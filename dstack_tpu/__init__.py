@@ -1,0 +1,8 @@
+"""dstack_tpu — a TPU-native AI workload orchestrator.
+
+Capability parity target: dstack (see SURVEY.md). The accelerator atom here is a TPU
+pod-slice topology (v5e/v5p/v6e), fleets are slices, and the cluster contract is
+JAX/PJRT/MegaScale environment wiring instead of NCCL/MPI.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,59 @@
+"""Framework exception hierarchy (parity: /root/reference src/dstack/_internal/core/errors.py)."""
+
+
+class DstackTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class ConfigurationError(DstackTpuError):
+    """Invalid user-supplied configuration."""
+
+
+class ServerClientError(DstackTpuError):
+    """Error reported by the server to a client; carries an HTTP-friendly message."""
+
+    code = "error"
+
+    def __init__(self, msg: str = ""):
+        super().__init__(msg)
+        self.msg = msg
+
+
+class ResourceNotExistsError(ServerClientError):
+    code = "resource_not_exists"
+
+
+class ResourceExistsError(ServerClientError):
+    code = "resource_exists"
+
+
+class ForbiddenError(ServerClientError):
+    code = "forbidden"
+
+
+class NotAuthenticatedError(ServerClientError):
+    code = "not_authenticated"
+
+
+class BackendError(DstackTpuError):
+    """Cloud backend failure."""
+
+
+class NoCapacityError(BackendError):
+    """No offers/capacity available to provision."""
+
+
+class ComputeError(BackendError):
+    """Provisioning call failed."""
+
+
+class PlacementGroupInUseError(BackendError):
+    pass
+
+
+class SSHError(DstackTpuError):
+    pass
+
+
+class GatewayError(DstackTpuError):
+    pass

@@ -1,0 +1,41 @@
+"""Backend identity + config wire models (parity: reference core/models/backends.py)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import List, Optional
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class BackendType(str, Enum):
+    """Cloud drivers shipped with the framework.
+
+    The reference ships 16 GPU-centric backends; this build is TPU-first: GCP (the only
+    cloud with TPUs), local (dev/test, shim-less), remote (SSH fleets of TPU VMs), and
+    mock (testing). The Compute ABC keeps the same extension surface so more clouds can
+    be added (reference base/compute.py:52-367).
+    """
+
+    GCP = "gcp"
+    LOCAL = "local"
+    REMOTE = "remote"
+    MOCK = "mock"
+
+
+class BackendConfig(CoreModel):
+    type: BackendType
+    project_id: Optional[str] = None  # GCP project
+    regions: Optional[List[str]] = None
+    creds: Optional[dict] = None
+
+    def masked(self) -> "BackendConfig":
+        c = self.model_copy(deep=True)
+        if c.creds:
+            c.creds = {k: "******" for k in c.creds}
+        return c
+
+
+class BackendInfo(CoreModel):
+    name: str
+    config: BackendConfig

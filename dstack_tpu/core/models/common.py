@@ -1,0 +1,181 @@
+"""Shared model base classes and scalar DSL types.
+
+Parity: /root/reference src/dstack/_internal/core/models/common.py and the
+Memory/Duration/Range DSL in .../models/resources.py:1-120 — re-designed for pydantic v2
+(annotated validators instead of v1 custom types).
+"""
+
+from __future__ import annotations
+
+import re
+from enum import Enum
+from typing import Annotated, Generic, Optional, TypeVar, Union
+
+from pydantic import (
+    BaseModel,
+    BeforeValidator,
+    ConfigDict,
+    PlainSerializer,
+    model_validator,
+)
+
+
+class CoreModel(BaseModel):
+    """Wire models: tolerant of unknown fields for forward compatibility."""
+
+    model_config = ConfigDict(populate_by_name=True, extra="ignore")
+
+
+class ConfigModel(BaseModel):
+    """User-authored YAML configuration models: unknown keys are an error."""
+
+    model_config = ConfigDict(populate_by_name=True, extra="forbid")
+
+
+class RegistryAuth(CoreModel):
+    username: Optional[str] = None
+    password: Optional[str] = None
+
+
+_DURATION_RE = re.compile(r"^\s*(\d+)\s*(s|m|h|d|w)?\s*$")
+_DURATION_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800, None: 1}
+
+
+def parse_duration(v: Union[int, str, None]) -> Optional[int]:
+    """'90s' | '15m' | '2h' | '1d' | 'off' | int seconds -> seconds (or None for 'off')."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        raise ValueError("invalid duration")
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = v.strip().lower()
+    if s in ("off", "-1"):
+        return None
+    m = _DURATION_RE.match(s)
+    if m is None:
+        raise ValueError(f"invalid duration: {v!r} (expected e.g. 30s, 15m, 2h, 1d)")
+    return int(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+def format_duration(seconds: Optional[int]) -> str:
+    if seconds is None:
+        return "off"
+    for unit, div in (("w", 604800), ("d", 86400), ("h", 3600), ("m", 60)):
+        if seconds and seconds % div == 0:
+            return f"{seconds // div}{unit}"
+    return f"{seconds}s"
+
+
+Duration = Annotated[
+    Optional[int],
+    BeforeValidator(parse_duration),
+    PlainSerializer(lambda v: v, return_type=Optional[int]),
+]
+
+
+_MEMORY_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(tb|gb|mb|kb|b)?\s*$", re.IGNORECASE)
+_MEMORY_UNITS = {"tb": 1024.0, "gb": 1.0, "mb": 1 / 1024, "kb": 1 / 1024**2, "b": 1 / 1024**3, None: 1.0}
+
+
+def parse_memory(v: Union[int, float, str]) -> float:
+    """'16GB' | '512MB' | 16 -> gibibytes (float)."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    m = _MEMORY_RE.match(str(v))
+    if m is None:
+        raise ValueError(f"invalid memory size: {v!r} (expected e.g. 512MB, 16GB, 1TB)")
+    unit = m.group(2).lower() if m.group(2) else None
+    return float(m.group(1)) * _MEMORY_UNITS[unit]
+
+
+def format_memory(gb: float) -> str:
+    if gb >= 1024 and gb % 1024 == 0:
+        return f"{int(gb // 1024)}TB"
+    if gb == int(gb):
+        return f"{int(gb)}GB"
+    return f"{int(gb * 1024)}MB"
+
+
+Memory = Annotated[float, BeforeValidator(parse_memory)]
+
+T = TypeVar("T", int, float)
+
+
+class Range(BaseModel, Generic[T]):
+    """Inclusive numeric range DSL: 4 | '4..8' | '4..' | '..8' | {min: 4, max: 8}."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    min: Optional[T] = None
+    max: Optional[T] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if v is None or isinstance(v, dict):
+            return v
+        if isinstance(v, Range):
+            return {"min": v.min, "max": v.max}
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return {"min": v, "max": v}
+        if isinstance(v, str):
+            s = v.replace(" ", "")
+            if ".." in s:
+                lo, _, hi = s.partition("..")
+                return {"min": lo or None, "max": hi or None}
+            return {"min": s, "max": s}
+        raise ValueError(f"invalid range: {v!r}")
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.min is None and self.max is None:
+            raise ValueError("range must have at least one bound")
+        if self.min is not None and self.max is not None and self.min > self.max:
+            raise ValueError(f"range min>{'max'}: {self.min}..{self.max}")
+        return self
+
+    def contains(self, value: Union[int, float]) -> bool:
+        if self.min is not None and value < self.min:
+            return False
+        if self.max is not None and value > self.max:
+            return False
+        return True
+
+    def intersects(self, other: "Range") -> bool:
+        lo = max(x for x in (self.min, other.min) if x is not None) if (self.min is not None or other.min is not None) else None
+        hi = min(x for x in (self.max, other.max) if x is not None) if (self.max is not None or other.max is not None) else None
+        if lo is None or hi is None:
+            return True
+        return lo <= hi
+
+    def pretty(self) -> str:
+        if self.min == self.max:
+            return str(self.min)
+        lo = "" if self.min is None else str(self.min)
+        hi = "" if self.max is None else str(self.max)
+        return f"{lo}..{hi}"
+
+
+class MemoryRange(Range[float]):
+    @model_validator(mode="before")
+    @classmethod
+    def _parse_mem(cls, v):
+        if isinstance(v, str) and ".." in v:
+            s = v.replace(" ", "")
+            lo, _, hi = s.partition("..")
+            return {"min": parse_memory(lo) if lo else None, "max": parse_memory(hi) if hi else None}
+        if isinstance(v, (str, int, float)) and not isinstance(v, bool):
+            g = parse_memory(v)
+            return {"min": g, "max": g}
+        if isinstance(v, dict):
+            return {
+                "min": parse_memory(v["min"]) if v.get("min") is not None else None,
+                "max": parse_memory(v["max"]) if v.get("max") is not None else None,
+            }
+        return v
+
+
+class ApplyAction(str, Enum):
+    CREATE = "create"
+    UPDATE = "update"

@@ -1,0 +1,361 @@
+"""Declarative run/resource configurations (the YAML a user `apply`s).
+
+Parity: /root/reference src/dstack/_internal/core/models/configurations.py
+(TaskConfiguration:355, ServiceConfiguration:479, DevEnvironmentConfiguration:345,
+discriminated union :495-545) and fleets.py/volumes.py/gateways.py configuration models —
+re-designed TPU-first: no GPU/CUDA knobs, `resources.tpu` is a slice topology, and
+multi-node tasks map onto slice hosts (`nodes` = hosts of a slice, auto-derived).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Annotated, Any, Dict, List, Literal, Optional, Union
+
+from pydantic import Field, TypeAdapter, model_validator
+
+from dstack_tpu.core.errors import ConfigurationError
+from dstack_tpu.core.models.common import ConfigModel, Duration, RegistryAuth
+from dstack_tpu.core.models.envs import Env
+from dstack_tpu.core.models.profiles import (
+    Profile,
+    RetryField,
+    StartupOrder,
+    StopCriteria,
+    UtilizationPolicy,
+)
+from dstack_tpu.core.models.resources import ResourcesSpec
+from dstack_tpu.core.models.services import ModelSpec, RateLimit, ScalingSpec
+
+DEFAULT_REPO_DIR = "/workflow"
+DEFAULT_TPU_IMAGE = "dstack-tpu/base:latest"  # docker/tpu image: libtpu + JAX/XLA + sshd
+
+
+class PortMapping(ConfigModel):
+    local_port: Optional[int] = None
+    container_port: int
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, int):
+            return {"container_port": v}
+        if isinstance(v, str):
+            if ":" in v:
+                lo, _, co = v.partition(":")
+                return {"local_port": int(lo) if lo != "*" else None, "container_port": int(co)}
+            return {"container_port": int(v)}
+        return v
+
+
+class VolumeMountPoint(ConfigModel):
+    name: str
+    path: str
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, str):
+            name, _, path = v.partition(":")
+            if not path:
+                raise ValueError(f"volume mount must be 'name:/path', got {v!r}")
+            return {"name": name, "path": path}
+        return v
+
+
+class InstanceMountPoint(ConfigModel):
+    instance_path: str
+    path: str
+    optional: bool = False
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, str):
+            ip, _, path = v.partition(":")
+            if not path:
+                raise ValueError(f"instance mount must be '/host/path:/container/path', got {v!r}")
+            return {"instance_path": ip, "path": path}
+        return v
+
+
+AnyMountPoint = Union[VolumeMountPoint, InstanceMountPoint]
+
+
+def _parse_mount(v):
+    if isinstance(v, str) and v.startswith("/"):
+        return InstanceMountPoint.model_validate(v)
+    if isinstance(v, dict) and ("instance_path" in v):
+        return InstanceMountPoint.model_validate(v)
+    if isinstance(v, (str, dict)):
+        return VolumeMountPoint.model_validate(v)
+    return v
+
+
+class BaseRunConfiguration(ConfigModel):
+    name: Optional[str] = Field(default=None, description="The run name; auto-generated if omitted")
+    image: Optional[str] = Field(default=None, description="Container image (defaults to the TPU base image)")
+    privileged: bool = False
+    entrypoint: Optional[str] = None
+    registry_auth: Optional[RegistryAuth] = None
+    python: Optional[str] = Field(default=None, description="Python version for the default image")
+    env: Env = Field(default_factory=Env)
+    resources: ResourcesSpec = Field(default_factory=ResourcesSpec)
+    volumes: List[Annotated[AnyMountPoint, "mount"]] = Field(default_factory=list)
+    working_dir: Optional[str] = None
+    home_dir: str = "/root"
+    repo_dir: str = DEFAULT_REPO_DIR
+    # Profile overlay fields, inline:
+    backends: Optional[List[str]] = None
+    regions: Optional[List[str]] = None
+    availability_zones: Optional[List[str]] = None
+    spot_policy: Optional[str] = None
+    retry: RetryField = None
+    max_duration: Duration = None
+    stop_duration: Duration = None  # default applied by the job configurator (300s)
+    max_price: Optional[float] = Field(default=None, gt=0)
+    creation_policy: Optional[str] = None
+    idle_duration: Duration = None
+    utilization_policy: Optional[UtilizationPolicy] = None
+    reservation: Optional[str] = None
+    fleets: Optional[List[str]] = None
+    tags: Optional[Dict[str, str]] = None
+
+    _PROFILE_FIELDS = (
+        "backends",
+        "regions",
+        "availability_zones",
+        "spot_policy",
+        "retry",
+        "max_duration",
+        "stop_duration",
+        "max_price",
+        "creation_policy",
+        "idle_duration",
+        "utilization_policy",
+        "reservation",
+        "fleets",
+        "tags",
+    )
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse_volumes(cls, values):
+        if isinstance(values, dict) and isinstance(values.get("volumes"), list):
+            values = dict(values)
+            values["volumes"] = [_parse_mount(v) for v in values["volumes"]]
+        return values
+
+    def inline_profile(self) -> Profile:
+        """Only fields the user actually set in the configuration, so the profile merge
+        can distinguish 'unset' from an explicit value (incl. an explicit `off`)."""
+        fields = {
+            name: getattr(self, name)
+            for name in self._PROFILE_FIELDS
+            if name in self.model_fields_set
+        }
+        return Profile(**fields)
+
+
+class TaskConfiguration(BaseRunConfiguration):
+    """A batch job; on a multi-host TPU slice one job runs per host (gang-scheduled)."""
+
+    type: Literal["task"] = "task"
+    commands: List[str] = Field(default_factory=list)
+    nodes: int = Field(default=0, ge=0, description="Hosts; 0 = derive from the TPU slice topology")
+    ports: List[PortMapping] = Field(default_factory=list)
+    startup_order: StartupOrder = StartupOrder.ANY
+    stop_criteria: StopCriteria = StopCriteria.ALL_DONE
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not self.commands and self.entrypoint is None:
+            raise ValueError("task requires `commands` (or an image `entrypoint`)")
+        return self
+
+
+class ServiceConfiguration(BaseRunConfiguration):
+    """A long-running inference service behind the proxy/gateway with autoscaling."""
+
+    type: Literal["service"] = "service"
+    commands: List[str] = Field(default_factory=list)
+    port: PortMapping
+    gateway: Optional[Union[bool, str]] = None
+    strip_prefix: bool = True
+    model: Optional[ModelSpec] = None
+    https: bool = True
+    auth: bool = True
+    replicas: Any = 1
+    scaling: Optional[ScalingSpec] = None
+    rate_limits: List[RateLimit] = Field(default_factory=list)
+    probes: List[Any] = Field(default_factory=list)
+
+    @model_validator(mode="after")
+    def _check(self):
+        from dstack_tpu.core.models.common import Range
+
+        self.replicas = Range[int].model_validate(self.replicas)
+        if self.replicas.min is None:
+            self.replicas.min = 0
+        if self.replicas.max is None:
+            self.replicas.max = self.replicas.min
+        if self.replicas.min != self.replicas.max and self.scaling is None:
+            raise ValueError("autoscaling range of replicas requires `scaling` to be set")
+        if not self.commands and self.entrypoint is None:
+            raise ValueError("service requires `commands` (or an image `entrypoint`)")
+        return self
+
+
+class IDE(str, Enum):
+    VSCODE = "vscode"
+    CURSOR = "cursor"
+
+
+class DevEnvironmentConfiguration(BaseRunConfiguration):
+    """An interactive TPU VM with an IDE bootstrap and a JAX-ready environment."""
+
+    type: Literal["dev-environment"] = "dev-environment"
+    ide: IDE = IDE.VSCODE
+    version: Optional[str] = None
+    init: List[str] = Field(default_factory=list)
+    inactivity_duration: Duration = None
+
+
+AnyRunConfiguration = Annotated[
+    Union[TaskConfiguration, ServiceConfiguration, DevEnvironmentConfiguration],
+    Field(discriminator="type"),
+]
+
+
+# ---------------------------------------------------------------------------------------
+# Fleet / volume / gateway configurations
+
+
+class SSHHostParams(ConfigModel):
+    hostname: str
+    port: int = 22
+    user: Optional[str] = None
+    identity_file: Optional[str] = None
+    proxy_jump: Optional[str] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, str):
+            return {"hostname": v}
+        return v
+
+
+class SSHParams(ConfigModel):
+    user: Optional[str] = None
+    identity_file: Optional[str] = None
+    hosts: List[SSHHostParams] = Field(default_factory=list)
+    network: Optional[str] = None
+    proxy_jump: Optional[str] = None
+
+
+class InstanceGroupPlacement(str, Enum):
+    ANY = "any"
+    CLUSTER = "cluster"
+
+
+class FleetConfiguration(ConfigModel):
+    """A fleet is a set of instances; a cloud TPU fleet's atom is a pod slice
+    (`resources.tpu`), where one slice = `hosts` instances gang-provisioned together.
+    """
+
+    type: Literal["fleet"] = "fleet"
+    name: Optional[str] = None
+    env: Env = Field(default_factory=Env)
+    ssh_config: Optional[SSHParams] = None
+    nodes: Optional[Any] = None  # Range: instance count for cloud fleets
+    placement: InstanceGroupPlacement = InstanceGroupPlacement.ANY
+    resources: ResourcesSpec = Field(default_factory=ResourcesSpec)
+    backends: Optional[List[str]] = None
+    regions: Optional[List[str]] = None
+    availability_zones: Optional[List[str]] = None
+    instance_types: Optional[List[str]] = None
+    spot_policy: Optional[str] = None
+    max_price: Optional[float] = Field(default=None, gt=0)
+    idle_duration: Duration = None
+    reservation: Optional[str] = None
+    tags: Optional[Dict[str, str]] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        from dstack_tpu.core.models.common import Range
+
+        if self.ssh_config is None and self.nodes is None:
+            self.nodes = 1
+        if self.nodes is not None:
+            self.nodes = Range[int].model_validate(self.nodes)
+        if self.ssh_config is not None and self.nodes is not None:
+            raise ValueError("`nodes` and `ssh_config` are mutually exclusive")
+        if self.ssh_config is not None and not self.ssh_config.hosts:
+            raise ValueError("ssh_config requires at least one host")
+        return self
+
+
+class VolumeConfiguration(ConfigModel):
+    type: Literal["volume"] = "volume"
+    name: Optional[str] = None
+    backend: str = "gcp"
+    region: str
+    availability_zone: Optional[str] = None
+    size: Optional[Any] = None  # Memory, e.g. "100GB"
+    volume_id: Optional[str] = Field(default=None, description="Register an existing disk instead of creating one")
+    auto_cleanup_duration: Duration = None
+    tags: Optional[Dict[str, str]] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        from dstack_tpu.core.models.common import parse_memory
+
+        if self.size is None and self.volume_id is None:
+            raise ValueError("either `size` or `volume_id` must be set")
+        if self.size is not None:
+            self.size = parse_memory(self.size)
+        return self
+
+
+class GatewayConfiguration(ConfigModel):
+    type: Literal["gateway"] = "gateway"
+    name: Optional[str] = None
+    backend: str = "gcp"
+    region: str
+    domain: Optional[str] = None
+    public_ip: bool = True
+    certificate: Optional[Dict[str, Any]] = None
+    tags: Optional[Dict[str, str]] = None
+
+
+AnyConfiguration = Annotated[
+    Union[
+        TaskConfiguration,
+        ServiceConfiguration,
+        DevEnvironmentConfiguration,
+        FleetConfiguration,
+        VolumeConfiguration,
+        GatewayConfiguration,
+    ],
+    Field(discriminator="type"),
+]
+
+_any_configuration_adapter: TypeAdapter = TypeAdapter(AnyConfiguration)
+_any_run_configuration_adapter: TypeAdapter = TypeAdapter(AnyRunConfiguration)
+
+
+def parse_configuration(data: dict) -> AnyConfiguration:
+    if not isinstance(data, dict) or "type" not in data:
+        raise ConfigurationError("configuration must be a mapping with a `type` key")
+    try:
+        return _any_configuration_adapter.validate_python(data)
+    except Exception as e:
+        raise ConfigurationError(str(e)) from e
+
+
+def parse_run_configuration(data: dict) -> Union[TaskConfiguration, ServiceConfiguration, DevEnvironmentConfiguration]:
+    try:
+        return _any_run_configuration_adapter.validate_python(data)
+    except Exception as e:
+        raise ConfigurationError(str(e)) from e

@@ -1,0 +1,49 @@
+"""Environment-variable DSL: `env:` as dict or list of NAME=VALUE / bare NAME entries.
+
+Parity: /root/reference src/dstack/_internal/core/models/envs.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from pydantic import model_validator
+
+from dstack_tpu.core.models.common import ConfigModel
+
+
+class Env(ConfigModel):
+    """Bare names (no '=') must be supplied from the caller's environment at submit."""
+
+    values: Dict[str, Optional[str]] = {}
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, Env):
+            return {"values": dict(v.values)}
+        if isinstance(v, dict) and "values" not in v:
+            return {"values": {str(k): None if val is None else str(val) for k, val in v.items()}}
+        if isinstance(v, list):
+            out: Dict[str, Optional[str]] = {}
+            for item in v:
+                s = str(item)
+                if "=" in s:
+                    k, _, val = s.partition("=")
+                    out[k] = val
+                else:
+                    out[s] = None
+            return {"values": out}
+        return v
+
+    def as_dict(self) -> Dict[str, str]:
+        missing = [k for k, v in self.values.items() if v is None]
+        if missing:
+            raise ValueError(f"env variables without values must be set at submit time: {missing}")
+        return {k: v for k, v in self.values.items() if v is not None}
+
+    def update(self, other: Union["Env", Dict[str, str]]) -> None:
+        if isinstance(other, Env):
+            self.values.update(other.values)
+        else:
+            self.values.update(other)

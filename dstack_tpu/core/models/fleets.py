@@ -1,0 +1,55 @@
+"""Fleet wire models (parity: reference core/models/fleets.py)."""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from enum import Enum
+from typing import List, Optional
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import FleetConfiguration
+from dstack_tpu.core.models.instances import Instance
+
+
+class FleetStatus(str, Enum):
+    SUBMITTED = "submitted"
+    ACTIVE = "active"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    FAILED = "failed"
+
+
+class FleetSpec(CoreModel):
+    configuration: FleetConfiguration
+    configuration_path: Optional[str] = None
+
+
+class Fleet(CoreModel):
+    id: uuid.UUID
+    name: str
+    project_name: str
+    spec: FleetSpec
+    created_at: datetime.datetime
+    status: FleetStatus
+    status_message: Optional[str] = None
+    instances: List[Instance] = Field(default_factory=list)
+
+
+class FleetPlan(CoreModel):
+    project_name: str
+    user: str
+    spec: FleetSpec
+    effective_name: Optional[str] = None
+    current_resource: Optional[Fleet] = None
+    offers: List[dict] = Field(default_factory=list)
+    total_offers: int = 0
+    max_offer_price: Optional[float] = None
+    action: str = "create"
+
+
+class ApplyFleetPlanInput(CoreModel):
+    spec: FleetSpec
+    force: bool = False

@@ -1,0 +1,40 @@
+"""Gateway wire models (parity: reference core/models/gateways.py)."""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import GatewayConfiguration
+
+
+class GatewayStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    RUNNING = "running"
+    FAILED = "failed"
+
+
+class GatewayProvisioningData(CoreModel):
+    instance_id: str
+    ip_address: Optional[str] = None
+    region: str = ""
+    availability_zone: Optional[str] = None
+    hostname: Optional[str] = None
+    backend_data: Optional[str] = None
+
+
+class Gateway(CoreModel):
+    id: uuid.UUID
+    name: str
+    project_name: str
+    configuration: GatewayConfiguration
+    created_at: datetime.datetime
+    status: GatewayStatus
+    status_message: Optional[str] = None
+    ip_address: Optional[str] = None
+    hostname: Optional[str] = None
+    default: bool = False

@@ -1,0 +1,148 @@
+"""Instance & offer wire models.
+
+Parity: /root/reference src/dstack/_internal/core/models/instances.py. TPU twist: an
+*offer* is a whole pod slice; `hosts_per_slice > 1` means one cloud resource backs
+multiple instance rows (worker ≠ instance — SURVEY §7 hard part (a))."""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from enum import Enum
+from typing import List, Optional
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.resources import TpuSliceSpec
+
+
+class TpuResources(CoreModel):
+    """Accelerator inventory of one offer (whole slice) or one instance (one host)."""
+
+    generation: Optional[str] = None
+    chips: int = 0
+    hosts: int = 1
+    topology: Optional[str] = None
+    hbm_gb: float = 0.0
+    bf16_tflops: float = 0.0
+
+    @classmethod
+    def from_slice(cls, s: TpuSliceSpec, topology: Optional[str] = None) -> "TpuResources":
+        return cls(
+            generation=s.generation,
+            chips=s.chips,
+            hosts=s.hosts,
+            topology=topology,
+            hbm_gb=s.total_hbm_gb,
+            bf16_tflops=s.bf16_tflops,
+        )
+
+
+class HostResources(CoreModel):
+    cpus: int = 0
+    memory_gb: float = 0.0
+    disk_gb: float = 100.0
+    spot: bool = False
+    tpu: Optional[TpuResources] = None
+
+    def pretty(self) -> str:
+        parts = [f"{self.cpus}xCPU", f"{self.memory_gb:g}GB"]
+        if self.tpu is not None and self.tpu.chips:
+            parts.append(f"tpu:{self.tpu.generation}:{self.tpu.chips}chips")
+        if self.spot:
+            parts.append("spot")
+        return ", ".join(parts)
+
+
+class InstanceType(CoreModel):
+    name: str
+    resources: HostResources
+
+
+class InstanceAvailability(str, Enum):
+    UNKNOWN = "unknown"
+    AVAILABLE = "available"
+    NOT_AVAILABLE = "not_available"
+    NO_QUOTA = "no_quota"
+    IDLE = "idle"
+    BUSY = "busy"
+
+    def is_available(self) -> bool:
+        return self in (self.UNKNOWN, self.AVAILABLE, self.IDLE)
+
+
+class InstanceOffer(CoreModel):
+    backend: str
+    instance: InstanceType
+    region: str
+    price: float  # $/hr for the whole slice
+    availability: InstanceAvailability = InstanceAvailability.UNKNOWN
+    availability_zones: Optional[List[str]] = None
+    # TPU specifics: one offer may be a multi-host slice — provisioned atomically.
+    slice_name: Optional[str] = None  # e.g. v5p-16
+    hosts_per_slice: int = 1
+    spot: bool = False
+
+    @property
+    def total_hosts(self) -> int:
+        return self.hosts_per_slice
+
+
+class InstanceStatus(str, Enum):
+    PENDING = "pending"
+    PROVISIONING = "provisioning"
+    IDLE = "idle"
+    BUSY = "busy"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+
+    def is_available(self) -> bool:
+        return self in (self.IDLE, self.BUSY)
+
+    @classmethod
+    def finished_statuses(cls) -> List["InstanceStatus"]:
+        return [cls.TERMINATING, cls.TERMINATED]
+
+    def is_active(self) -> bool:
+        return self not in self.finished_statuses()
+
+
+class SSHConnectionParams(CoreModel):
+    hostname: str
+    username: str = "root"
+    port: int = 22
+    proxy_jump: Optional[str] = None
+
+
+class RemoteConnectionInfo(CoreModel):
+    host: str
+    port: int = 22
+    ssh_user: str = "root"
+    ssh_proxy: Optional[SSHConnectionParams] = None
+
+
+class Instance(CoreModel):
+    id: uuid.UUID
+    project_name: str
+    backend: Optional[str] = None
+    instance_type: Optional[InstanceType] = None
+    name: str
+    fleet_id: Optional[uuid.UUID] = None
+    fleet_name: Optional[str] = None
+    instance_num: int = 0
+    hostname: Optional[str] = None
+    status: InstanceStatus
+    unreachable: bool = False
+    termination_reason: Optional[str] = None
+    created: datetime.datetime
+    region: Optional[str] = None
+    availability_zone: Optional[str] = None
+    price: Optional[float] = None
+    # TPU slice identity: all hosts of one slice share slice_id; worker_num orders them.
+    slice_id: Optional[str] = None
+    slice_name: Optional[str] = None
+    worker_num: int = 0
+    hosts_per_slice: int = 1
+    total_blocks: int = 1
+    busy_blocks: int = 0

@@ -1,0 +1,27 @@
+"""Log event wire models (parity: reference core/models/logs.py)."""
+
+from __future__ import annotations
+
+import datetime
+from enum import Enum
+from typing import List
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class LogEventSource(str, Enum):
+    STDOUT = "stdout"
+    STDERR = "stderr"
+
+
+class LogEvent(CoreModel):
+    timestamp: datetime.datetime
+    log_source: LogEventSource = LogEventSource.STDOUT
+    message: str  # base64-encoded bytes on the wire
+
+
+class JobSubmissionLogs(CoreModel):
+    logs: List[LogEvent] = Field(default_factory=list)
+    next_token: str = ""

@@ -1,0 +1,25 @@
+"""Metrics wire models (parity: reference core/models/metrics.py). GPU util is replaced
+by TPU duty-cycle / tensorcore utilization and per-chip HBM usage."""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class MetricPoint(CoreModel):
+    timestamp: datetime.datetime
+    cpu_usage_percent: float = 0.0
+    memory_usage_bytes: int = 0
+    memory_working_set_bytes: int = 0
+    tpu_duty_cycle_percent: Optional[float] = None
+    tpu_hbm_usage_bytes: Optional[int] = None
+    tpu_tensorcore_util_percent: Optional[float] = None
+
+
+class JobMetrics(CoreModel):
+    points: List[MetricPoint] = Field(default_factory=list)

@@ -1,0 +1,131 @@
+"""Run profiles: scheduling/lifecycle knobs shared by all configuration types.
+
+Parity: /root/reference src/dstack/_internal/core/models/profiles.py (SpotPolicy,
+RetryEvent, utilization policy, startup_order/stop_criteria, idle duration).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Annotated, List, Optional, Union
+
+from pydantic import BeforeValidator, Field, model_validator
+
+from dstack_tpu.core.models.common import ConfigModel, Duration, parse_duration
+
+DEFAULT_RUN_TERMINATION_IDLE_TIME = 5 * 60
+DEFAULT_FLEET_TERMINATION_IDLE_TIME = 3 * 24 * 3600
+
+
+class SpotPolicy(str, Enum):
+    SPOT = "spot"
+    ONDEMAND = "on-demand"
+    AUTO = "auto"
+
+
+class CreationPolicy(str, Enum):
+    REUSE = "reuse"
+    REUSE_OR_CREATE = "reuse-or-create"
+
+
+class TerminationPolicy(str, Enum):
+    DONT_DESTROY = "dont-destroy"
+    DESTROY_AFTER_IDLE = "destroy-after-idle"
+
+
+class RetryEvent(str, Enum):
+    NO_CAPACITY = "no-capacity"
+    INTERRUPTION = "interruption"
+    ERROR = "error"
+
+
+class StartupOrder(str, Enum):
+    ANY = "any"
+    MASTER_FIRST = "master-first"
+    WORKERS_FIRST = "workers-first"
+
+
+class StopCriteria(str, Enum):
+    ALL_DONE = "all-done"
+    MASTER_DONE = "master-done"
+
+
+class UtilizationPolicy(ConfigModel):
+    """Terminate a run whose accelerator duty-cycle stays below a threshold for a window."""
+
+    min_tpu_utilization: int = Field(ge=0, le=100, description="Percent duty cycle")
+    time_window: Duration = Field(description="Window over which utilization is evaluated")
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.time_window is None or self.time_window < 60:
+            raise ValueError("time_window must be at least 1m")
+        return self
+
+
+class RetryPolicy(ConfigModel):
+    """`retry: true` | duration | {on_events: [...], duration: 1h}."""
+
+    on_events: List[RetryEvent] = Field(
+        default_factory=lambda: [RetryEvent.NO_CAPACITY, RetryEvent.INTERRUPTION, RetryEvent.ERROR]
+    )
+    duration: Duration = 3600
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if v is True:
+            return {}
+        if isinstance(v, (int, str)) and not isinstance(v, bool):
+            return {"duration": v}
+        return v
+
+
+def parse_retry(v):
+    """Field-site parser so `retry: false` disables retry instead of failing validation."""
+    if v is False or v is None:
+        return None
+    return v
+
+
+RetryField = Annotated[Optional[RetryPolicy], BeforeValidator(parse_retry)]
+
+
+class Profile(ConfigModel):
+    """Named profile; all fields overlay onto run configurations."""
+
+    name: Optional[str] = None
+    backends: Optional[List[str]] = None
+    regions: Optional[List[str]] = None
+    availability_zones: Optional[List[str]] = None
+    instance_types: Optional[List[str]] = None
+    reservation: Optional[str] = None
+    spot_policy: Optional[SpotPolicy] = None
+    retry: RetryField = None
+    max_duration: Optional[Union[int, str]] = None
+    stop_duration: Optional[Union[int, str]] = None
+    max_price: Optional[float] = Field(default=None, gt=0)
+    creation_policy: Optional[CreationPolicy] = None
+    idle_duration: Optional[Union[int, str]] = None
+    utilization_policy: Optional[UtilizationPolicy] = None
+    startup_order: Optional[StartupOrder] = None
+    stop_criteria: Optional[StopCriteria] = None
+    fleets: Optional[List[str]] = None
+    tags: Optional[dict] = None
+
+    def normalized_max_duration(self) -> Optional[int]:
+        return parse_duration(self.max_duration)
+
+    def normalized_idle_duration(self) -> Optional[int]:
+        return parse_duration(self.idle_duration)
+
+
+def merge_profiles(base: Profile, overlay: Profile) -> Profile:
+    """Overlay explicitly-set fields of `overlay` onto `base` (overlay wins).
+
+    Uses fields-set rather than non-None so an explicit `off` (-> None) in the overlay
+    disables a policy from the base instead of being silently dropped.
+    """
+    data = base.model_dump(exclude_unset=True)
+    data.update(overlay.model_dump(exclude_unset=True))
+    return Profile.model_validate(data)

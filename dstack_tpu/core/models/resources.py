@@ -1,0 +1,261 @@
+"""Resource requirements DSL where the accelerator atom is a *TPU pod-slice topology*.
+
+Parity: /root/reference src/dstack/_internal/core/models/resources.py (GPUSpec DSL,
+`gpu: v5litepod-8` shorthand) — re-designed so TPU slices (generation × topology ×
+slice count) are first-class rather than a vendor branch of a GPU spec.
+
+Naming semantics (public TPU naming):
+- v4 / v5p slice names count **TensorCores** (v5p-16 = 8 chips, 2 hosts of 4 chips).
+- v5e (v5litepod) / v6e names count **chips** (v5litepod-8 = 8 chips, 1 host).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional
+
+from pydantic import Field, model_validator
+
+from dstack_tpu.core.models.common import ConfigModel, CoreModel, MemoryRange, Range
+
+
+class TpuGeneration(CoreModel):
+    """Static description of one TPU generation."""
+
+    name: str
+    chips_per_host: int
+    hbm_gb_per_chip: float
+    bf16_tflops_per_chip: float
+    # True when the slice name counts TensorCores (2 per chip) rather than chips.
+    name_counts_cores: bool
+    # Sorted valid chip counts for slices (sub-host sizes first where supported).
+    valid_chip_counts: List[int]
+    default_runtime_version: str
+
+
+# Peak numbers are the public per-chip specs; used for offer metadata and MFU math.
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    g.name: g
+    for g in [
+        TpuGeneration(
+            name="v4",
+            chips_per_host=4,
+            hbm_gb_per_chip=32,
+            bf16_tflops_per_chip=275,
+            name_counts_cores=True,
+            valid_chip_counts=[4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048],
+            default_runtime_version="tpu-ubuntu2204-base",
+        ),
+        TpuGeneration(
+            name="v5e",
+            chips_per_host=8,
+            hbm_gb_per_chip=16,
+            bf16_tflops_per_chip=197,
+            name_counts_cores=False,
+            valid_chip_counts=[1, 2, 4, 8, 16, 32, 64, 128, 256],
+            default_runtime_version="v2-alpha-tpuv5-lite",
+        ),
+        TpuGeneration(
+            name="v5p",
+            chips_per_host=4,
+            hbm_gb_per_chip=95,
+            bf16_tflops_per_chip=459,
+            name_counts_cores=True,
+            valid_chip_counts=[4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 3072],
+            default_runtime_version="v2-alpha-tpuv5",
+        ),
+        TpuGeneration(
+            name="v6e",
+            chips_per_host=4,
+            hbm_gb_per_chip=32,
+            bf16_tflops_per_chip=918,
+            name_counts_cores=False,
+            valid_chip_counts=[1, 4, 8, 16, 32, 64, 128, 256],
+            default_runtime_version="v2-alpha-tpuv6e",
+        ),
+    ]
+}
+
+_GEN_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v5": "v5p",
+    "trillium": "v6e",
+}
+
+_SLICE_NAME_RE = re.compile(r"^(v\d+[a-z]*|v5litepod|trillium)-(\d+)$", re.IGNORECASE)
+
+
+def normalize_generation(name: str) -> str:
+    n = name.lower()
+    n = _GEN_ALIASES.get(n, n)
+    if n not in TPU_GENERATIONS:
+        raise ValueError(
+            f"unknown TPU generation {name!r}; known: {sorted(TPU_GENERATIONS)} "
+            f"(aliases: {sorted(_GEN_ALIASES)})"
+        )
+    return n
+
+
+class TpuSliceSpec(ConfigModel):
+    """A concrete TPU pod slice: generation + chip count (+ derived topology/hosts).
+
+    Accepted YAML forms::
+
+        tpu: v5p-16                      # slice name
+        tpu: {generation: v5e, chips: 8}
+        tpu: {name: v5litepod-16}
+        tpu: {generation: v5p, chips: 8, count: 2}   # 2 slices (multislice)
+    """
+
+    generation: str
+    chips: int
+    count: Range[int] = Field(default_factory=lambda: Range[int](min=1, max=1), description="Number of slices (multislice when >1)")
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, str):
+            return cls._parse_name(v)
+        if isinstance(v, dict):
+            v = dict(v)
+            name = v.pop("name", None)
+            if name is not None:
+                if "generation" in v or "chips" in v:
+                    raise ValueError("`name` cannot be combined with `generation`/`chips`")
+                parsed = cls._parse_name(name)
+                parsed.update(v)
+                return parsed
+            if "generation" in v:
+                v["generation"] = normalize_generation(str(v["generation"]))
+            return v
+        return v
+
+    @staticmethod
+    def _parse_name(name: str) -> dict:
+        m = _SLICE_NAME_RE.match(name.strip())
+        if m is None:
+            raise ValueError(f"invalid TPU slice name {name!r} (expected e.g. v5p-16, v5e-8, v6e-256)")
+        gen = normalize_generation(m.group(1))
+        n = int(m.group(2))
+        chips = n // 2 if TPU_GENERATIONS[gen].name_counts_cores else n
+        if chips < 1:
+            raise ValueError(f"invalid TPU slice name {name!r}: too small")
+        return {"generation": gen, "chips": chips}
+
+    @model_validator(mode="after")
+    def _validate(self):
+        gen = TPU_GENERATIONS[self.generation]
+        if self.chips not in gen.valid_chip_counts:
+            raise ValueError(
+                f"{self.generation} slices support chip counts {gen.valid_chip_counts}, got {self.chips}"
+            )
+        return self
+
+    @property
+    def gen_info(self) -> TpuGeneration:
+        return TPU_GENERATIONS[self.generation]
+
+    @property
+    def hosts(self) -> int:
+        return max(1, math.ceil(self.chips / self.gen_info.chips_per_host))
+
+    @property
+    def slice_name(self) -> str:
+        n = self.chips * 2 if self.gen_info.name_counts_cores else self.chips
+        return f"{self.generation}-{n}"
+
+    @property
+    def accelerator_type(self) -> str:
+        """GCP TPU API accelerator type string."""
+        if self.generation == "v5e":
+            return f"v5litepod-{self.chips}"
+        return self.slice_name
+
+    @property
+    def total_hbm_gb(self) -> float:
+        return self.chips * self.gen_info.hbm_gb_per_chip
+
+    @property
+    def bf16_tflops(self) -> float:
+        return self.chips * self.gen_info.bf16_tflops_per_chip
+
+    def pretty(self) -> str:
+        c = self.count
+        prefix = "" if c.min == 1 and c.max == 1 else f"{c.pretty()}x "
+        return f"{prefix}{self.slice_name} ({self.chips} chips, {self.hosts} hosts)"
+
+
+def default_topology(generation: str, chips: int) -> str:
+    """A reasonable ICI topology string for a chip count (e.g. 16 chips v5p -> 2x2x4)."""
+    gen = TPU_GENERATIONS[normalize_generation(generation)]
+    if gen.name in ("v5e", "v6e"):  # 2-D tori
+        if chips == 1:
+            return "1x1"
+        a = 2 ** (int(math.log2(chips)) // 2)
+        return f"{a}x{chips // a}"
+    # 3-D tori (v4/v5p); factor so non-power-of-two counts (e.g. 3072 = 3*1024) work
+    dims = [1, 1, 1]
+    i = 0
+    remaining = chips
+    while remaining > 1:
+        factor = next((p for p in (2, 3, 5, 7) if remaining % p == 0), remaining)
+        dims[i % 3] *= factor
+        remaining //= factor
+        i += 1
+    dims.sort()
+    return "x".join(str(d) for d in dims)
+
+
+class CpuSpec(ConfigModel):
+    """CPU requirement: count range + optional arch (parity: resources.py CPUSpec)."""
+
+    arch: Optional[str] = None
+    count: Range[int] = Field(default_factory=lambda: Range[int](min=2))
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if v is None:
+            return v
+        if isinstance(v, (int, str)) and not isinstance(v, bool):
+            s = str(v)
+            if ":" in s:
+                arch, _, cnt = s.partition(":")
+                return {"arch": arch or None, "count": cnt}
+            return {"count": s}
+        return v
+
+
+class DiskSpec(ConfigModel):
+    size: MemoryRange = Field(default_factory=lambda: MemoryRange(min=100.0))
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, (int, float, str)) and not isinstance(v, bool):
+            return {"size": v}
+        return v
+
+
+class ResourcesSpec(ConfigModel):
+    """The `resources:` block of a run configuration.
+
+    TPU-first: `tpu:` names a pod slice; `gpu:`-style specs from the reference are out of
+    scope (the framework targets TPU fleets; CPU-only runs use cpu/memory/disk alone).
+    """
+
+    tpu: Optional[TpuSliceSpec] = None
+    cpu: CpuSpec = Field(default_factory=CpuSpec)
+    memory: MemoryRange = Field(default_factory=lambda: MemoryRange(min=8.0))
+    shm_size: Optional[MemoryRange] = None
+    disk: Optional[DiskSpec] = Field(default_factory=DiskSpec)
+
+    def pretty(self) -> str:
+        parts = [f"cpu={self.cpu.count.pretty()}", f"mem={self.memory.pretty()}GB"]
+        if self.tpu is not None:
+            parts.insert(0, f"tpu={self.tpu.pretty()}")
+        if self.disk is not None:
+            parts.append(f"disk={self.disk.size.pretty()}GB")
+        return ", ".join(parts)

@@ -1,0 +1,317 @@
+"""Run/job wire models + the run/job state machines.
+
+Parity: /root/reference src/dstack/_internal/core/models/runs.py (JobStatus:44,
+JobTerminationReason:104, RunStatus:474, JobSpec:185, JobProvisioningData:209,
+ClusterInfo:270, Run:492, RunPlan:576). The cluster contract is re-designed for TPU:
+`ClusterInfo` carries slice topology + JAX coordinator + MegaScale env instead of an MPI
+hostfile (reference runner executor.go:262-274)."""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from enum import Enum
+from typing import Dict, List, Optional
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import AnyRunConfiguration
+from dstack_tpu.core.models.instances import InstanceType, SSHConnectionParams
+from dstack_tpu.core.models.profiles import Profile, RetryPolicy, UtilizationPolicy
+from dstack_tpu.core.models.resources import ResourcesSpec
+from dstack_tpu.core.models.services import ServiceSpec
+
+
+class JobStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    PULLING = "pulling"
+    RUNNING = "running"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    ABORTED = "aborted"
+    FAILED = "failed"
+    DONE = "done"
+
+    @classmethod
+    def finished_statuses(cls) -> List["JobStatus"]:
+        return [cls.TERMINATED, cls.ABORTED, cls.FAILED, cls.DONE]
+
+    def is_finished(self) -> bool:
+        return self in self.finished_statuses()
+
+
+class JobTerminationReason(str, Enum):
+    # set by the server
+    FAILED_TO_START_DUE_TO_NO_CAPACITY = "failed_to_start_due_to_no_capacity"
+    INTERRUPTED_BY_NO_CAPACITY = "interrupted_by_no_capacity"
+    INSTANCE_UNREACHABLE = "instance_unreachable"
+    WAITING_INSTANCE_LIMIT_EXCEEDED = "waiting_instance_limit_exceeded"
+    TERMINATED_BY_USER = "terminated_by_user"
+    VOLUME_ERROR = "volume_error"
+    GATEWAY_ERROR = "gateway_error"
+    SCALED_DOWN = "scaled_down"
+    DONE_BY_RUNNER = "done_by_runner"
+    ABORTED_BY_USER = "aborted_by_user"
+    TERMINATED_BY_SERVER = "terminated_by_server"
+    INACTIVITY_DURATION_EXCEEDED = "inactivity_duration_exceeded"
+    TERMINATED_DUE_TO_UTILIZATION_POLICY = "terminated_due_to_utilization_policy"
+    # set by the runner
+    CONTAINER_EXITED_WITH_ERROR = "container_exited_with_error"
+    PORTS_BINDING_FAILED = "ports_binding_failed"
+    CREATING_CONTAINER_ERROR = "creating_container_error"
+    EXECUTOR_ERROR = "executor_error"
+    MAX_DURATION_EXCEEDED = "max_duration_exceeded"
+
+    def to_status(self) -> JobStatus:
+        failed = {
+            self.FAILED_TO_START_DUE_TO_NO_CAPACITY,
+            self.INTERRUPTED_BY_NO_CAPACITY,
+            self.INSTANCE_UNREACHABLE,
+            self.WAITING_INSTANCE_LIMIT_EXCEEDED,
+            self.VOLUME_ERROR,
+            self.GATEWAY_ERROR,
+            self.CONTAINER_EXITED_WITH_ERROR,
+            self.PORTS_BINDING_FAILED,
+            self.CREATING_CONTAINER_ERROR,
+            self.EXECUTOR_ERROR,
+        }
+        terminated = {
+            self.TERMINATED_BY_USER,
+            self.SCALED_DOWN,
+            self.TERMINATED_BY_SERVER,
+            self.INACTIVITY_DURATION_EXCEEDED,
+            self.TERMINATED_DUE_TO_UTILIZATION_POLICY,
+            self.MAX_DURATION_EXCEEDED,
+        }
+        if self in failed:
+            return JobStatus.FAILED
+        if self in terminated:
+            return JobStatus.TERMINATED
+        if self == self.ABORTED_BY_USER:
+            return JobStatus.ABORTED
+        return JobStatus.DONE
+
+
+class RunStatus(str, Enum):
+    PENDING = "pending"
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    RUNNING = "running"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    FAILED = "failed"
+    DONE = "done"
+
+    @classmethod
+    def finished_statuses(cls) -> List["RunStatus"]:
+        return [cls.TERMINATED, cls.FAILED, cls.DONE]
+
+    def is_finished(self) -> bool:
+        return self in self.finished_statuses()
+
+
+class RunTerminationReason(str, Enum):
+    ALL_JOBS_DONE = "all_jobs_done"
+    JOB_FAILED = "job_failed"
+    RETRY_LIMIT_EXCEEDED = "retry_limit_exceeded"
+    STOPPED_BY_USER = "stopped_by_user"
+    ABORTED_BY_USER = "aborted_by_user"
+    SERVER_ERROR = "server_error"
+
+    def to_status(self) -> RunStatus:
+        if self == self.ALL_JOBS_DONE:
+            return RunStatus.DONE
+        if self in (self.STOPPED_BY_USER, self.ABORTED_BY_USER):
+            return RunStatus.TERMINATED
+        return RunStatus.FAILED
+
+    def to_job_termination_reason(self) -> JobTerminationReason:
+        if self == self.ALL_JOBS_DONE:
+            return JobTerminationReason.DONE_BY_RUNNER
+        if self == self.STOPPED_BY_USER:
+            return JobTerminationReason.TERMINATED_BY_USER
+        if self == self.ABORTED_BY_USER:
+            return JobTerminationReason.ABORTED_BY_USER
+        return JobTerminationReason.TERMINATED_BY_SERVER
+
+
+class Requirements(CoreModel):
+    resources: ResourcesSpec
+    max_price: Optional[float] = None
+    spot: Optional[bool] = None
+    reservation: Optional[str] = None
+
+
+class RunSpec(CoreModel):
+    run_name: Optional[str] = None
+    repo_id: Optional[str] = None
+    repo_data: Optional[dict] = None
+    configuration_path: Optional[str] = None
+    configuration: AnyRunConfiguration
+    profile: Profile = Field(default_factory=Profile)
+    ssh_key_pub: Optional[str] = None
+
+    def merged_profile(self) -> Profile:
+        from dstack_tpu.core.models.profiles import merge_profiles
+
+        return merge_profiles(self.profile, self.configuration.inline_profile())
+
+
+class JobSpec(CoreModel):
+    replica_num: int = 0
+    job_num: int = 0
+    job_name: str
+    jobs_per_replica: int = 1
+    commands: List[str] = Field(default_factory=list)
+    env: Dict[str, str] = Field(default_factory=dict)
+    image_name: str
+    privileged: bool = False
+    user: Optional[str] = None
+    home_dir: Optional[str] = None
+    working_dir: Optional[str] = None
+    repo_dir: Optional[str] = None
+    max_duration: Optional[int] = None
+    stop_duration: Optional[int] = None
+    utilization_policy: Optional[UtilizationPolicy] = None
+    retry: Optional[RetryPolicy] = None
+    requirements: Requirements
+    app_ports: List[int] = Field(default_factory=list)
+    service_port: Optional[int] = None
+
+
+class JobProvisioningData(CoreModel):
+    """Where a job landed: backend identity + connectivity for one slice worker."""
+
+    backend: str
+    instance_type: InstanceType
+    instance_id: str
+    hostname: Optional[str] = None
+    internal_ip: Optional[str] = None
+    region: str = ""
+    availability_zone: Optional[str] = None
+    price: float = 0.0
+    username: str = "root"
+    ssh_port: int = 22
+    ssh_proxy: Optional[SSHConnectionParams] = None
+    dockerized: bool = True
+    backend_data: Optional[str] = None
+    # TPU slice identity
+    slice_id: Optional[str] = None
+    slice_name: Optional[str] = None
+    worker_num: int = 0
+    hosts_per_slice: int = 1
+
+
+class ClusterInfo(CoreModel):
+    """The TPU cluster contract injected into every job's environment.
+
+    Replaces the reference's MPI hostfile + NCCL bootstrap (executor.go:262-274,707):
+    JAX coordinator + per-worker identity + MegaScale DCN variables for multislice.
+    """
+
+    master_node_ip: str = ""
+    node_ips: List[str] = Field(default_factory=list)
+    nodes_num: int = 1
+    node_rank: int = 0
+    # Slice-local contract
+    tpu_worker_id: int = 0
+    tpu_worker_hostnames: List[str] = Field(default_factory=list)
+    tpu_topology: Optional[str] = None
+    tpu_generation: Optional[str] = None
+    chips_per_host: int = 0
+    # Cross-slice (multislice) contract
+    num_slices: int = 1
+    slice_id: int = 0
+    coordinator_address: Optional[str] = None  # jax.distributed.initialize
+    megascale_coordinator_address: Optional[str] = None
+
+    def to_env(self) -> Dict[str, str]:
+        env = {
+            "DSTACK_NODE_RANK": str(self.node_rank),
+            "DSTACK_NODES_NUM": str(self.nodes_num),
+            "DSTACK_MASTER_NODE_IP": self.master_node_ip,
+            "DSTACK_NODES_IPS": "\n".join(self.node_ips),
+            "TPU_WORKER_ID": str(self.tpu_worker_id),
+            "TPU_WORKER_HOSTNAMES": ",".join(self.tpu_worker_hostnames),
+        }
+        if self.chips_per_host:
+            env["DSTACK_TPU_CHIPS_PER_HOST"] = str(self.chips_per_host)
+        if self.tpu_topology:
+            env["TPU_TOPOLOGY"] = self.tpu_topology
+        if self.tpu_generation:
+            env["DSTACK_TPU_GENERATION"] = self.tpu_generation
+        if self.coordinator_address:
+            env["DSTACK_JAX_COORDINATOR"] = self.coordinator_address
+        if self.num_slices > 1:
+            env["MEGASCALE_NUM_SLICES"] = str(self.num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(self.slice_id)
+            if self.megascale_coordinator_address:
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = self.megascale_coordinator_address
+        return env
+
+
+class JobSubmission(CoreModel):
+    id: uuid.UUID
+    submission_num: int = 0
+    submitted_at: datetime.datetime
+    last_processed_at: Optional[datetime.datetime] = None
+    finished_at: Optional[datetime.datetime] = None
+    status: JobStatus
+    termination_reason: Optional[JobTerminationReason] = None
+    termination_reason_message: Optional[str] = None
+    exit_status: Optional[int] = None
+    job_provisioning_data: Optional[JobProvisioningData] = None
+    inactivity_secs: Optional[int] = None
+
+    @property
+    def age(self) -> datetime.timedelta:
+        return datetime.datetime.now(datetime.timezone.utc) - self.submitted_at
+
+
+class Job(CoreModel):
+    job_spec: JobSpec
+    job_submissions: List[JobSubmission] = Field(default_factory=list)
+
+    @property
+    def latest(self) -> Optional[JobSubmission]:
+        return self.job_submissions[-1] if self.job_submissions else None
+
+
+class Run(CoreModel):
+    id: uuid.UUID
+    project_name: str
+    user: str
+    submitted_at: datetime.datetime
+    last_processed_at: Optional[datetime.datetime] = None
+    status: RunStatus
+    status_message: Optional[str] = None
+    termination_reason: Optional[RunTerminationReason] = None
+    run_spec: RunSpec
+    jobs: List[Job] = Field(default_factory=list)
+    cost: float = 0.0
+    service: Optional[ServiceSpec] = None
+    error: Optional[str] = None
+
+    @property
+    def run_name(self) -> str:
+        return self.run_spec.run_name or ""
+
+
+class RunPlan(CoreModel):
+    project_name: str
+    user: str
+    run_spec: RunSpec
+    effective_run_name: Optional[str] = None
+    job_plans: List[JobSpec] = Field(default_factory=list)
+    offers: List[dict] = Field(default_factory=list)
+    total_offers: int = 0
+    max_offer_price: Optional[float] = None
+    current_resource: Optional[Run] = None
+    action: str = "create"
+
+
+class ApplyRunPlanInput(CoreModel):
+    run_spec: RunSpec
+    force: bool = False

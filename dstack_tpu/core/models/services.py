@@ -1,0 +1,58 @@
+"""Service-specific config: autoscaling, model registry entry, rate limits.
+
+Parity: /root/reference core/models/configurations.py ScalingSpec:71, RateLimit:112,
+core/models/services.py (OpenAI-compatible model mapping).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from dstack_tpu.core.models.common import ConfigModel, CoreModel, Duration
+
+
+class ScalingMetric(str, Enum):
+    RPS = "rps"
+
+
+class ScalingSpec(ConfigModel):
+    metric: ScalingMetric = ScalingMetric.RPS
+    target: float = Field(gt=0)
+    scale_up_delay: Duration = 300
+    scale_down_delay: Duration = 600
+
+
+class RateLimit(ConfigModel):
+    prefix: str = "/"
+    rps: float = Field(gt=0)
+    burst: int = Field(default=1, ge=1)
+
+
+class ModelFormat(str, Enum):
+    OPENAI = "openai"
+
+
+class ModelSpec(ConfigModel):
+    """Registers the service in the OpenAI-compatible model gateway under `name`."""
+
+    name: str
+    format: ModelFormat = ModelFormat.OPENAI
+    prefix: str = "/v1"
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v):
+        if isinstance(v, str):
+            return {"name": v}
+        return v
+
+
+class ServiceSpec(CoreModel):
+    """Wire model describing how to reach a deployed service."""
+
+    url: str
+    model: Optional[ModelSpec] = None
+    options: dict = {}

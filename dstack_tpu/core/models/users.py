@@ -1,0 +1,50 @@
+"""User/project wire models (parity: reference core/models/{users,projects}.py)."""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from enum import Enum
+from typing import List, Optional
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class GlobalRole(str, Enum):
+    ADMIN = "admin"
+    USER = "user"
+
+
+class ProjectRole(str, Enum):
+    ADMIN = "admin"
+    MANAGER = "manager"
+    USER = "user"
+
+
+class User(CoreModel):
+    id: uuid.UUID
+    username: str
+    global_role: GlobalRole = GlobalRole.USER
+    email: Optional[str] = None
+    active: bool = True
+    created_at: Optional[datetime.datetime] = None
+
+
+class UserWithCreds(User):
+    creds: Optional[dict] = None  # {"token": "..."}
+
+
+class Member(CoreModel):
+    user: User
+    project_role: ProjectRole
+
+
+class Project(CoreModel):
+    id: uuid.UUID
+    project_name: str
+    owner: User
+    created_at: Optional[datetime.datetime] = None
+    members: List[Member] = Field(default_factory=list)
+    is_public: bool = False

@@ -1,0 +1,58 @@
+"""Volume wire models (parity: reference core/models/volumes.py). TPU data disks attach
+to every host of a slice (reference gcp/compute.py:1003-1016)."""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from enum import Enum
+from typing import List, Optional
+
+from pydantic import Field
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+
+
+class VolumeStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    ACTIVE = "active"
+    FAILED = "failed"
+
+    def is_active(self) -> bool:
+        return self != VolumeStatus.FAILED
+
+
+class VolumeProvisioningData(CoreModel):
+    backend: Optional[str] = None
+    volume_id: str
+    size_gb: float = 0
+    availability_zone: Optional[str] = None
+    price: Optional[float] = None
+    attachable: bool = True
+    detachable: bool = True
+    backend_data: Optional[str] = None
+
+
+class VolumeAttachment(CoreModel):
+    instance_id: uuid.UUID
+    instance_name: Optional[str] = None
+    device_name: Optional[str] = None
+
+
+class Volume(CoreModel):
+    id: uuid.UUID
+    name: str
+    project_name: str
+    user: Optional[str] = None
+    configuration: VolumeConfiguration
+    external: bool = False
+    created_at: datetime.datetime
+    last_job_processed_at: Optional[datetime.datetime] = None
+    status: VolumeStatus
+    status_message: Optional[str] = None
+    deleted: bool = False
+    volume_id: Optional[str] = None
+    provisioning_data: Optional[VolumeProvisioningData] = None
+    attachments: List[VolumeAttachment] = Field(default_factory=list)

@@ -1,0 +1,28 @@
+"""Test configuration.
+
+JAX-facing tests run on a virtual 8-device CPU mesh so multi-host sharding logic is
+exercised without TPU hardware (mirrors the reference's fake-Compute strategy,
+/root/reference SURVEY §4: real scheduler loops + mocked clouds).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSTACK_TPU_TEST", "1")
+
+import asyncio
+import inspect
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests with asyncio.run (pytest-asyncio is not available here)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,317 @@
+"""Core model DSL tests (parity with reference test coverage of resources/configurations:
+/root/reference src/tests/_internal/core/models — re-targeted at the TPU slice DSL)."""
+
+import pytest
+
+from dstack_tpu.core.errors import ConfigurationError
+from dstack_tpu.core.models.common import (
+    MemoryRange,
+    Range,
+    format_duration,
+    parse_duration,
+    parse_memory,
+)
+from dstack_tpu.core.models.configurations import (
+    DevEnvironmentConfiguration,
+    FleetConfiguration,
+    ServiceConfiguration,
+    TaskConfiguration,
+    parse_configuration,
+)
+from dstack_tpu.core.models.envs import Env
+from dstack_tpu.core.models.profiles import Profile, RetryPolicy, merge_profiles
+from dstack_tpu.core.models.resources import ResourcesSpec, TpuSliceSpec, default_topology
+from dstack_tpu.core.models.runs import (
+    ClusterInfo,
+    JobStatus,
+    JobTerminationReason,
+    RunStatus,
+    RunTerminationReason,
+)
+
+
+class TestScalars:
+    def test_duration(self):
+        assert parse_duration("90s") == 90
+        assert parse_duration("15m") == 900
+        assert parse_duration("2h") == 7200
+        assert parse_duration("1d") == 86400
+        assert parse_duration(42) == 42
+        assert parse_duration("off") is None
+        assert parse_duration(None) is None
+        with pytest.raises(ValueError):
+            parse_duration("2 fortnights")
+        assert format_duration(7200) == "2h"
+        assert format_duration(None) == "off"
+
+    def test_memory(self):
+        assert parse_memory("16GB") == 16.0
+        assert parse_memory("512MB") == 0.5
+        assert parse_memory("1TB") == 1024.0
+        assert parse_memory(8) == 8.0
+        with pytest.raises(ValueError):
+            parse_memory("lots")
+
+    def test_range(self):
+        r = Range[int].model_validate("4..8")
+        assert (r.min, r.max) == (4, 8)
+        assert Range[int].model_validate("4..").max is None
+        assert Range[int].model_validate("..8").min is None
+        assert Range[int].model_validate(4).max == 4
+        assert r.contains(5) and not r.contains(9)
+        assert r.intersects(Range[int].model_validate("8.."))
+        assert not r.intersects(Range[int].model_validate("9.."))
+        with pytest.raises(ValueError):
+            Range[int].model_validate("8..4")
+
+    def test_memory_range(self):
+        mr = MemoryRange.model_validate("16GB..64GB")
+        assert (mr.min, mr.max) == (16.0, 64.0)
+        assert MemoryRange.model_validate("8GB..").min == 8.0
+
+
+class TestTpuSliceSpec:
+    def test_v5e_names_count_chips(self):
+        s = TpuSliceSpec.model_validate("v5e-8")
+        assert s.generation == "v5e" and s.chips == 8 and s.hosts == 1
+        assert s.accelerator_type == "v5litepod-8"
+
+    def test_v5litepod_alias(self):
+        s = TpuSliceSpec.model_validate("v5litepod-16")
+        assert s.generation == "v5e" and s.chips == 16 and s.hosts == 2
+
+    def test_v5p_names_count_cores(self):
+        s = TpuSliceSpec.model_validate("v5p-16")
+        assert s.chips == 8 and s.hosts == 2  # 4 chips/host
+        assert s.slice_name == "v5p-16"
+
+    def test_v4(self):
+        s = TpuSliceSpec.model_validate("v4-32")
+        assert s.chips == 16 and s.hosts == 4
+
+    def test_v6e(self):
+        s = TpuSliceSpec.model_validate("v6e-256")
+        assert s.chips == 256 and s.hosts == 64
+
+    def test_dict_form(self):
+        s = TpuSliceSpec.model_validate({"generation": "v5p", "chips": 8, "count": 2})
+        assert s.hosts == 2 and s.count.min == 2
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            TpuSliceSpec.model_validate("v5p-13")
+        with pytest.raises(ValueError):
+            TpuSliceSpec.model_validate("h100-8")
+
+    def test_hbm_and_flops(self):
+        s = TpuSliceSpec.model_validate("v5p-16")
+        assert s.total_hbm_gb == 8 * 95
+        assert s.bf16_tflops == 8 * 459
+
+    def test_default_topology(self):
+        assert default_topology("v5e", 16) == "4x4"
+        assert default_topology("v5p", 8) == "2x2x2"
+
+    def test_default_topology_non_power_of_two(self):
+        dims = [int(d) for d in default_topology("v5p", 3072).split("x")]
+        assert dims[0] * dims[1] * dims[2] == 3072
+
+    def test_name_conflicts_with_fields(self):
+        with pytest.raises(ValueError):
+            TpuSliceSpec.model_validate({"name": "v5p-16", "generation": "v5e"})
+
+
+class TestResourcesSpec:
+    def test_defaults(self):
+        r = ResourcesSpec()
+        assert r.tpu is None and r.cpu.count.min == 2
+
+    def test_full(self):
+        r = ResourcesSpec.model_validate(
+            {"tpu": "v5p-16", "cpu": "8..", "memory": "32GB..", "disk": "200GB"}
+        )
+        assert r.tpu.chips == 8
+        assert r.cpu.count.min == 8
+        assert r.memory.min == 32.0
+        assert r.disk.size.min == 200.0
+
+
+class TestConfigurations:
+    def test_task(self):
+        c = parse_configuration(
+            {
+                "type": "task",
+                "commands": ["python train.py"],
+                "resources": {"tpu": "v5p-16"},
+                "env": {"LR": "1e-4"},
+            }
+        )
+        assert isinstance(c, TaskConfiguration)
+        assert c.resources.tpu.hosts == 2
+
+    def test_task_requires_commands(self):
+        with pytest.raises(ConfigurationError):
+            parse_configuration({"type": "task"})
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ConfigurationError):
+            parse_configuration({"type": "task", "commands": ["x"], "gpu": "A100"})
+
+    def test_service(self):
+        c = parse_configuration(
+            {
+                "type": "service",
+                "commands": ["python serve.py"],
+                "port": 8000,
+                "model": "llama-3-8b",
+                "replicas": "1..4",
+                "scaling": {"metric": "rps", "target": 10},
+            }
+        )
+        assert isinstance(c, ServiceConfiguration)
+        assert c.port.container_port == 8000
+        assert c.model.name == "llama-3-8b"
+        assert (c.replicas.min, c.replicas.max) == (1, 4)
+
+    def test_service_autoscaling_requires_scaling(self):
+        with pytest.raises(ConfigurationError):
+            parse_configuration(
+                {"type": "service", "commands": ["x"], "port": 80, "replicas": "1..4"}
+            )
+
+    def test_dev_env(self):
+        c = parse_configuration({"type": "dev-environment", "ide": "vscode"})
+        assert isinstance(c, DevEnvironmentConfiguration)
+
+    def test_fleet_cloud(self):
+        c = parse_configuration(
+            {"type": "fleet", "name": "tpu-fleet", "nodes": 2, "resources": {"tpu": "v5e-8"}}
+        )
+        assert isinstance(c, FleetConfiguration)
+        assert c.nodes.min == 2
+
+    def test_fleet_ssh(self):
+        c = parse_configuration(
+            {
+                "type": "fleet",
+                "name": "onprem",
+                "ssh_config": {"user": "ubuntu", "hosts": ["10.0.0.1", {"hostname": "10.0.0.2"}]},
+            }
+        )
+        assert c.ssh_config.hosts[1].hostname == "10.0.0.2"
+
+    def test_volume(self):
+        c = parse_configuration({"type": "volume", "region": "us-central2", "size": "100GB"})
+        assert c.size == 100.0
+
+    def test_gateway(self):
+        c = parse_configuration({"type": "gateway", "region": "us-central1", "domain": "x.example"})
+        assert c.public_ip is True
+
+    def test_ports(self):
+        c = parse_configuration({"type": "task", "commands": ["x"], "ports": ["8080", 3000, "127:80"]})
+        assert [p.container_port for p in c.ports] == [8080, 3000, 80]
+
+    def test_mounts(self):
+        c = parse_configuration(
+            {"type": "task", "commands": ["x"], "volumes": ["data:/data", "/mnt/disk:/scratch"]}
+        )
+        assert c.volumes[0].name == "data"
+        assert c.volumes[1].instance_path == "/mnt/disk"
+
+
+class TestEnv:
+    def test_dict(self):
+        e = Env.model_validate({"A": "1", "B": 2})
+        assert e.as_dict() == {"A": "1", "B": "2"}
+
+    def test_list(self):
+        e = Env.model_validate(["A=1", "HOME_TOKEN"])
+        assert e.values == {"A": "1", "HOME_TOKEN": None}
+        with pytest.raises(ValueError):
+            e.as_dict()
+
+
+class TestProfiles:
+    def test_merge(self):
+        base = Profile(spot_policy="spot", max_price=10.0)
+        over = Profile(max_price=5.0)
+        merged = merge_profiles(base, over)
+        assert merged.max_price == 5.0
+        assert merged.spot_policy.value == "spot"
+
+    def test_retry_parse(self):
+        assert RetryPolicy.model_validate(True).duration == 3600
+        assert RetryPolicy.model_validate("2h").duration == 7200
+        r = RetryPolicy.model_validate({"on_events": ["no-capacity"], "duration": "1d"})
+        assert r.duration == 86400
+
+    def test_retry_false_disables(self):
+        assert Profile(retry=False).retry is None
+        assert Profile.model_validate({"retry": False}).retry is None
+
+    def test_explicit_off_overrides_base(self):
+        # A config-level `idle_duration: off` must beat a profile's 1h, not be dropped.
+        base = Profile.model_validate({"idle_duration": "1h"})
+        cfg = parse_configuration({"type": "task", "commands": ["x"], "idle_duration": "off"})
+        merged = merge_profiles(base, cfg.inline_profile())
+        assert merged.idle_duration is None
+        assert "idle_duration" in merged.model_fields_set
+
+    def test_unset_config_default_does_not_override_profile(self):
+        base = Profile.model_validate({"stop_duration": 600})
+        cfg = parse_configuration({"type": "task", "commands": ["x"]})
+        merged = merge_profiles(base, cfg.inline_profile())
+        assert merged.stop_duration == 600
+
+
+class TestStateMachines:
+    def test_job_termination_to_status(self):
+        assert JobTerminationReason.DONE_BY_RUNNER.to_status() == JobStatus.DONE
+        assert JobTerminationReason.CONTAINER_EXITED_WITH_ERROR.to_status() == JobStatus.FAILED
+        assert JobTerminationReason.TERMINATED_BY_USER.to_status() == JobStatus.TERMINATED
+        assert JobTerminationReason.ABORTED_BY_USER.to_status() == JobStatus.ABORTED
+        assert JobTerminationReason.MAX_DURATION_EXCEEDED.to_status() == JobStatus.TERMINATED
+
+    def test_run_termination(self):
+        assert RunTerminationReason.ALL_JOBS_DONE.to_status() == RunStatus.DONE
+        assert RunTerminationReason.JOB_FAILED.to_status() == RunStatus.FAILED
+        assert RunTerminationReason.STOPPED_BY_USER.to_status() == RunStatus.TERMINATED
+
+    def test_finished(self):
+        assert JobStatus.DONE.is_finished()
+        assert not JobStatus.RUNNING.is_finished()
+        assert RunStatus.FAILED.is_finished()
+
+
+class TestClusterInfo:
+    def test_single_slice_env(self):
+        ci = ClusterInfo(
+            master_node_ip="10.0.0.1",
+            node_ips=["10.0.0.1", "10.0.0.2"],
+            nodes_num=2,
+            node_rank=1,
+            tpu_worker_id=1,
+            tpu_worker_hostnames=["w0", "w1"],
+            tpu_topology="2x2x2",
+            tpu_generation="v5p",
+            chips_per_host=4,
+            coordinator_address="10.0.0.1:8476",
+        )
+        env = ci.to_env()
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_TOPOLOGY"] == "2x2x2"
+        assert env["DSTACK_JAX_COORDINATOR"] == "10.0.0.1:8476"
+        assert "MEGASCALE_NUM_SLICES" not in env
+
+    def test_multislice_env(self):
+        ci = ClusterInfo(
+            nodes_num=4,
+            num_slices=2,
+            slice_id=1,
+            megascale_coordinator_address="10.0.0.1:8080",
+        )
+        env = ci.to_env()
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "10.0.0.1:8080"
