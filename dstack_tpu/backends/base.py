@@ -1,0 +1,69 @@
+"""Backend Compute ABC.
+
+Parity: reference core/backends/base/compute.py:52-367 (Compute ABC + capability
+mixins). TPU twist: `create_slice` provisions an entire pod slice atomically (N hosts =
+one cloud resource) and returns per-worker provisioning data — the reference's
+create_instance assumes 1 VM = 1 instance."""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional
+
+from dstack_tpu.core.models.instances import InstanceOffer
+from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.core.models.volumes import Volume, VolumeProvisioningData
+
+
+class Compute(abc.ABC):
+    """One instance per configured backend per project."""
+
+    TYPE: str = ""
+
+    @abc.abstractmethod
+    async def get_offers(self, requirements: Requirements, regions: Optional[List[str]] = None) -> List[InstanceOffer]:
+        ...
+
+    @abc.abstractmethod
+    async def create_slice(
+        self,
+        offer: InstanceOffer,
+        instance_name: str,
+        ssh_public_key: str = "",
+        startup_script: Optional[str] = None,
+    ) -> List[JobProvisioningData]:
+        """Provision the slice behind `offer`; one JobProvisioningData per worker host."""
+
+    @abc.abstractmethod
+    async def terminate_slice(self, slice_id: str, region: str, backend_data: Optional[str] = None) -> None:
+        ...
+
+    async def update_provisioning_data(self, jpd: JobProvisioningData) -> JobProvisioningData:
+        """Poll the cloud until hostname/IP are known; default: already known."""
+        return jpd
+
+
+class ComputeWithVolumeSupport(abc.ABC):
+    async def create_volume(self, volume: Volume) -> VolumeProvisioningData:
+        raise NotImplementedError
+
+    async def register_volume(self, volume: Volume) -> VolumeProvisioningData:
+        raise NotImplementedError
+
+    async def delete_volume(self, volume: Volume) -> None:
+        raise NotImplementedError
+
+    async def attach_volume(self, volume: Volume, provisioning_data: JobProvisioningData) -> str:
+        """Returns the device name on the host."""
+        raise NotImplementedError
+
+    async def detach_volume(self, volume: Volume, provisioning_data: JobProvisioningData) -> None:
+        raise NotImplementedError
+
+
+class ComputeWithGatewaySupport(abc.ABC):
+    async def create_gateway(self, configuration) -> "object":
+        raise NotImplementedError
+
+    async def terminate_gateway(self, instance_id: str, region: str) -> None:
+        raise NotImplementedError

@@ -1,0 +1,124 @@
+"""TPU pod-slice offer catalog: generation x chip-count x region -> priced offer.
+
+Parity: the reference's external `gpuhunt` catalog + adapter (base/offers.py:26-190,
+KNOWN_TPUS); here the catalog is built in, TPU-only, and slice-topology-aware (the
+reference prices single VMs; a TPU offer prices a whole slice and knows its host count).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dstack_tpu.core.models.instances import (
+    HostResources,
+    InstanceAvailability,
+    InstanceOffer,
+    InstanceType,
+    TpuResources,
+)
+from dstack_tpu.core.models.resources import (
+    TPU_GENERATIONS,
+    TpuSliceSpec,
+    default_topology,
+)
+from dstack_tpu.core.models.runs import Requirements
+
+# $/chip/hour on-demand (public GCP list prices, us-central region family).
+ON_DEMAND_PRICE_PER_CHIP: Dict[str, float] = {
+    "v4": 3.22,
+    "v5e": 1.20,
+    "v5p": 4.20,
+    "v6e": 2.70,
+}
+SPOT_DISCOUNT = 0.6  # spot ~40% of on-demand
+
+# Host VM shape paired with each generation's TPU VM (vCPUs, RAM GB per host).
+HOST_SHAPES: Dict[str, tuple] = {
+    "v4": (240, 400.0),
+    "v5e": (224, 384.0),
+    "v5p": (208, 448.0),
+    "v6e": (180, 720.0),
+}
+
+REGIONS: Dict[str, List[str]] = {
+    "v4": ["us-central2"],
+    "v5e": ["us-central1", "us-west4", "europe-west4", "asia-southeast1"],
+    "v5p": ["us-central1", "us-east5", "europe-west4"],
+    "v6e": ["us-central2", "us-east1", "europe-west4", "asia-northeast1"],
+}
+
+
+def slice_offer(
+    generation: str,
+    chips: int,
+    region: str,
+    spot: bool,
+    backend: str = "gcp",
+) -> InstanceOffer:
+    gen = TPU_GENERATIONS[generation]
+    spec = TpuSliceSpec(generation=generation, chips=chips)
+    cpus, mem = HOST_SHAPES[generation]
+    # Sub-host slices get a proportional share of the host VM.
+    frac = min(1.0, chips / gen.chips_per_host)
+    price = chips * ON_DEMAND_PRICE_PER_CHIP[generation] * (SPOT_DISCOUNT if spot else 1.0)
+    topology = default_topology(generation, chips)
+    return InstanceOffer(
+        backend=backend,
+        instance=InstanceType(
+            name=spec.accelerator_type,
+            resources=HostResources(
+                cpus=int(cpus * frac),
+                memory_gb=mem * frac,
+                disk_gb=100.0,
+                spot=spot,
+                tpu=TpuResources.from_slice(spec, topology=topology),
+            ),
+        ),
+        region=region,
+        price=round(price, 4),
+        availability=InstanceAvailability.AVAILABLE,
+        slice_name=spec.slice_name,
+        hosts_per_slice=spec.hosts,
+        spot=spot,
+    )
+
+
+def get_catalog_offers(
+    backend: str = "gcp",
+    regions: Optional[List[str]] = None,
+    requirements: Optional[Requirements] = None,
+) -> List[InstanceOffer]:
+    offers: List[InstanceOffer] = []
+    for gen_name, gen in TPU_GENERATIONS.items():
+        for chips in gen.valid_chip_counts:
+            for region in REGIONS[gen_name]:
+                if regions and region not in regions:
+                    continue
+                for spot in (False, True):
+                    offers.append(slice_offer(gen_name, chips, region, spot, backend=backend))
+    if requirements is not None:
+        offers = [o for o in offers if offer_matches(o, requirements)]
+    return sorted(offers, key=lambda o: o.price)
+
+
+def offer_matches(offer: InstanceOffer, req: Requirements) -> bool:
+    res = req.resources
+    host = offer.instance.resources
+    if res.tpu is not None:
+        tpu = host.tpu
+        if tpu is None or tpu.generation != res.tpu.generation or tpu.chips != res.tpu.chips:
+            return False
+    elif host.tpu is not None and host.tpu.chips > 0:
+        # CPU-only request should not pay for a slice.
+        return False
+    if res.cpu.count.min is not None and host.cpus < res.cpu.count.min:
+        return False
+    if res.memory.min is not None and host.memory_gb < res.memory.min:
+        return False
+    if res.disk is not None and res.disk.size.min is not None and host.disk_gb < res.disk.size.min:
+        return False
+    if req.spot is not None and offer.spot != req.spot:
+        return False
+    if req.max_price is not None and offer.price > req.max_price:
+        return False
+    return True

@@ -1,0 +1,70 @@
+"""Local backend: runs jobs as processes on the server host, shim-less.
+
+Parity: reference backends/local (local/compute.py:26-116, LOCAL_BACKEND_ENABLED
+settings.py:98) — the dev/test backend exercising the full scheduler path with zero
+cloud dependencies. Offers a CPU-only "instance" plus a simulated TPU slice shape so
+slice gang-scheduling is testable locally."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from dstack_tpu.backends.base import Compute
+from dstack_tpu.core.models.instances import (
+    HostResources,
+    InstanceAvailability,
+    InstanceOffer,
+    InstanceType,
+)
+from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+
+
+class LocalCompute(Compute):
+    TYPE = "local"
+
+    async def get_offers(self, requirements: Requirements, regions: Optional[List[str]] = None) -> List[InstanceOffer]:
+        if requirements.resources.tpu is not None:
+            return []  # TPU requests must go to a TPU-capable backend
+        cpus = os.cpu_count() or 1
+        offer = InstanceOffer(
+            backend="local",
+            instance=InstanceType(
+                name="local",
+                resources=HostResources(cpus=cpus, memory_gb=64.0, disk_gb=500.0),
+            ),
+            region="local",
+            price=0.0,
+            availability=InstanceAvailability.AVAILABLE,
+        )
+        # Local host must still satisfy cpu/memory minimums loosely; don't over-filter dev runs.
+        return [offer]
+
+    async def create_slice(
+        self,
+        offer: InstanceOffer,
+        instance_name: str,
+        ssh_public_key: str = "",
+        startup_script: Optional[str] = None,
+    ) -> List[JobProvisioningData]:
+        return [
+            JobProvisioningData(
+                backend="local",
+                instance_type=offer.instance,
+                instance_id=f"local-{instance_name}",
+                hostname="127.0.0.1",
+                internal_ip="127.0.0.1",
+                region=offer.region,
+                price=0.0,
+                username="root",
+                ssh_port=0,
+                dockerized=False,
+                slice_id=f"local-{instance_name}",
+                slice_name=offer.slice_name,
+                worker_num=0,
+                hosts_per_slice=1,
+            )
+        ]
+
+    async def terminate_slice(self, slice_id: str, region: str, backend_data: Optional[str] = None) -> None:
+        return None

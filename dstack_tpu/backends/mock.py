@@ -1,0 +1,69 @@
+"""Mock TPU backend for tests: serves the real TPU catalog, 'provisions' instantly.
+
+Parity: reference testing ComputeMockSpec (server/testing/common.py:985) — but as a real
+Compute subclass so scheduler tests run the production code path (SURVEY §4: fake
+Compute + real loops)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from dstack_tpu.backends import catalog
+from dstack_tpu.backends.base import Compute
+from dstack_tpu.core.models.instances import InstanceOffer
+from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+
+_counter = itertools.count(1)
+
+
+class MockTpuCompute(Compute):
+    TYPE = "mock"
+
+    def __init__(self, fail_provision: bool = False, regions: Optional[List[str]] = None):
+        self.fail_provision = fail_provision
+        self.regions = regions
+        self.created: List[str] = []
+        self.terminated: List[str] = []
+
+    async def get_offers(self, requirements: Requirements, regions: Optional[List[str]] = None) -> List[InstanceOffer]:
+        return catalog.get_catalog_offers(
+            backend="mock", regions=regions or self.regions, requirements=requirements
+        )
+
+    async def create_slice(
+        self,
+        offer: InstanceOffer,
+        instance_name: str,
+        ssh_public_key: str = "",
+        startup_script: Optional[str] = None,
+    ) -> List[JobProvisioningData]:
+        if self.fail_provision:
+            from dstack_tpu.core.errors import NoCapacityError
+
+            raise NoCapacityError(f"mock: no capacity for {offer.instance.name}")
+        n = next(_counter)
+        slice_id = f"mock-slice-{n}"
+        self.created.append(slice_id)
+        return [
+            JobProvisioningData(
+                backend="mock",
+                instance_type=offer.instance,
+                instance_id=f"{slice_id}-w{w}",
+                hostname=f"10.130.0.{n % 250 + 1}" if w == 0 else f"10.130.{w}.{n % 250 + 1}",
+                internal_ip=f"10.130.{w}.{n % 250 + 1}",
+                region=offer.region,
+                price=offer.price,
+                username="root",
+                ssh_port=22,
+                dockerized=True,
+                slice_id=slice_id,
+                slice_name=offer.slice_name,
+                worker_num=w,
+                hosts_per_slice=offer.hosts_per_slice,
+            )
+            for w in range(offer.hosts_per_slice)
+        ]
+
+    async def terminate_slice(self, slice_id: str, region: str, backend_data: Optional[str] = None) -> None:
+        self.terminated.append(slice_id)

@@ -1,0 +1,92 @@
+"""Server application factory + lifespan.
+
+Parity: reference server/app.py (create_app:80, lifespan:96-162: migrate -> config ->
+admin -> default project -> background tasks) on aiohttp.web."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.core.models.users import ProjectRole
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.routers import backends as backends_router
+from dstack_tpu.server.routers import projects as projects_router
+from dstack_tpu.server.routers import runs as runs_router
+from dstack_tpu.server.routers import users as users_router
+from dstack_tpu.server.routers._common import error_middleware
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import users as users_service
+
+logger = logging.getLogger(__name__)
+
+
+async def _on_startup(app: web.Application) -> None:
+    db: Database = app["db"]
+    await db.connect()  # runs migrations
+    admin_row, created = await users_service.get_or_create_admin_user(
+        db, token=settings.ADMIN_TOKEN
+    )
+    app["admin_token"] = admin_row["token"]
+    if created:
+        logger.info("created admin user")
+    # default project
+    existing = await db.fetchone(
+        "SELECT id FROM projects WHERE name = ? AND deleted = 0",
+        (settings.DEFAULT_PROJECT_NAME,),
+    )
+    if existing is None:
+        await projects_service.create_project(db, admin_row, settings.DEFAULT_PROJECT_NAME)
+        logger.info("created default project %s", settings.DEFAULT_PROJECT_NAME)
+    if app["run_background_tasks"]:
+        from dstack_tpu.server.background import start_background_tasks
+
+        app["background"] = start_background_tasks(app)
+
+
+async def _on_cleanup(app: web.Application) -> None:
+    bg = app.get("background")
+    if bg is not None:
+        await bg.stop()
+    await app["db"].close()
+
+
+async def healthcheck(request: web.Request) -> web.Response:
+    import dstack_tpu
+
+    return web.json_response({"status": "ok", "version": dstack_tpu.__version__})
+
+
+def create_app(
+    db_path: Optional[str] = None,
+    run_background_tasks: bool = True,
+) -> web.Application:
+    app = web.Application(middlewares=[error_middleware], client_max_size=settings.MAX_CODE_SIZE + 1024**2)
+    app["db"] = Database(db_path if db_path is not None else settings.DB_PATH)
+    app["run_background_tasks"] = run_background_tasks
+    app.router.add_get("/healthcheck", healthcheck)
+    app.add_routes(users_router.routes)
+    app.add_routes(projects_router.routes)
+    app.add_routes(runs_router.routes)
+    app.add_routes(backends_router.routes)
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    return app
+
+
+def main(host: Optional[str] = None, port: Optional[int] = None) -> None:  # pragma: no cover
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    app = create_app()
+
+    async def _print_token(app_: web.Application) -> None:
+        logger.info("admin token: %s", app_["admin_token"])
+
+    app.on_startup.append(_print_token)
+    web.run_app(app, host=host or settings.SERVER_HOST, port=port or settings.SERVER_PORT)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

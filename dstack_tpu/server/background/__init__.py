@@ -1,0 +1,72 @@
+"""Background processing loops (parity: reference server/background/__init__.py:32-100
+APScheduler — re-built as plain asyncio tasks; no executor pools needed)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, List
+
+from aiohttp import web
+
+from dstack_tpu.server import settings
+
+logger = logging.getLogger(__name__)
+
+
+class BackgroundScheduler:
+    def __init__(self) -> None:
+        self._tasks: List[asyncio.Task] = []
+
+    def add_periodic(
+        self, fn: Callable[[], Awaitable[None]], interval: float, name: str
+    ) -> None:
+        async def loop() -> None:
+            while True:
+                try:
+                    await fn()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("background task %s failed", name)
+                await asyncio.sleep(interval)
+
+        self._tasks.append(asyncio.create_task(loop(), name=f"bg:{name}"))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+
+def start_background_tasks(app: web.Application) -> BackgroundScheduler:
+    """Registers the processing loops; intervals/batches per settings (BASELINE.md)."""
+    from dstack_tpu.server.background import tasks
+
+    db = app["db"]
+    sched = BackgroundScheduler()
+    sched.add_periodic(
+        lambda: tasks.process_runs(db), settings.PROCESS_RUNS_INTERVAL, "process_runs"
+    )
+    sched.add_periodic(
+        lambda: tasks.process_submitted_jobs(db),
+        settings.PROCESS_SUBMITTED_JOBS_INTERVAL,
+        "process_submitted_jobs",
+    )
+    sched.add_periodic(
+        lambda: tasks.process_running_jobs(db),
+        settings.PROCESS_RUNNING_JOBS_INTERVAL,
+        "process_running_jobs",
+    )
+    sched.add_periodic(
+        lambda: tasks.process_terminating_jobs(db),
+        settings.PROCESS_TERMINATING_JOBS_INTERVAL,
+        "process_terminating_jobs",
+    )
+    sched.add_periodic(
+        lambda: tasks.process_instances(db),
+        settings.PROCESS_INSTANCES_INTERVAL,
+        "process_instances",
+    )
+    return sched

@@ -1,0 +1,26 @@
+"""Background task entry points. Filled in by the scheduler milestone (M3); the
+placeholders keep the server bootable before then."""
+
+from __future__ import annotations
+
+from dstack_tpu.server.db import Database
+
+
+async def process_runs(db: Database) -> None:
+    return None
+
+
+async def process_submitted_jobs(db: Database) -> None:
+    return None
+
+
+async def process_running_jobs(db: Database) -> None:
+    return None
+
+
+async def process_terminating_jobs(db: Database) -> None:
+    return None
+
+
+async def process_instances(db: Database) -> None:
+    return None

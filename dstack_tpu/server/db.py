@@ -1,0 +1,138 @@
+"""Async-friendly sqlite persistence.
+
+Parity: reference server/db.py (async SQLAlchemy, WAL pragma db.py:35-40) — re-designed
+on stdlib sqlite3: one writer connection in WAL mode, all statements funneled through a
+single worker thread so the asyncio event loop never blocks and writes are serialized
+(sqlite's own model). Schema migrations are ordered DDL scripts tracked in a version
+table (alembic equivalent)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import threading
+import queue
+import uuid
+from pathlib import Path
+from typing import Any, Iterable, List, Optional
+
+from dstack_tpu.server import migrations
+
+
+class Database:
+    """All access goes through execute()/fetchall()/fetchone() coroutines.
+
+    A dedicated thread owns the sqlite3 connection; requests are queued, keeping the
+    event loop responsive under the write-heavy scheduler loops.
+    """
+
+    def __init__(self, path: str = ":memory:"):
+        self._path = path
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        if self._thread is not None:
+            return
+        if self._path != ":memory:":
+            Path(self._path).parent.mkdir(parents=True, exist_ok=True)
+        loop = asyncio.get_running_loop()
+        started: "asyncio.Future" = loop.create_future()
+        self._thread = threading.Thread(
+            target=self._worker, args=(loop, started), name="db-worker", daemon=True
+        )
+        self._thread.start()
+        await started
+
+    def _worker(self, loop: asyncio.AbstractEventLoop, started: "asyncio.Future") -> None:
+        try:
+            conn = sqlite3.connect(self._path)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA busy_timeout=10000")
+            conn.execute("PRAGMA foreign_keys=ON")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            migrations.migrate(conn)
+            loop.call_soon_threadsafe(started.set_result, None)
+        except Exception as e:  # pragma: no cover
+            loop.call_soon_threadsafe(started.set_exception, e)
+            return
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            fn, fut, fut_loop = item
+            try:
+                result = fn(conn)
+                conn.commit()
+            except Exception as e:
+                conn.rollback()
+                fut_loop.call_soon_threadsafe(_set_exc, fut, e)
+            else:
+                fut_loop.call_soon_threadsafe(_set_result, fut, result)
+        conn.close()
+
+    async def run(self, fn) -> Any:
+        """Run `fn(conn)` on the DB thread inside a transaction; return its result."""
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future" = loop.create_future()
+        self._queue.put((fn, fut, loop))
+        return await fut
+
+    async def execute(self, sql: str, params: Iterable = ()) -> int:
+        def _do(conn: sqlite3.Connection) -> int:
+            cur = conn.execute(sql, tuple(params))
+            return cur.rowcount
+
+        return await self.run(_do)
+
+    async def executemany(self, sql: str, rows: List[Iterable]) -> None:
+        def _do(conn: sqlite3.Connection) -> None:
+            conn.executemany(sql, [tuple(r) for r in rows])
+
+        await self.run(_do)
+
+    async def fetchall(self, sql: str, params: Iterable = ()) -> List[sqlite3.Row]:
+        def _do(conn: sqlite3.Connection):
+            return conn.execute(sql, tuple(params)).fetchall()
+
+        return await self.run(_do)
+
+    async def fetchone(self, sql: str, params: Iterable = ()) -> Optional[sqlite3.Row]:
+        def _do(conn: sqlite3.Connection):
+            return conn.execute(sql, tuple(params)).fetchone()
+
+        return await self.run(_do)
+
+    async def close(self) -> None:
+        if self._thread is not None and not self._closed:
+            self._closed = True
+            self._queue.put(None)
+            await asyncio.get_running_loop().run_in_executor(None, self._thread.join)
+            self._thread = None
+
+
+def _set_result(fut: "asyncio.Future", result: Any) -> None:
+    if not fut.cancelled():
+        fut.set_result(result)
+
+
+def _set_exc(fut: "asyncio.Future", e: Exception) -> None:
+    if not fut.cancelled():
+        fut.set_exception(e)
+
+
+def new_id() -> str:
+    return str(uuid.uuid4())
+
+
+def dumps(obj: Any) -> str:
+    return json.dumps(obj, separators=(",", ":"), default=str)
+
+
+def loads(s: Optional[str]) -> Any:
+    if s is None:
+        return None
+    return json.loads(s)

@@ -1,0 +1,226 @@
+"""Ordered schema migrations (alembic equivalent; parity: reference server/migrations/).
+
+Wire payloads (specs, provisioning data) are stored as JSON text next to indexed scalar
+columns — the same shape the reference uses for run_spec/job_spec columns."""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import List, Tuple
+
+MIGRATIONS: List[Tuple[int, str]] = [
+    (
+        1,
+        """
+        CREATE TABLE users (
+            id TEXT PRIMARY KEY,
+            username TEXT NOT NULL UNIQUE,
+            global_role TEXT NOT NULL DEFAULT 'user',
+            email TEXT,
+            token TEXT NOT NULL UNIQUE,
+            active INTEGER NOT NULL DEFAULT 1,
+            created_at TEXT NOT NULL
+        );
+        CREATE TABLE projects (
+            id TEXT PRIMARY KEY,
+            name TEXT NOT NULL,
+            owner_id TEXT NOT NULL REFERENCES users(id),
+            created_at TEXT NOT NULL,
+            deleted INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE UNIQUE INDEX ux_projects_live_name ON projects(name) WHERE deleted = 0;
+        CREATE TABLE members (
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            user_id TEXT NOT NULL REFERENCES users(id) ON DELETE CASCADE,
+            project_role TEXT NOT NULL DEFAULT 'user',
+            PRIMARY KEY (project_id, user_id)
+        );
+        CREATE TABLE backends (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            type TEXT NOT NULL,
+            config TEXT NOT NULL,
+            auth TEXT,
+            UNIQUE (project_id, type)
+        );
+        CREATE TABLE repos (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            name TEXT NOT NULL,
+            type TEXT NOT NULL DEFAULT 'local',
+            info TEXT,
+            creds TEXT,
+            UNIQUE (project_id, name)
+        );
+        CREATE TABLE codes (
+            id TEXT PRIMARY KEY,
+            repo_id TEXT NOT NULL REFERENCES repos(id) ON DELETE CASCADE,
+            blob_hash TEXT NOT NULL,
+            blob BLOB,
+            UNIQUE (repo_id, blob_hash)
+        );
+        CREATE TABLE fleets (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            name TEXT NOT NULL,
+            status TEXT NOT NULL DEFAULT 'active',
+            status_message TEXT,
+            spec TEXT NOT NULL,
+            created_at TEXT NOT NULL,
+            last_processed_at TEXT,
+            auto_created INTEGER NOT NULL DEFAULT 0,
+            deleted INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE INDEX ix_fleets_project ON fleets(project_id, deleted);
+        CREATE TABLE instances (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            fleet_id TEXT REFERENCES fleets(id),
+            name TEXT NOT NULL,
+            instance_num INTEGER NOT NULL DEFAULT 0,
+            status TEXT NOT NULL DEFAULT 'pending',
+            unreachable INTEGER NOT NULL DEFAULT 0,
+            termination_reason TEXT,
+            created_at TEXT NOT NULL,
+            started_at TEXT,
+            finished_at TEXT,
+            last_processed_at TEXT,
+            backend TEXT,
+            region TEXT,
+            availability_zone TEXT,
+            price REAL,
+            instance_type TEXT,
+            offer TEXT,
+            job_provisioning_data TEXT,
+            remote_connection_info TEXT,
+            profile TEXT,
+            requirements TEXT,
+            slice_id TEXT,
+            slice_name TEXT,
+            worker_num INTEGER NOT NULL DEFAULT 0,
+            hosts_per_slice INTEGER NOT NULL DEFAULT 1,
+            total_blocks INTEGER NOT NULL DEFAULT 1,
+            busy_blocks INTEGER NOT NULL DEFAULT 0,
+            idle_since TEXT,
+            idle_duration INTEGER,
+            termination_deadline TEXT,
+            health TEXT,
+            deleted INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE INDEX ix_instances_project ON instances(project_id, deleted, status);
+        CREATE INDEX ix_instances_slice ON instances(slice_id);
+        CREATE TABLE runs (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            user_id TEXT NOT NULL REFERENCES users(id),
+            repo_id TEXT,
+            fleet_id TEXT,
+            run_name TEXT NOT NULL,
+            submitted_at TEXT NOT NULL,
+            last_processed_at TEXT,
+            status TEXT NOT NULL DEFAULT 'submitted',
+            termination_reason TEXT,
+            status_message TEXT,
+            run_spec TEXT NOT NULL,
+            service_spec TEXT,
+            desired_replica_count INTEGER NOT NULL DEFAULT 1,
+            next_triggered_at TEXT,
+            deleted INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE UNIQUE INDEX ux_runs_live_name ON runs(project_id, run_name) WHERE deleted = 0;
+        CREATE INDEX ix_runs_status ON runs(status) WHERE deleted = 0;
+        CREATE TABLE jobs (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            run_id TEXT NOT NULL REFERENCES runs(id) ON DELETE CASCADE,
+            run_name TEXT NOT NULL,
+            job_num INTEGER NOT NULL DEFAULT 0,
+            replica_num INTEGER NOT NULL DEFAULT 0,
+            submission_num INTEGER NOT NULL DEFAULT 0,
+            job_spec TEXT NOT NULL,
+            status TEXT NOT NULL DEFAULT 'submitted',
+            termination_reason TEXT,
+            termination_reason_message TEXT,
+            exit_status INTEGER,
+            submitted_at TEXT NOT NULL,
+            last_processed_at TEXT,
+            finished_at TEXT,
+            job_provisioning_data TEXT,
+            job_runtime_data TEXT,
+            instance_id TEXT REFERENCES instances(id),
+            used_instance_id TEXT,
+            disconnected_at TEXT,
+            inactivity_secs INTEGER,
+            remove_at TEXT
+        );
+        CREATE INDEX ix_jobs_run ON jobs(run_id);
+        CREATE INDEX ix_jobs_status ON jobs(status);
+        CREATE TABLE volumes (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            user_id TEXT,
+            name TEXT NOT NULL,
+            status TEXT NOT NULL DEFAULT 'submitted',
+            status_message TEXT,
+            configuration TEXT NOT NULL,
+            external INTEGER NOT NULL DEFAULT 0,
+            created_at TEXT NOT NULL,
+            last_processed_at TEXT,
+            last_job_processed_at TEXT,
+            provisioning_data TEXT,
+            volume_id TEXT,
+            deleted INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE UNIQUE INDEX ux_volumes_live_name ON volumes(project_id, name) WHERE deleted = 0;
+        CREATE TABLE volume_attachments (
+            volume_id TEXT NOT NULL REFERENCES volumes(id) ON DELETE CASCADE,
+            instance_id TEXT NOT NULL REFERENCES instances(id) ON DELETE CASCADE,
+            attachment_data TEXT,
+            PRIMARY KEY (volume_id, instance_id)
+        );
+        CREATE TABLE gateways (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            name TEXT NOT NULL,
+            status TEXT NOT NULL DEFAULT 'submitted',
+            status_message TEXT,
+            configuration TEXT NOT NULL,
+            created_at TEXT NOT NULL,
+            last_processed_at TEXT,
+            ip_address TEXT,
+            hostname TEXT,
+            provisioning_data TEXT,
+            is_default INTEGER NOT NULL DEFAULT 0,
+            deleted INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE UNIQUE INDEX ux_gateways_live_name ON gateways(project_id, name) WHERE deleted = 0;
+        CREATE TABLE job_metrics_points (
+            job_id TEXT NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+            timestamp TEXT NOT NULL,
+            cpu_usage_micro INTEGER NOT NULL DEFAULT 0,
+            memory_usage_bytes INTEGER NOT NULL DEFAULT 0,
+            memory_working_set_bytes INTEGER NOT NULL DEFAULT 0,
+            tpu TEXT
+        );
+        CREATE INDEX ix_job_metrics_points_job ON job_metrics_points(job_id, timestamp);
+        CREATE TABLE secrets (
+            id TEXT PRIMARY KEY,
+            project_id TEXT NOT NULL REFERENCES projects(id) ON DELETE CASCADE,
+            name TEXT NOT NULL,
+            value TEXT NOT NULL,
+            UNIQUE (project_id, name)
+        );
+        """,
+    ),
+]
+
+
+def migrate(conn: sqlite3.Connection) -> None:
+    conn.execute("CREATE TABLE IF NOT EXISTS schema_version (version INTEGER NOT NULL)")
+    row = conn.execute("SELECT MAX(version) AS v FROM schema_version").fetchone()
+    current = row["v"] if row and row["v"] is not None else 0
+    for version, script in MIGRATIONS:
+        if version > current:
+            conn.executescript(script)
+            conn.execute("INSERT INTO schema_version (version) VALUES (?)", (version,))
+    conn.commit()

@@ -1,0 +1,110 @@
+"""Router plumbing: auth/dependency helpers, pydantic<->JSON glue, error middleware.
+
+Parity: the FastAPI router/dependency layer of the reference (server/app.py:179-199) —
+re-built on aiohttp.web with explicit helpers instead of DI."""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Optional, Type, TypeVar
+
+import pydantic
+from aiohttp import web
+
+from dstack_tpu.core.errors import (
+    ForbiddenError,
+    NotAuthenticatedError,
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerClientError,
+)
+from dstack_tpu.server import security
+from dstack_tpu.server.services import projects as projects_service
+
+logger = logging.getLogger(__name__)
+
+M = TypeVar("M", bound=pydantic.BaseModel)
+
+_ERROR_STATUS = {
+    NotAuthenticatedError: 401,
+    ForbiddenError: 403,
+    ResourceNotExistsError: 404,
+    ResourceExistsError: 409,
+}
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except ServerClientError as e:
+        status = 400
+        for cls, code in _ERROR_STATUS.items():
+            if isinstance(e, cls):
+                status = code
+                break
+        return web.json_response(
+            {"detail": [{"msg": e.msg or str(e), "code": e.code}]}, status=status
+        )
+    except pydantic.ValidationError as e:
+        return web.json_response(
+            {"detail": [{"msg": str(e), "code": "validation_error"}]}, status=422
+        )
+    except Exception:
+        logger.exception("unhandled server error: %s %s", request.method, request.path)
+        return web.json_response(
+            {"detail": [{"msg": "internal server error", "code": "server_error"}]}, status=500
+        )
+
+
+async def parse_body(request: web.Request, model: Type[M]) -> M:
+    try:
+        raw = await request.read()
+        data = json.loads(raw) if raw else {}
+    except json.JSONDecodeError:
+        raise ServerClientError("invalid JSON body")
+    try:
+        return model.model_validate(data)
+    except pydantic.ValidationError as e:
+        raise ServerClientError(f"invalid request: {e}")
+
+
+async def body_dict(request: web.Request) -> dict:
+    try:
+        raw = await request.read()
+        return json.loads(raw) if raw else {}
+    except json.JSONDecodeError:
+        raise ServerClientError("invalid JSON body")
+
+
+def model_response(obj: Any, status: int = 200) -> web.Response:
+    if obj is None:
+        return web.json_response(None, status=status)
+    if isinstance(obj, pydantic.BaseModel):
+        return web.Response(
+            text=obj.model_dump_json(), status=status, content_type="application/json"
+        )
+    if isinstance(obj, list):
+        text = "[" + ",".join(
+            o.model_dump_json() if isinstance(o, pydantic.BaseModel) else json.dumps(o)
+            for o in obj
+        ) + "]"
+        return web.Response(text=text, status=status, content_type="application/json")
+    return web.json_response(obj, status=status)
+
+
+async def auth_user(request: web.Request):
+    return await security.authenticate(request)
+
+
+async def auth_project(request: web.Request, admin_only: bool = False):
+    """Authenticated user + project from the URL + membership check."""
+    user_row = await security.authenticate(request)
+    project_name = request.match_info["project_name"]
+    db = request.app["db"]
+    project_row = await projects_service.get_project_row(db, project_name)
+    await security.require_project_access(db, project_row, user_row, admin_only=admin_only)
+    return user_row, project_row

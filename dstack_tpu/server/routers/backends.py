@@ -1,0 +1,36 @@
+"""/api/project/{p}/backends/* (parity: reference server/routers/backends.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.backends import BackendConfig
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, parse_body
+from dstack_tpu.server.services import backends as backends_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/backends/create")
+async def create_backend(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request, admin_only=True)
+    config = await parse_body(request, BackendConfig)
+    await backends_service.create_backend(request.app["db"], project_row, config)
+    return model_response(config.masked())
+
+
+@routes.post("/api/project/{project_name}/backends/list")
+async def list_backends(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    configs = await backends_service.list_backends(request.app["db"], project_row)
+    return model_response([c.masked() for c in configs])
+
+
+@routes.post("/api/project/{project_name}/backends/delete")
+async def delete_backends(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request, admin_only=True)
+    body = await body_dict(request)
+    await backends_service.delete_backends(
+        request.app["db"], project_row, body["backends_names"]
+    )
+    return model_response(None)

@@ -1,0 +1,64 @@
+"""/api/projects/* (parity: reference server/routers/projects.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.errors import ForbiddenError
+from dstack_tpu.server.routers._common import (
+    auth_project,
+    auth_user,
+    body_dict,
+    model_response,
+)
+from dstack_tpu.server.security import is_global_admin
+from dstack_tpu.server.services import projects as projects_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/projects/list")
+async def list_projects(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    return model_response(await projects_service.list_user_projects(request.app["db"], user_row))
+
+
+@routes.post("/api/projects/create")
+async def create_project(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    body = await body_dict(request)
+    project = await projects_service.create_project(
+        request.app["db"], user_row, body["project_name"]
+    )
+    return model_response(project)
+
+
+@routes.post("/api/projects/delete")
+async def delete_projects(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    db = request.app["db"]
+    body = await body_dict(request)
+    for name in body["projects_names"]:
+        project_row = await projects_service.get_project_row(db, name)
+        if not is_global_admin(user_row) and project_row["owner_id"] != user_row["id"]:
+            raise ForbiddenError(f"not the owner of {name}")
+    await projects_service.delete_projects(db, body["projects_names"])
+    return model_response(None)
+
+
+@routes.post("/api/projects/{project_name}/get")
+async def get_project(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    return model_response(
+        await projects_service.get_project(request.app["db"], project_row["name"])
+    )
+
+
+@routes.post("/api/projects/{project_name}/set_members")
+async def set_members(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request, admin_only=True)
+    body = await body_dict(request)
+    project = await projects_service.set_members(
+        request.app["db"], project_row["name"], body["members"]
+    )
+    return model_response(project)

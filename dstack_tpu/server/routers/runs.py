@@ -1,0 +1,96 @@
+"""/api/project/{p}/runs/* + /api/runs/list (parity: reference server/routers/runs.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.runs import ApplyRunPlanInput, RunSpec
+from dstack_tpu.server.routers._common import (
+    auth_project,
+    auth_user,
+    body_dict,
+    model_response,
+    parse_body,
+)
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import runs as runs_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/runs/list")
+async def list_all_runs(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    db = request.app["db"]
+    if user_row["global_role"] == "admin":
+        rows = await db.fetchall("SELECT id FROM projects WHERE deleted = 0")
+    else:
+        rows = await db.fetchall(
+            "SELECT p.id FROM projects p JOIN members m ON m.project_id = p.id"
+            " WHERE m.user_id = ? AND p.deleted = 0",
+            (user_row["id"],),
+        )
+    runs = await runs_service.list_runs(db, project_ids=[r["id"] for r in rows])
+    runs.sort(key=lambda r: r.submitted_at, reverse=True)
+    return model_response(runs)
+
+
+@routes.post("/api/project/{project_name}/runs/get_plan")
+async def get_plan(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    body = await body_dict(request)
+    run_spec = RunSpec.model_validate(body["run_spec"])
+    plan = await runs_service.get_run_plan(request.app["db"], project_row, user_row, run_spec)
+    return model_response(plan)
+
+
+@routes.post("/api/project/{project_name}/runs/apply_plan")
+async def apply_plan(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    plan_input = await parse_body(request, ApplyRunPlanInput)
+    run = await runs_service.submit_run(
+        request.app["db"], project_row, user_row, plan_input.run_spec
+    )
+    return model_response(run)
+
+
+@routes.post("/api/project/{project_name}/runs/submit")
+async def submit(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    body = await body_dict(request)
+    run_spec = RunSpec.model_validate(body["run_spec"])
+    run = await runs_service.submit_run(request.app["db"], project_row, user_row, run_spec)
+    return model_response(run)
+
+
+@routes.post("/api/project/{project_name}/runs/list")
+async def list_runs(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    runs = await runs_service.list_runs(request.app["db"], project_id=project_row["id"])
+    return model_response(runs)
+
+
+@routes.post("/api/project/{project_name}/runs/get")
+async def get_run(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    run = await runs_service.get_run(request.app["db"], project_row, body["run_name"])
+    return model_response(run)
+
+
+@routes.post("/api/project/{project_name}/runs/stop")
+async def stop_runs(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    await runs_service.stop_runs(
+        request.app["db"], project_row, body["runs_names"], abort=body.get("abort_requested", False)
+    )
+    return model_response(None)
+
+
+@routes.post("/api/project/{project_name}/runs/delete")
+async def delete_runs(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    await runs_service.delete_runs(request.app["db"], project_row, body["runs_names"])
+    return model_response(None)

@@ -1,0 +1,84 @@
+"""/api/users/* (parity: reference server/routers/users.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.errors import ForbiddenError
+from dstack_tpu.core.models.users import GlobalRole
+from dstack_tpu.server.routers._common import auth_user, body_dict, model_response
+from dstack_tpu.server.security import is_global_admin
+from dstack_tpu.server.services import users as users_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/users/get_my_user")
+async def get_my_user(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    return model_response(users_service.row_to_user(user_row))
+
+
+@routes.post("/api/users/list")
+async def list_users(request: web.Request) -> web.Response:
+    await auth_user(request)
+    return model_response(await users_service.list_users(request.app["db"]))
+
+
+@routes.post("/api/users/create")
+async def create_user(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    if not is_global_admin(user_row):
+        raise ForbiddenError("admin required")
+    body = await body_dict(request)
+    user = await users_service.create_user(
+        request.app["db"],
+        username=body["username"],
+        global_role=GlobalRole(body.get("global_role", "user")),
+        email=body.get("email"),
+    )
+    return model_response(user)
+
+
+@routes.post("/api/users/update")
+async def update_user(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    if not is_global_admin(user_row):
+        raise ForbiddenError("admin required")
+    body = await body_dict(request)
+    user = await users_service.update_user(
+        request.app["db"],
+        username=body["username"],
+        global_role=GlobalRole(body["global_role"]) if "global_role" in body else None,
+        email=body.get("email"),
+    )
+    return model_response(user)
+
+
+@routes.post("/api/users/get_user")
+async def get_user(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    if not is_global_admin(user_row):
+        raise ForbiddenError("admin required")
+    body = await body_dict(request)
+    row = await users_service.get_user_by_name(request.app["db"], body["username"])
+    return model_response(users_service.row_to_user_with_creds(row))
+
+
+@routes.post("/api/users/refresh_token")
+async def refresh_token(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    body = await body_dict(request)
+    if not is_global_admin(user_row) and user_row["username"] != body["username"]:
+        raise ForbiddenError("can only refresh own token")
+    return model_response(await users_service.refresh_token(request.app["db"], body["username"]))
+
+
+@routes.post("/api/users/delete")
+async def delete_users(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    if not is_global_admin(user_row):
+        raise ForbiddenError("admin required")
+    body = await body_dict(request)
+    await users_service.delete_users(request.app["db"], body["users"])
+    return model_response(None)

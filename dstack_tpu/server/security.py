@@ -1,0 +1,58 @@
+"""Token auth + role checks (parity: reference server/security/)."""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.core.errors import ForbiddenError, NotAuthenticatedError
+from dstack_tpu.core.models.users import GlobalRole, ProjectRole
+
+
+def generate_token() -> str:
+    return secrets.token_hex(20)
+
+
+def get_request_token(request: web.Request) -> Optional[str]:
+    auth = request.headers.get("Authorization", "")
+    if auth.lower().startswith("bearer "):
+        return auth[7:].strip()
+    return None
+
+
+async def authenticate(request: web.Request):
+    """Resolve the bearer token to a user row; raise if missing/invalid."""
+    token = get_request_token(request)
+    if not token:
+        raise NotAuthenticatedError("missing token")
+    db = request.app["db"]
+    row = await db.fetchone("SELECT * FROM users WHERE token = ? AND active = 1", (token,))
+    if row is None:
+        raise NotAuthenticatedError("invalid token")
+    return row
+
+
+def is_global_admin(user_row) -> bool:
+    return user_row["global_role"] == GlobalRole.ADMIN.value
+
+
+async def get_project_member_role(db, project_id: str, user_id: str) -> Optional[str]:
+    row = await db.fetchone(
+        "SELECT project_role FROM members WHERE project_id = ? AND user_id = ?",
+        (project_id, user_id),
+    )
+    return row["project_role"] if row else None
+
+
+async def require_project_access(db, project_row, user_row, admin_only: bool = False) -> str:
+    """Return the caller's effective role in the project or raise ForbiddenError."""
+    if is_global_admin(user_row):
+        return ProjectRole.ADMIN.value
+    role = await get_project_member_role(db, project_row["id"], user_row["id"])
+    if role is None:
+        raise ForbiddenError("not a project member")
+    if admin_only and role not in (ProjectRole.ADMIN.value, ProjectRole.MANAGER.value):
+        raise ForbiddenError("project admin required")
+    return role

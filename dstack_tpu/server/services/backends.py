@@ -1,0 +1,88 @@
+"""Per-project backend registry (parity: reference server/services/backends/ +
+core/backends/configurators.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from dstack_tpu.backends.base import Compute
+from dstack_tpu.backends.local import LocalCompute
+from dstack_tpu.backends.mock import MockTpuCompute
+from dstack_tpu.core.errors import ResourceNotExistsError, ServerClientError
+from dstack_tpu.core.models.backends import BackendConfig, BackendType
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, dumps, loads, new_id
+
+# Compute instances are lightweight; cache per (project_id, type).
+_compute_cache: Dict[Tuple[str, str], Compute] = {}
+
+
+def make_compute(backend_type: str, config: Optional[dict] = None) -> Compute:
+    config = config or {}
+    if backend_type == BackendType.LOCAL.value:
+        return LocalCompute()
+    if backend_type == BackendType.MOCK.value:
+        return MockTpuCompute(regions=config.get("regions"))
+    if backend_type == BackendType.GCP.value:
+        from dstack_tpu.backends.gcp import GcpTpuCompute
+
+        return GcpTpuCompute(config)
+    raise ServerClientError(f"unsupported backend type {backend_type}")
+
+
+async def create_backend(db: Database, project_row, config: BackendConfig) -> None:
+    make_compute(config.type.value, config.model_dump())  # validates type
+    await db.execute(
+        "INSERT OR REPLACE INTO backends (id, project_id, type, config) VALUES ("
+        " COALESCE((SELECT id FROM backends WHERE project_id = ? AND type = ?), ?),"
+        " ?, ?, ?)",
+        (
+            project_row["id"],
+            config.type.value,
+            new_id(),
+            project_row["id"],
+            config.type.value,
+            config.model_dump_json(),
+        ),
+    )
+    _compute_cache.pop((project_row["id"], config.type.value), None)
+
+
+async def delete_backends(db: Database, project_row, types: List[str]) -> None:
+    for t in types:
+        await db.execute(
+            "DELETE FROM backends WHERE project_id = ? AND type = ?", (project_row["id"], t)
+        )
+        _compute_cache.pop((project_row["id"], t), None)
+
+
+async def list_backends(db: Database, project_row) -> List[BackendConfig]:
+    rows = await db.fetchall(
+        "SELECT * FROM backends WHERE project_id = ? ORDER BY type", (project_row["id"],)
+    )
+    configs = [BackendConfig.model_validate(loads(r["config"])) for r in rows]
+    if settings.LOCAL_BACKEND_ENABLED and not any(c.type == BackendType.LOCAL for c in configs):
+        configs.append(BackendConfig(type=BackendType.LOCAL))
+    return configs
+
+
+async def get_project_computes(db: Database, project_row) -> List[Tuple[str, Compute]]:
+    """All (backend_type, Compute) pairs usable by the project."""
+    out: List[Tuple[str, Compute]] = []
+    for config in await list_backends(db, project_row):
+        key = (project_row["id"], config.type.value)
+        if key not in _compute_cache:
+            _compute_cache[key] = make_compute(config.type.value, config.model_dump())
+        out.append((config.type.value, _compute_cache[key]))
+    return out
+
+
+async def get_compute(db: Database, project_row, backend_type: str) -> Compute:
+    for t, compute in await get_project_computes(db, project_row):
+        if t == backend_type:
+            return compute
+    raise ResourceNotExistsError(f"backend {backend_type} not configured")
+
+
+def reset_compute_cache() -> None:
+    _compute_cache.clear()

@@ -1,0 +1,124 @@
+"""RunSpec -> per-job JobSpecs.
+
+Parity: reference server/services/jobs/configurators/{base,task,service,dev}.py
+(base.py:60-279). TPU twist: a replica spans all hosts of the requested slice, so
+jobs_per_replica = slice hosts and every job of a replica is gang-scheduled onto the
+same slice (reference's `nodes: N` maps N jobs to N independent VMs instead)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from dstack_tpu.core.errors import ServerClientError
+from dstack_tpu.core.models.configurations import (
+    DEFAULT_TPU_IMAGE,
+    DevEnvironmentConfiguration,
+    ServiceConfiguration,
+    TaskConfiguration,
+)
+from dstack_tpu.core.models.profiles import Profile
+from dstack_tpu.core.models.runs import JobSpec, Requirements, RunSpec
+
+DEFAULT_STOP_DURATION = 300
+DEFAULT_MAX_DURATION = {"task": None, "service": None, "dev-environment": 72 * 3600}
+
+
+def _requirements(run_spec: RunSpec, profile: Profile) -> Requirements:
+    spot = None
+    if profile.spot_policy is not None:
+        spot = {"spot": True, "on-demand": False, "auto": None}[profile.spot_policy.value]
+    return Requirements(
+        resources=run_spec.configuration.resources,
+        max_price=profile.max_price,
+        spot=spot,
+        reservation=profile.reservation,
+    )
+
+
+def _env(run_spec: RunSpec) -> dict:
+    try:
+        return run_spec.configuration.env.as_dict()
+    except ValueError as e:
+        raise ServerClientError(str(e))
+
+
+def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
+    """All jobs for one replica. Multi-host slices produce one job per slice host."""
+    conf = run_spec.configuration
+    profile = run_spec.merged_profile()
+    run_name = run_spec.run_name or "run"
+
+    if conf.resources.tpu is not None:
+        jobs_per_replica = conf.resources.tpu.hosts
+    elif isinstance(conf, TaskConfiguration) and conf.nodes > 0:
+        jobs_per_replica = conf.nodes
+    else:
+        jobs_per_replica = 1
+
+    if isinstance(conf, TaskConfiguration) and conf.nodes > 0 and conf.resources.tpu is not None:
+        if conf.nodes != conf.resources.tpu.hosts:
+            raise ServerClientError(
+                f"`nodes: {conf.nodes}` conflicts with the {conf.resources.tpu.slice_name} "
+                f"slice topology ({conf.resources.tpu.hosts} hosts); omit `nodes` to derive it"
+            )
+
+    from dstack_tpu.core.models.common import parse_duration
+
+    commands = _build_commands(conf)
+    stop_duration = (
+        parse_duration(profile.stop_duration)
+        if "stop_duration" in profile.model_fields_set
+        else DEFAULT_STOP_DURATION
+    )
+    max_duration = (
+        parse_duration(profile.max_duration)
+        if "max_duration" in profile.model_fields_set
+        else DEFAULT_MAX_DURATION[conf.type]
+    )
+
+    specs = []
+    for job_num in range(jobs_per_replica):
+        specs.append(
+            JobSpec(
+                replica_num=replica_num,
+                job_num=job_num,
+                job_name=f"{run_name}-{job_num}-{replica_num}",
+                jobs_per_replica=jobs_per_replica,
+                commands=commands,
+                env=_env(run_spec),
+                image_name=conf.image or DEFAULT_TPU_IMAGE,
+                privileged=conf.privileged,
+                home_dir=conf.home_dir,
+                working_dir=conf.working_dir,
+                repo_dir=conf.repo_dir,
+                max_duration=max_duration,
+                stop_duration=stop_duration,
+                utilization_policy=profile.utilization_policy,
+                retry=profile.retry,
+                requirements=_requirements(run_spec, profile),
+                app_ports=_app_ports(conf),
+                service_port=(
+                    conf.port.container_port if isinstance(conf, ServiceConfiguration) else None
+                ),
+            )
+        )
+    return specs
+
+
+def _build_commands(conf) -> List[str]:
+    if isinstance(conf, DevEnvironmentConfiguration):
+        # IDE bootstrap + init commands, then keep the environment alive.
+        return [
+            *conf.init,
+            f"echo 'dev environment ready ({conf.ide.value})'",
+            "tail -f /dev/null",
+        ]
+    return list(conf.commands)
+
+
+def _app_ports(conf) -> List[int]:
+    if isinstance(conf, TaskConfiguration):
+        return [p.container_port for p in conf.ports]
+    if isinstance(conf, ServiceConfiguration):
+        return [conf.port.container_port]
+    return []

@@ -1,0 +1,50 @@
+"""Offer fan-in across project backends (parity: reference server/services/offers.py:
+get_offers_by_requirements:26-154)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional
+
+from dstack_tpu.core.models.instances import InstanceOffer
+from dstack_tpu.core.models.profiles import Profile, SpotPolicy
+from dstack_tpu.core.models.runs import Requirements
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services import backends as backends_service
+
+logger = logging.getLogger(__name__)
+
+
+async def get_offers_by_requirements(
+    db: Database,
+    project_row,
+    requirements: Requirements,
+    profile: Optional[Profile] = None,
+) -> List[InstanceOffer]:
+    profile = profile or Profile()
+    computes = await backends_service.get_project_computes(db, project_row)
+    if profile.backends:
+        computes = [(t, c) for t, c in computes if t in profile.backends]
+
+    req = requirements
+    if profile.spot_policy == SpotPolicy.SPOT:
+        req = requirements.model_copy(update={"spot": True})
+    elif profile.spot_policy == SpotPolicy.ONDEMAND:
+        req = requirements.model_copy(update={"spot": False})
+
+    results = await asyncio.gather(
+        *(c.get_offers(req, regions=profile.regions) for _, c in computes),
+        return_exceptions=True,
+    )
+    offers: List[InstanceOffer] = []
+    for (backend_type, _), result in zip(computes, results):
+        if isinstance(result, BaseException):
+            logger.warning("backend %s offers failed: %s", backend_type, result)
+            continue
+        offers.extend(result)
+    if profile.max_price is not None:
+        offers = [o for o in offers if o.price <= profile.max_price]
+    if profile.instance_types:
+        offers = [o for o in offers if o.instance.name in profile.instance_types]
+    return sorted(offers, key=lambda o: (o.price, o.backend, o.region))

@@ -1,0 +1,106 @@
+"""Project management (parity: reference server/services/projects.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from dstack_tpu.core.errors import ResourceExistsError, ResourceNotExistsError
+from dstack_tpu.core.models.users import Member, Project, ProjectRole
+from dstack_tpu.server.db import Database, new_id
+from dstack_tpu.server.services.users import row_to_user
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+
+async def get_project_row(db: Database, project_name: str):
+    row = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"project {project_name} not found")
+    return row
+
+
+async def create_project(db: Database, owner_row, project_name: str) -> Project:
+    existing = await db.fetchone(
+        "SELECT id FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if existing is not None:
+        raise ResourceExistsError(f"project {project_name} exists")
+    pid = new_id()
+    owner_id = owner_row["id"]
+    created = to_iso(now_utc())
+
+    def _tx(conn) -> None:
+        conn.execute(
+            "INSERT INTO projects (id, name, owner_id, created_at) VALUES (?, ?, ?, ?)",
+            (pid, project_name, owner_id, created),
+        )
+        conn.execute(
+            "INSERT INTO members (project_id, user_id, project_role) VALUES (?, ?, ?)",
+            (pid, owner_id, ProjectRole.ADMIN.value),
+        )
+
+    await db.run(_tx)
+    return await get_project(db, project_name)
+
+
+async def get_project(db: Database, project_name: str) -> Project:
+    row = await get_project_row(db, project_name)
+    owner = await db.fetchone("SELECT * FROM users WHERE id = ?", (row["owner_id"],))
+    member_rows = await db.fetchall(
+        "SELECT m.project_role, u.* FROM members m JOIN users u ON u.id = m.user_id"
+        " WHERE m.project_id = ?",
+        (row["id"],),
+    )
+    return Project(
+        id=row["id"],
+        project_name=row["name"],
+        owner=row_to_user(owner),
+        created_at=from_iso(row["created_at"]),
+        members=[
+            Member(user=row_to_user(m), project_role=ProjectRole(m["project_role"]))
+            for m in member_rows
+        ],
+    )
+
+
+async def list_user_projects(db: Database, user_row) -> List[Project]:
+    if user_row["global_role"] == "admin":
+        rows = await db.fetchall("SELECT name FROM projects WHERE deleted = 0 ORDER BY name")
+    else:
+        rows = await db.fetchall(
+            "SELECT p.name FROM projects p JOIN members m ON m.project_id = p.id"
+            " WHERE m.user_id = ? AND p.deleted = 0 ORDER BY p.name",
+            (user_row["id"],),
+        )
+    return [await get_project(db, r["name"]) for r in rows]
+
+
+async def set_members(db: Database, project_name: str, members: List[dict]) -> Project:
+    row = await get_project_row(db, project_name)
+    # Resolve all usernames before mutating so a bad entry can't wipe the member list.
+    resolved = []
+    for m in members:
+        user = await db.fetchone("SELECT id FROM users WHERE username = ?", (m["username"],))
+        if user is None:
+            raise ResourceNotExistsError(f"user {m['username']} not found")
+        resolved.append((user["id"], m.get("project_role", "user")))
+    project_id = row["id"]
+
+    def _tx(conn) -> None:
+        conn.execute("DELETE FROM members WHERE project_id = ?", (project_id,))
+        for user_id, role in resolved:
+            conn.execute(
+                "INSERT OR REPLACE INTO members (project_id, user_id, project_role)"
+                " VALUES (?, ?, ?)",
+                (project_id, user_id, role),
+            )
+
+    await db.run(_tx)
+    return await get_project(db, project_name)
+
+
+async def delete_projects(db: Database, names: List[str]) -> None:
+    for name in names:
+        row = await get_project_row(db, name)
+        await db.execute("UPDATE projects SET deleted = 1 WHERE id = ?", (row["id"],))

@@ -1,0 +1,346 @@
+"""Runs service: plan/apply/submit/stop/delete + row<->wire conversion.
+
+Parity: reference server/services/runs.py (get_plan:277, apply_plan:377, submit_run:452,
+stop_runs:552). The async FSM driving submitted->running->done lives in
+server/background/tasks (M3 of the build plan)."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from dstack_tpu.core.errors import (
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerClientError,
+)
+from dstack_tpu.core.models.runs import (
+    Job,
+    JobProvisioningData,
+    JobSpec,
+    JobStatus,
+    JobSubmission,
+    JobTerminationReason,
+    Run,
+    RunPlan,
+    RunSpec,
+    RunStatus,
+    RunTerminationReason,
+)
+from dstack_tpu.core.models.services import ServiceSpec
+from dstack_tpu.server.db import Database, dumps, loads, new_id
+from dstack_tpu.server.services.jobs.configurators import get_job_specs
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+from dstack_tpu.utils.random_names import generate_name
+
+
+def row_to_job_submission(row) -> JobSubmission:
+    jpd = loads(row["job_provisioning_data"])
+    return JobSubmission(
+        id=row["id"],
+        submission_num=row["submission_num"],
+        submitted_at=from_iso(row["submitted_at"]),
+        last_processed_at=from_iso(row["last_processed_at"]),
+        finished_at=from_iso(row["finished_at"]),
+        status=JobStatus(row["status"]),
+        termination_reason=(
+            JobTerminationReason(row["termination_reason"]) if row["termination_reason"] else None
+        ),
+        termination_reason_message=row["termination_reason_message"],
+        exit_status=row["exit_status"],
+        job_provisioning_data=JobProvisioningData.model_validate(jpd) if jpd else None,
+        inactivity_secs=row["inactivity_secs"],
+    )
+
+
+async def rows_to_runs(db: Database, run_rows: List) -> List[Run]:
+    """Batch conversion: 3 queries total instead of 3 per run (avoids N+1 through the
+    single DB worker)."""
+    if not run_rows:
+        return []
+    user_ids = sorted({r["user_id"] for r in run_rows})
+    project_ids = sorted({r["project_id"] for r in run_rows})
+    run_ids = [r["id"] for r in run_rows]
+
+    def q(ids):
+        return ",".join("?" for _ in ids)
+
+    users = {
+        r["id"]: r["username"]
+        for r in await db.fetchall(f"SELECT id, username FROM users WHERE id IN ({q(user_ids)})", user_ids)
+    }
+    projects = {
+        r["id"]: r["name"]
+        for r in await db.fetchall(f"SELECT id, name FROM projects WHERE id IN ({q(project_ids)})", project_ids)
+    }
+    job_rows = await db.fetchall(
+        f"SELECT * FROM jobs WHERE run_id IN ({q(run_ids)})"
+        " ORDER BY run_id, replica_num, job_num, submission_num",
+        run_ids,
+    )
+    jobs_by_run: dict = {}
+    for jr in job_rows:
+        jobs_by_run.setdefault(jr["run_id"], []).append(jr)
+    return [
+        _build_run(
+            r,
+            username=users.get(r["user_id"], "?"),
+            project_name=projects.get(r["project_id"], "?"),
+            job_rows=jobs_by_run.get(r["id"], []),
+        )
+        for r in run_rows
+    ]
+
+
+async def run_model_to_run(db: Database, run_row) -> Run:
+    return (await rows_to_runs(db, [run_row]))[0]
+
+
+def _build_run(run_row, username: str, project_name: str, job_rows: List) -> Run:
+    by_key: dict = {}
+    for jr in job_rows:
+        key = (jr["replica_num"], jr["job_num"])
+        if key not in by_key:
+            by_key[key] = Job(job_spec=JobSpec.model_validate(loads(jr["job_spec"])))
+        by_key[key].job_submissions.append(row_to_job_submission(jr))
+    jobs = list(by_key.values())
+    service_spec = loads(run_row["service_spec"])
+    cost = 0.0
+    for job in jobs:
+        for sub in job.job_submissions:
+            if sub.job_provisioning_data is not None and sub.finished_at is not None:
+                cost += sub.job_provisioning_data.price * max(
+                    0.0, (sub.finished_at - sub.submitted_at).total_seconds() / 3600
+                )
+    run = Run(
+        id=run_row["id"],
+        project_name=project_name,
+        user=username,
+        submitted_at=from_iso(run_row["submitted_at"]),
+        last_processed_at=from_iso(run_row["last_processed_at"]),
+        status=RunStatus(run_row["status"]),
+        status_message=run_row["status_message"],
+        termination_reason=(
+            RunTerminationReason(run_row["termination_reason"])
+            if run_row["termination_reason"]
+            else None
+        ),
+        run_spec=RunSpec.model_validate(loads(run_row["run_spec"])),
+        jobs=jobs,
+        cost=cost,
+        service=ServiceSpec.model_validate(service_spec) if service_spec else None,
+    )
+    run.error = _run_error(run)
+    return run
+
+
+def _run_error(run: Run) -> Optional[str]:
+    if run.termination_reason == RunTerminationReason.RETRY_LIMIT_EXCEEDED:
+        return "retry limit exceeded"
+    if run.termination_reason == RunTerminationReason.SERVER_ERROR:
+        return "server error"
+    return None
+
+
+async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -> RunPlan:
+    effective_name = run_spec.run_name or generate_name()
+    plan_spec = run_spec.model_copy(deep=True)
+    plan_spec.run_name = effective_name
+    job_specs = get_job_specs(plan_spec)
+
+    # Offer fan-in (backends configured for the project; populated in M3+).
+    from dstack_tpu.server.services import offers as offers_service
+
+    profile = plan_spec.merged_profile()
+    offer_list = await offers_service.get_offers_by_requirements(
+        db, project_row, job_specs[0].requirements, profile
+    )
+
+    current = None
+    action = "create"
+    existing = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_spec.run_name),
+    ) if run_spec.run_name else None
+    if existing is not None:
+        current = await run_model_to_run(db, existing)
+        action = "update" if not current.status.is_finished() else "create"
+
+    return RunPlan(
+        project_name=project_row["name"],
+        user=user_row["username"],
+        run_spec=plan_spec,
+        effective_run_name=effective_name,
+        job_plans=job_specs,
+        offers=[o.model_dump(mode="json") for o in offer_list[:50]],
+        total_offers=len(offer_list),
+        max_offer_price=max((o.price for o in offer_list), default=None),
+        current_resource=current,
+        action=action,
+    )
+
+
+async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> Run:
+    if not run_spec.run_name:
+        run_spec = run_spec.model_copy(deep=True)
+        run_spec.run_name = generate_name()
+    _validate_run_name(run_spec.run_name)
+
+    existing = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_spec.run_name),
+    )
+    if existing is not None and not RunStatus(existing["status"]).is_finished():
+        raise ResourceExistsError(
+            f"run {run_spec.run_name} already exists and is {existing['status']}"
+        )
+
+    run_id = new_id()
+    now = to_iso(now_utc())
+    replicas = 1
+    conf = run_spec.configuration
+    if conf.type == "service":
+        replicas = conf.replicas.min or 0
+
+    # Validate/configure all job specs before writing anything, then insert the run and
+    # its jobs in one transaction so a failure can't leave an orphan 'submitted' run.
+    all_specs = [
+        (replica_num, job_spec)
+        for replica_num in range(replicas)
+        for job_spec in get_job_specs(run_spec, replica_num=replica_num)
+    ]
+    project_id = project_row["id"]
+    user_id = user_row["id"]
+    run_spec_json = run_spec.model_dump_json()
+    run_name = run_spec.run_name
+
+    def _tx(conn) -> None:
+        if existing is not None:
+            # Finished runs with the same name are soft-deleted on resubmit.
+            conn.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (existing["id"],))
+        conn.execute(
+            "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
+            " run_spec, desired_replica_count) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+            (run_id, project_id, user_id, run_name, now, RunStatus.SUBMITTED.value, run_spec_json, replicas),
+        )
+        for _, job_spec in all_specs:
+            conn.execute(
+                "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+                " submission_num, job_spec, status, submitted_at)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    new_id(),
+                    project_id,
+                    run_id,
+                    run_name,
+                    job_spec.job_num,
+                    job_spec.replica_num,
+                    0,
+                    job_spec.model_dump_json(),
+                    JobStatus.SUBMITTED.value,
+                    now,
+                ),
+            )
+
+    await db.run(_tx)
+    run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+    return await run_model_to_run(db, run_row)
+
+
+async def create_job(
+    db: Database,
+    project_id: str,
+    run_id: str,
+    run_name: str,
+    job_spec: JobSpec,
+    submission_num: int = 0,
+) -> str:
+    job_id = new_id()
+    await db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " submission_num, job_spec, status, submitted_at)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            job_id,
+            project_id,
+            run_id,
+            run_name,
+            job_spec.job_num,
+            job_spec.replica_num,
+            submission_num,
+            job_spec.model_dump_json(),
+            JobStatus.SUBMITTED.value,
+            to_iso(now_utc()),
+        ),
+    )
+    return job_id
+
+
+async def list_runs(
+    db: Database,
+    project_id: Optional[str] = None,
+    project_ids: Optional[List[str]] = None,
+    only_active: bool = False,
+    limit: int = 1000,
+) -> List[Run]:
+    sql = "SELECT * FROM runs WHERE deleted = 0"
+    params: list = []
+    if project_id is not None:
+        sql += " AND project_id = ?"
+        params.append(project_id)
+    if project_ids is not None:
+        if not project_ids:
+            return []
+        sql += f" AND project_id IN ({','.join('?' for _ in project_ids)})"
+        params.extend(project_ids)
+    if only_active:
+        sql += " AND status NOT IN ('terminated', 'failed', 'done')"
+    sql += " ORDER BY submitted_at DESC LIMIT ?"
+    params.append(limit)
+    rows = await db.fetchall(sql, params)
+    return await rows_to_runs(db, rows)
+
+
+async def get_run(db: Database, project_row, run_name: str) -> Run:
+    row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"run {run_name} not found")
+    return await run_model_to_run(db, row)
+
+
+async def stop_runs(db: Database, project_row, run_names: List[str], abort: bool = False) -> None:
+    reason = RunTerminationReason.ABORTED_BY_USER if abort else RunTerminationReason.STOPPED_BY_USER
+    for name in run_names:
+        row = await db.fetchone(
+            "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+            (project_row["id"], name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"run {name} not found")
+        if RunStatus(row["status"]).is_finished():
+            continue
+        await db.execute(
+            "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
+            (RunStatus.TERMINATING.value, reason.value, row["id"]),
+        )
+
+
+async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
+    for name in run_names:
+        row = await db.fetchone(
+            "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+            (project_row["id"], name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"run {name} not found")
+        if not RunStatus(row["status"]).is_finished():
+            raise ServerClientError(f"run {name} is {row['status']}; stop it first")
+        await db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))
+
+
+def _validate_run_name(name: str) -> None:
+    if not name or not all(c.isalnum() or c in "-_" for c in name):
+        raise ServerClientError(f"invalid run name {name!r}")

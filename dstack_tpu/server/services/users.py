@@ -1,0 +1,117 @@
+"""User management (parity: reference server/services/users.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from dstack_tpu.core.errors import ResourceExistsError, ResourceNotExistsError
+from dstack_tpu.core.models.users import GlobalRole, User, UserWithCreds
+from dstack_tpu.server.db import Database, new_id
+from dstack_tpu.server.security import generate_token
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+
+def row_to_user(row) -> User:
+    return User(
+        id=row["id"],
+        username=row["username"],
+        global_role=GlobalRole(row["global_role"]),
+        email=row["email"],
+        active=bool(row["active"]),
+        created_at=from_iso(row["created_at"]),
+    )
+
+
+def row_to_user_with_creds(row) -> UserWithCreds:
+    u = row_to_user(row)
+    return UserWithCreds(**u.model_dump(), creds={"token": row["token"]})
+
+
+async def get_or_create_admin_user(db: Database, token: Optional[str] = None):
+    row = await db.fetchone("SELECT * FROM users WHERE username = 'admin'")
+    if row is not None:
+        if token and row["token"] != token:
+            await db.execute("UPDATE users SET token = ? WHERE id = ?", (token, row["id"]))
+            row = await db.fetchone("SELECT * FROM users WHERE id = ?", (row["id"],))
+        return row, False
+    await create_user(db, "admin", GlobalRole.ADMIN, token=token)
+    return await db.fetchone("SELECT * FROM users WHERE username = 'admin'"), True
+
+
+async def create_user(
+    db: Database,
+    username: str,
+    global_role: GlobalRole = GlobalRole.USER,
+    email: Optional[str] = None,
+    token: Optional[str] = None,
+) -> UserWithCreds:
+    existing = await db.fetchone("SELECT id FROM users WHERE username = ?", (username,))
+    if existing is not None:
+        raise ResourceExistsError(f"user {username} exists")
+    uid = new_id()
+    await db.execute(
+        "INSERT INTO users (id, username, global_role, email, token, active, created_at)"
+        " VALUES (?, ?, ?, ?, ?, 1, ?)",
+        (uid, username, global_role.value, email, token or generate_token(), to_iso(now_utc())),
+    )
+    row = await db.fetchone("SELECT * FROM users WHERE id = ?", (uid,))
+    return row_to_user_with_creds(row)
+
+
+async def list_users(db: Database) -> List[User]:
+    rows = await db.fetchall("SELECT * FROM users ORDER BY username")
+    return [row_to_user(r) for r in rows]
+
+
+async def get_user_by_name(db: Database, username: str):
+    row = await db.fetchone("SELECT * FROM users WHERE username = ?", (username,))
+    if row is None:
+        raise ResourceNotExistsError(f"user {username} not found")
+    return row
+
+
+async def refresh_token(db: Database, username: str) -> UserWithCreds:
+    row = await get_user_by_name(db, username)
+    await db.execute("UPDATE users SET token = ? WHERE id = ?", (generate_token(), row["id"]))
+    return row_to_user_with_creds(await get_user_by_name(db, username))
+
+
+async def update_user(
+    db: Database,
+    username: str,
+    global_role: Optional[GlobalRole] = None,
+    email: Optional[str] = None,
+) -> User:
+    """Partial update: omitted fields keep their current values."""
+    row = await get_user_by_name(db, username)
+    await db.execute(
+        "UPDATE users SET global_role = ?, email = ? WHERE id = ?",
+        (
+            global_role.value if global_role is not None else row["global_role"],
+            email if email is not None else row["email"],
+            row["id"],
+        ),
+    )
+    return row_to_user(await get_user_by_name(db, username))
+
+
+async def delete_users(db: Database, usernames: List[str]) -> None:
+    """Hard-delete when unreferenced; otherwise deactivate (projects/runs keep valid
+    foreign keys to the user row)."""
+    rows = [await get_user_by_name(db, name) for name in usernames]
+    for row in rows:
+        uid = row["id"]
+        owns = await db.fetchone("SELECT 1 FROM projects WHERE owner_id = ? LIMIT 1", (uid,))
+        has_runs = await db.fetchone("SELECT 1 FROM runs WHERE user_id = ? LIMIT 1", (uid,))
+
+        def _tx(conn, uid=uid, referenced=bool(owns or has_runs)) -> None:
+            conn.execute("DELETE FROM members WHERE user_id = ?", (uid,))
+            if referenced:
+                conn.execute(
+                    "UPDATE users SET active = 0, token = ? WHERE id = ?",
+                    (generate_token(), uid),
+                )
+            else:
+                conn.execute("DELETE FROM users WHERE id = ?", (uid,))
+
+        await db.run(_tx)

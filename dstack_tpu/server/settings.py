@@ -1,0 +1,46 @@
+"""Env-var driven server settings (parity: reference server/settings.py:1-103)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.getenv(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+SERVER_DIR = Path(os.getenv("DSTACK_TPU_SERVER_DIR", os.path.expanduser("~/.dstack-tpu/server")))
+DATA_DIR = SERVER_DIR / "data"
+LOGS_DIR = SERVER_DIR / "logs"
+
+DB_PATH = os.getenv("DSTACK_TPU_DB_PATH", str(DATA_DIR / "server.db"))
+
+ADMIN_TOKEN = os.getenv("DSTACK_TPU_SERVER_ADMIN_TOKEN")
+DEFAULT_PROJECT_NAME = os.getenv("DSTACK_TPU_DEFAULT_PROJECT", "main")
+
+# Background processing knobs (reference background/__init__.py:39-100). The reference
+# caps at 150 active jobs/replica with 4s loops; we default to tighter loops (asyncio is
+# cheap without APScheduler's executor pools) — see bench: scheduling throughput.
+PROCESS_RUNS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_RUNS_INTERVAL", "1.0"))
+PROCESS_SUBMITTED_JOBS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_SUBMITTED_JOBS_INTERVAL", "1.0"))
+PROCESS_RUNNING_JOBS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_RUNNING_JOBS_INTERVAL", "1.0"))
+PROCESS_TERMINATING_JOBS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_TERMINATING_JOBS_INTERVAL", "1.0"))
+PROCESS_INSTANCES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_INSTANCES_INTERVAL", "2.0"))
+PROCESS_FLEETS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_FLEETS_INTERVAL", "5.0"))
+PROCESS_VOLUMES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_VOLUMES_INTERVAL", "5.0"))
+PROCESS_GATEWAYS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_GATEWAYS_INTERVAL", "5.0"))
+PROCESS_METRICS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_METRICS_INTERVAL", "10.0"))
+PROCESS_BATCH_SIZE = int(os.getenv("DSTACK_TPU_PROCESS_BATCH_SIZE", "10"))
+METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL", "3600"))
+
+LOCAL_BACKEND_ENABLED = _env_bool("DSTACK_TPU_LOCAL_BACKEND_ENABLED", True)
+ENABLE_PROMETHEUS_METRICS = _env_bool("DSTACK_TPU_ENABLE_PROMETHEUS_METRICS", True)
+
+MAX_CODE_SIZE = int(os.getenv("DSTACK_TPU_MAX_CODE_SIZE", str(2 * 1024 * 1024)))  # 2 MiB, ref settings.py:92
+
+SERVER_HOST = os.getenv("DSTACK_TPU_SERVER_HOST", "127.0.0.1")
+SERVER_PORT = int(os.getenv("DSTACK_TPU_SERVER_PORT", "3000"))

@@ -1,0 +1,26 @@
+"""Memorable run-name generator (parity: reference _internal/utils/random_names.py —
+different word lists, same adjective-noun-number shape)."""
+
+from __future__ import annotations
+
+import random
+
+_ADJECTIVES = [
+    "swift", "calm", "bright", "brave", "quiet", "rapid", "solid", "vivid", "lucid",
+    "noble", "eager", "merry", "keen", "bold", "wise", "fond", "warm", "cool", "deft",
+    "spry", "sleek", "stout", "sunny", "tidy", "agile", "amber", "azure", "coral",
+    "ivory", "jade", "onyx", "pearl", "ruby", "topaz", "cobalt",
+]
+
+_NOUNS = [
+    "falcon", "otter", "heron", "lynx", "puffin", "marmot", "ibex", "gecko", "wren",
+    "stork", "tern", "dingo", "tapir", "quokka", "lemur", "hare", "mole", "vole",
+    "newt", "koi", "crane", "finch", "swift2", "raven", "magpie", "osprey", "kestrel",
+    "plover", "sparrow", "weasel", "badger", "beaver", "bison", "camel", "donkey",
+]
+
+
+def generate_name(rng: random.Random = random) -> str:
+    adj = rng.choice(_ADJECTIVES)
+    noun = rng.choice(_NOUNS).rstrip("0123456789")
+    return f"{adj}-{noun}-{rng.randint(1, 99)}"

@@ -1,0 +1,76 @@
+"""Shared test harness: in-memory server + authed client.
+
+Parity with the reference's test strategy (SURVEY §4): single-process server, real DB
+(sqlite in-memory), real services; clouds replaced by the mock TPU backend."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+from typing import Any, AsyncIterator, Optional
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.server.app import create_app
+
+
+class ApiClient:
+    """Thin wrapper: POST json with auth header, parse json, expose raw responses."""
+
+    def __init__(self, client: TestClient, token: str):
+        self.client = client
+        self.token = token
+
+    async def post(
+        self,
+        path: str,
+        body: Optional[dict] = None,
+        token: Optional[str] = None,
+        expect: Optional[int] = 200,
+    ) -> Any:
+        headers = {}
+        tok = token if token is not None else self.token
+        if tok:
+            headers["Authorization"] = f"Bearer {tok}"
+        resp = await self.client.post(path, json=body or {}, headers=headers)
+        text = await resp.text()
+        if expect is not None:
+            assert resp.status == expect, f"{path} -> {resp.status}: {text[:500]}"
+        return json.loads(text) if text else None
+
+
+@contextlib.asynccontextmanager
+async def api_server(run_background_tasks: bool = False) -> AsyncIterator[ApiClient]:
+    app = create_app(db_path=":memory:", run_background_tasks=run_background_tasks)
+    server = TestServer(app)
+    client = TestClient(server)
+    await client.start_server()
+    try:
+        yield ApiClient(client, app["admin_token"])
+    finally:
+        await client.close()
+
+
+TASK_SPEC = {
+    "run_spec": {
+        "run_name": "test-run",
+        "configuration": {
+            "type": "task",
+            "commands": ["echo hello"],
+        },
+    }
+}
+
+
+def tpu_task_spec(run_name: str = "tpu-run", tpu: str = "v5p-16", **conf) -> dict:
+    return {
+        "run_spec": {
+            "run_name": run_name,
+            "configuration": {
+                "type": "task",
+                "commands": ["python train.py"],
+                "resources": {"tpu": tpu},
+                **conf,
+            },
+        }
+    }

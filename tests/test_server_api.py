@@ -1,0 +1,295 @@
+"""Router/API tests (parity: reference src/tests/_internal/server/routers/)."""
+
+import pytest
+
+from tests.common import TASK_SPEC, api_server, tpu_task_spec
+
+
+class TestAuth:
+    async def test_healthcheck_public(self):
+        async with api_server() as api:
+            resp = await api.client.get("/healthcheck")
+            assert resp.status == 200
+
+    async def test_missing_token(self):
+        async with api_server() as api:
+            await api.post("/api/users/get_my_user", token="", expect=401)
+
+    async def test_invalid_token(self):
+        async with api_server() as api:
+            await api.post("/api/users/get_my_user", token="bogus", expect=401)
+
+    async def test_admin_user(self):
+        async with api_server() as api:
+            me = await api.post("/api/users/get_my_user")
+            assert me["username"] == "admin"
+            assert me["global_role"] == "admin"
+
+
+class TestUsers:
+    async def test_create_list_delete(self):
+        async with api_server() as api:
+            created = await api.post("/api/users/create", {"username": "alice"})
+            assert created["username"] == "alice"
+            assert created["creds"]["token"]
+            users = await api.post("/api/users/list")
+            assert {u["username"] for u in users} == {"admin", "alice"}
+            # alice is not an admin
+            await api.post(
+                "/api/users/create", {"username": "bob"}, token=created["creds"]["token"], expect=403
+            )
+            await api.post("/api/users/delete", {"users": ["alice"]})
+            users = await api.post("/api/users/list")
+            assert {u["username"] for u in users} == {"admin"}
+
+    async def test_duplicate_username(self):
+        async with api_server() as api:
+            await api.post("/api/users/create", {"username": "alice"})
+            await api.post("/api/users/create", {"username": "alice"}, expect=409)
+
+    async def test_refresh_token(self):
+        async with api_server() as api:
+            created = await api.post("/api/users/create", {"username": "alice"})
+            old = created["creds"]["token"]
+            refreshed = await api.post("/api/users/refresh_token", {"username": "alice"})
+            assert refreshed["creds"]["token"] != old
+            # old token no longer works
+            await api.post("/api/users/get_my_user", token=old, expect=401)
+            me = await api.post(
+                "/api/users/get_my_user", token=refreshed["creds"]["token"]
+            )
+            assert me["username"] == "alice"
+
+
+class TestProjects:
+    async def test_default_project(self):
+        async with api_server() as api:
+            projects = await api.post("/api/projects/list")
+            assert [p["project_name"] for p in projects] == ["main"]
+
+    async def test_create_and_members(self):
+        async with api_server() as api:
+            await api.post("/api/projects/create", {"project_name": "research"})
+            alice = await api.post("/api/users/create", {"username": "alice"})
+            atoken = alice["creds"]["token"]
+            # alice sees no projects yet
+            projects = await api.post("/api/projects/list", token=atoken)
+            assert projects == []
+            # non-member cannot read the project
+            await api.post("/api/projects/research/get", token=atoken, expect=403)
+            await api.post(
+                "/api/projects/research/set_members",
+                {"members": [{"username": "admin", "project_role": "admin"}, {"username": "alice", "project_role": "user"}]},
+            )
+            proj = await api.post("/api/projects/research/get", token=atoken)
+            assert {m["user"]["username"] for m in proj["members"]} == {"admin", "alice"}
+            # member but not admin: cannot set members
+            await api.post(
+                "/api/projects/research/set_members",
+                {"members": []},
+                token=atoken,
+                expect=403,
+            )
+
+    async def test_duplicate_project(self):
+        async with api_server() as api:
+            await api.post("/api/projects/create", {"project_name": "p1"})
+            await api.post("/api/projects/create", {"project_name": "p1"}, expect=409)
+
+    async def test_delete_project(self):
+        async with api_server() as api:
+            await api.post("/api/projects/create", {"project_name": "p1"})
+            await api.post("/api/projects/delete", {"projects_names": ["p1"]})
+            projects = await api.post("/api/projects/list")
+            assert "p1" not in [p["project_name"] for p in projects]
+
+
+class TestBackends:
+    async def test_local_backend_present(self):
+        async with api_server() as api:
+            backends = await api.post("/api/project/main/backends/list")
+            assert any(b["type"] == "local" for b in backends)
+
+    async def test_create_mock_backend(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/backends/create", {"type": "mock"})
+            backends = await api.post("/api/project/main/backends/list")
+            assert any(b["type"] == "mock" for b in backends)
+
+
+class TestRuns:
+    async def test_get_plan_cpu_task(self):
+        async with api_server() as api:
+            plan = await api.post("/api/project/main/runs/get_plan", TASK_SPEC)
+            assert plan["effective_run_name"] == "test-run"
+            assert len(plan["job_plans"]) == 1
+            assert plan["action"] == "create"
+            # local backend offers a CPU instance
+            assert plan["total_offers"] >= 1
+
+    async def test_get_plan_tpu_task_no_tpu_backend(self):
+        async with api_server() as api:
+            plan = await api.post("/api/project/main/runs/get_plan", tpu_task_spec())
+            assert plan["total_offers"] == 0  # local backend can't serve TPUs
+
+    async def test_get_plan_tpu_task_with_mock(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/backends/create", {"type": "mock"})
+            plan = await api.post("/api/project/main/runs/get_plan", tpu_task_spec())
+            assert plan["total_offers"] > 0
+            offer = plan["offers"][0]
+            assert offer["slice_name"] == "v5p-16"
+            assert offer["hosts_per_slice"] == 2
+            # multi-host slice -> one job per host in the plan
+            assert len(plan["job_plans"]) == 2
+
+    async def test_submit_and_get(self):
+        async with api_server() as api:
+            run = await api.post("/api/project/main/runs/apply_plan", TASK_SPEC)
+            assert run["status"] == "submitted"
+            got = await api.post("/api/project/main/runs/get", {"run_name": "test-run"})
+            assert got["id"] == run["id"]
+            assert len(got["jobs"]) == 1
+            runs = await api.post("/api/project/main/runs/list")
+            assert len(runs) == 1
+
+    async def test_submit_duplicate_active(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/runs/apply_plan", TASK_SPEC)
+            await api.post("/api/project/main/runs/apply_plan", TASK_SPEC, expect=409)
+
+    async def test_submit_generates_name(self):
+        async with api_server() as api:
+            spec = {"run_spec": {"configuration": {"type": "task", "commands": ["true"]}}}
+            run = await api.post("/api/project/main/runs/apply_plan", spec)
+            assert run["run_spec"]["run_name"]
+
+    async def test_stop_run(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/runs/apply_plan", TASK_SPEC)
+            await api.post("/api/project/main/runs/stop", {"runs_names": ["test-run"]})
+            got = await api.post("/api/project/main/runs/get", {"run_name": "test-run"})
+            assert got["status"] == "terminating"
+            assert got["termination_reason"] == "stopped_by_user"
+
+    async def test_delete_requires_finished(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/runs/apply_plan", TASK_SPEC)
+            await api.post(
+                "/api/project/main/runs/delete", {"runs_names": ["test-run"]}, expect=400
+            )
+
+    async def test_get_missing_run(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/runs/get", {"run_name": "nope"}, expect=404)
+
+    async def test_tpu_submit_creates_gang(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/backends/create", {"type": "mock"})
+            run = await api.post(
+                "/api/project/main/runs/apply_plan", tpu_task_spec(run_name="gang", tpu="v5e-16")
+            )
+            assert len(run["jobs"]) == 2  # v5e-16 = 2 hosts
+            specs = [j["job_spec"] for j in run["jobs"]]
+            assert [s["job_num"] for s in specs] == [0, 1]
+            assert all(s["jobs_per_replica"] == 2 for s in specs)
+
+    async def test_nodes_conflicting_with_slice(self):
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/runs/get_plan",
+                tpu_task_spec(run_name="x", tpu="v5p-16", nodes=5),
+                expect=400,
+            )
+
+
+class TestRegressions:
+    async def test_resubmit_finished_name_twice(self):
+        # Two generations of soft-deleted rows with the same name must not collide.
+        async with api_server() as api:
+            for _ in range(3):
+                run = await api.post("/api/project/main/runs/apply_plan", TASK_SPEC)
+                db = api.client.server.app["db"]
+                await db.execute(
+                    "UPDATE runs SET status = 'done' WHERE id = ?", (run["id"],)
+                )
+
+    async def test_project_name_reusable_after_delete(self):
+        async with api_server() as api:
+            await api.post("/api/projects/create", {"project_name": "p1"})
+            await api.post("/api/projects/delete", {"projects_names": ["p1"]})
+            created = await api.post("/api/projects/create", {"project_name": "p1"})
+            assert created["project_name"] == "p1"
+
+    async def test_delete_user_with_resources_deactivates(self):
+        async with api_server() as api:
+            alice = await api.post("/api/users/create", {"username": "alice"})
+            atok = alice["creds"]["token"]
+            await api.post("/api/projects/create", {"project_name": "ap"}, token=atok)
+            await api.post("/api/users/delete", {"users": ["alice"]})
+            # token revoked, but project ownership intact (no 500)
+            await api.post("/api/users/get_my_user", token=atok, expect=401)
+            proj = await api.post("/api/projects/ap/get")
+            assert proj["owner"]["username"] == "alice"
+
+    async def test_set_members_ghost_preserves_members(self):
+        async with api_server() as api:
+            await api.post("/api/projects/create", {"project_name": "p2"})
+            await api.post(
+                "/api/projects/p2/set_members",
+                {"members": [{"username": "ghost"}]},
+                expect=404,
+            )
+            proj = await api.post("/api/projects/p2/get")
+            assert len(proj["members"]) == 1  # admin still a member
+
+    async def test_failed_submit_leaves_no_orphan_run(self):
+        async with api_server() as api:
+            spec = {
+                "run_spec": {
+                    "run_name": "orphan",
+                    "configuration": {
+                        "type": "task",
+                        "commands": ["x"],
+                        "env": ["UNSET_VAR"],  # bare env var -> configurator error
+                    },
+                }
+            }
+            await api.post("/api/project/main/runs/apply_plan", spec, expect=400)
+            await api.post("/api/project/main/runs/get", {"run_name": "orphan"}, expect=404)
+
+    async def test_profile_duration_strings(self):
+        async with api_server() as api:
+            spec = {
+                "run_spec": {
+                    "run_name": "durs",
+                    "configuration": {"type": "task", "commands": ["x"], "max_duration": "2h"},
+                    "profile": {"stop_duration": "10m"},
+                }
+            }
+            run = await api.post("/api/project/main/runs/apply_plan", spec)
+            js = run["jobs"][0]["job_spec"]
+            assert js["max_duration"] == 7200
+            assert js["stop_duration"] == 600
+
+    async def test_update_user_partial(self):
+        async with api_server() as api:
+            await api.post("/api/users/create", {"username": "root2", "global_role": "admin"})
+            updated = await api.post(
+                "/api/users/update", {"username": "root2", "email": "x@y.z"}
+            )
+            assert updated["global_role"] == "admin"  # not demoted
+            assert updated["email"] == "x@y.z"
+
+
+class TestOffersCatalog:
+    async def test_catalog_pricing_sorted(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/backends/create", {"type": "mock"})
+            plan = await api.post(
+                "/api/project/main/runs/get_plan", tpu_task_spec(run_name="o", tpu="v5e-8")
+            )
+            prices = [o["price"] for o in plan["offers"]]
+            assert prices == sorted(prices)
+            # spot offers cheaper than on-demand
+            assert any(o["spot"] for o in plan["offers"])
