@@ -204,6 +204,20 @@ class JobProvisioningData(CoreModel):
     hosts_per_slice: int = 1
 
 
+class JobRuntimeData(CoreModel):
+    """Mutable per-submission runtime state (parity: reference JobRuntimeData runs.py:243).
+
+    Stored in jobs.job_runtime_data; carries how the server reaches the runner and how
+    far logs/state have been pulled."""
+
+    runner_port: Optional[int] = None
+    runner_pid: Optional[int] = None
+    pull_offset: int = 0
+    started_at: Optional[datetime.datetime] = None  # first observed RUNNING transition
+    ports_mapping: Dict[int, int] = Field(default_factory=dict)
+    volume_names: List[str] = Field(default_factory=list)
+
+
 class ClusterInfo(CoreModel):
     """The TPU cluster contract injected into every job's environment.
 

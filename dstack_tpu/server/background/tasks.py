@@ -1,26 +1,945 @@
-"""Background task entry points. Filled in by the scheduler milestone (M3); the
-placeholders keep the server bootable before then."""
+"""The async control-plane FSM loops.
+
+Parity: reference server/background/tasks/ —
+  process_submitted_jobs.py:124-341 (two-phase assign-or-provision scheduler),
+  process_running_jobs.py:116-300 (provisioning→pulling→running via the runner agent),
+  process_runs.py:212-449 (run FSM: aggregation, retries w/ backoff, stop criteria),
+  process_terminating_jobs.py:27, process_instances.py:165-1118.
+
+TPU re-design (SURVEY §7 hard parts a+b): the placement atom is a *slice* — a replica's
+jobs are gang-placed onto whole slices (all hosts of each slice at once), never onto
+independent VMs. Multislice replicas (tpu.count > 1) place one slice at a time; partial
+placements park provisioned slices in the pool as idle so the next pass completes the
+gang instead of leaking capacity.
+"""
 
 from __future__ import annotations
 
-from dstack_tpu.server.db import Database
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from dstack_tpu.core.errors import BackendError, NoCapacityError
+from dstack_tpu.core.models.instances import InstanceOffer, InstanceStatus
+from dstack_tpu.core.models.logs import LogEvent
+from dstack_tpu.core.models.profiles import (
+    DEFAULT_RUN_TERMINATION_IDLE_TIME,
+    CreationPolicy,
+    Profile,
+    RetryEvent,
+    StartupOrder,
+    StopCriteria,
+)
+from dstack_tpu.core.models.runs import (
+    JobRuntimeData,
+    JobStatus,
+    JobTerminationReason,
+    RunSpec,
+    RunStatus,
+    RunTerminationReason,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, loads, new_id
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import fleets as fleets_service
+from dstack_tpu.server.services import instances as instances_service
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.server.services import offers as offers_service
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services.jobs import (
+    build_cluster_info,
+    job_jpd,
+    job_jrd,
+    job_spec as load_job_spec,
+    set_job_status,
+    terminate_job,
+)
+from dstack_tpu.server.services.locking import get_locker
+from dstack_tpu.server.services.runner.client import get_runner_client
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+# Which job failures a retry event covers (reference runs.py:92-95).
+_REASON_TO_RETRY_EVENT = {
+    JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY: RetryEvent.NO_CAPACITY,
+    JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY: RetryEvent.INTERRUPTION,
+    JobTerminationReason.INSTANCE_UNREACHABLE: RetryEvent.INTERRUPTION,
+    JobTerminationReason.CONTAINER_EXITED_WITH_ERROR: RetryEvent.ERROR,
+    JobTerminationReason.EXECUTOR_ERROR: RetryEvent.ERROR,
+    JobTerminationReason.CREATING_CONTAINER_ERROR: RetryEvent.ERROR,
+    JobTerminationReason.PORTS_BINDING_FAILED: RetryEvent.ERROR,
+}
 
 
-async def process_runs(db: Database) -> None:
-    return None
+# =====================================================================================
+# process_submitted_jobs
 
 
-async def process_submitted_jobs(db: Database) -> None:
-    return None
+async def process_submitted_jobs(db: Database, batch: Optional[int] = None) -> None:
+    batch = batch or settings.PROCESS_BATCH_SIZE
+    # Order by last processing attempt, not submission time: jobs parked in `submitted`
+    # by a no-capacity retry window rotate to the back instead of head-of-line blocking
+    # fresh runs.
+    rows = await db.fetchall(
+        "SELECT j.*, r.status AS run_status FROM jobs j JOIN runs r ON r.id = j.run_id"
+        " WHERE j.status = 'submitted' AND r.status NOT IN"
+        " ('terminating', 'terminated', 'failed', 'done')"
+        " ORDER BY COALESCE(j.last_processed_at, j.submitted_at) LIMIT ?",
+        (batch * 4,),
+    )
+    # Group into replicas (the gang unit); cap work per pass at `batch` replicas.
+    groups: Dict[Tuple[str, int, int], List] = {}
+    for r in rows:
+        groups.setdefault((r["run_id"], r["replica_num"], r["submission_num"]), []).append(r)
+    for (run_id, replica_num, submission_num), _ in list(groups.items())[:batch]:
+        async with get_locker().lock(f"run:{run_id}"):
+            await _place_replica(db, run_id, replica_num, submission_num)
 
 
-async def process_running_jobs(db: Database) -> None:
-    return None
+async def _place_replica(db: Database, run_id: str, replica_num: int, submission_num: int) -> None:
+    # Re-fetch the full gang under the lock (the batch query may have truncated it).
+    job_rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND submission_num = ?"
+        " ORDER BY job_num",
+        (run_id, replica_num, submission_num),
+    )
+    job_rows = [r for r in job_rows if r["status"] == "submitted"]
+    if not job_rows:
+        return
+    run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+    if run_row is None or RunStatus(run_row["status"]).is_finished():
+        return
+    project_row = await db.fetchone("SELECT * FROM projects WHERE id = ?", (run_row["project_id"],))
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    profile = run_spec.merged_profile()
+    spec0 = load_job_spec(job_rows[0])
+    requirements = spec0.requirements
+
+    tpu = requirements.resources.tpu
+    hosts_per_slice = tpu.hosts if tpu is not None else 1
+    slice_name = tpu.slice_name if tpu is not None else None
+
+    # Which fleets may be used (profile.fleets names -> ids).
+    fleet_ids: Optional[List[str]] = None
+    if profile.fleets:
+        frows = await db.fetchall(
+            f"SELECT id FROM fleets WHERE project_id = ? AND deleted = 0 AND name IN"
+            f" ({','.join('?' for _ in profile.fleets)})",
+            [run_row["project_id"], *profile.fleets],
+        )
+        fleet_ids = [r["id"] for r in frows]
+
+    # Slice-by-slice gang placement. job_num w of slice s is job_rows[s*hosts+w].
+    num_slices = max(1, len(job_rows) // max(1, hosts_per_slice))
+    idle_slices = await instances_service.find_idle_slices(
+        db,
+        run_row["project_id"],
+        requirements,
+        slice_name,
+        hosts_per_slice,
+        fleet_ids,
+        profile=profile,
+    )
+    offers: Optional[List[InstanceOffer]] = None
+    placed_all = True
+    for s in range(num_slices):
+        slice_jobs = job_rows[s * hosts_per_slice : (s + 1) * hosts_per_slice]
+        if not slice_jobs or slice_jobs[0]["status"] != "submitted":
+            continue
+        # Phase 1: reuse an idle slice from the pool (reference
+        # process_submitted_jobs.py:344 _assign_job_to_pool_instance).
+        if idle_slices:
+            workers = idle_slices.pop(0)
+            await instances_service.mark_slice_busy(db, [w["id"] for w in workers])
+            for w_row, j_row in zip(workers, slice_jobs):
+                await _assign_job(db, j_row, w_row["id"], loads(w_row["job_provisioning_data"]))
+            continue
+        # Phase 2: provision a new slice (reference :415 _run_job_on_new_instance).
+        if profile.creation_policy == CreationPolicy.REUSE:
+            placed_all = False
+            continue
+        if offers is None:
+            offers = await offers_service.get_offers_by_requirements(
+                db, project_row, requirements, profile
+            )
+            offers = [o for o in offers if o.availability.is_available()]
+        created = await _provision_slice(db, project_row, run_row, run_spec, offers, slice_jobs)
+        if not created:
+            placed_all = False
+
+    if not placed_all:
+        await _handle_no_capacity(db, run_row, job_rows, profile)
 
 
-async def process_terminating_jobs(db: Database) -> None:
-    return None
+async def _assign_job(db: Database, job_row, instance_id: str, jpd_dict: dict) -> None:
+    await db.execute(
+        "UPDATE jobs SET status = 'provisioning', instance_id = ?,"
+        " job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
+        (instance_id, json.dumps(jpd_dict), to_iso(now_utc()), job_row["id"]),
+    )
 
 
-async def process_instances(db: Database) -> None:
-    return None
+async def _provision_slice(
+    db: Database, project_row, run_row, run_spec: RunSpec, offers: List[InstanceOffer], slice_jobs: List
+) -> bool:
+    """Try offers in price order until a slice provisions; create instance rows and
+    assign the gang. Returns False when every offer fails with no capacity."""
+    for offer in offers[: settings.MAX_OFFERS_TRIED]:
+        try:
+            compute = await backends_service.get_compute(db, project_row, offer.backend)
+        except Exception:
+            continue
+        name = f"{run_row['run_name']}-{slice_jobs[0]['replica_num']}-{new_id()[:8]}"
+        try:
+            jpds = await compute.create_slice(
+                offer, name, ssh_public_key=run_spec.ssh_key_pub or ""
+            )
+        except NoCapacityError as e:
+            logger.debug("offer %s/%s no capacity: %s", offer.backend, offer.instance.name, e)
+            continue
+        except BackendError as e:
+            logger.warning("offer %s/%s provisioning failed: %s", offer.backend, offer.instance.name, e)
+            continue
+        fleet_id = await _run_fleet(db, run_row, run_spec)
+        ids = await instances_service.create_slice_instances(
+            db,
+            project_row["id"],
+            fleet_id,
+            name,
+            jpds,
+            offer,
+            status=InstanceStatus.PROVISIONING,
+        )
+        await db.execute(
+            f"UPDATE instances SET busy_blocks = 1 WHERE id IN ({','.join('?' for _ in ids)})",
+            ids,
+        )
+        if run_row["fleet_id"] is None:
+            await db.execute("UPDATE runs SET fleet_id = ? WHERE id = ?", (fleet_id, run_row["id"]))
+        for jpd, iid, j_row in zip(jpds, ids, slice_jobs):
+            await _assign_job(db, j_row, iid, json.loads(jpd.model_dump_json()))
+        return True
+    return False
+
+
+async def _run_fleet(db: Database, run_row, run_spec: RunSpec) -> str:
+    profile = run_spec.merged_profile()
+    if profile.fleets:
+        row = await db.fetchone(
+            "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+            (run_row["project_id"], profile.fleets[0]),
+        )
+        if row is not None:
+            return row["id"]
+    if run_row["fleet_id"] is not None:
+        return run_row["fleet_id"]
+    return await fleets_service.get_or_create_auto_fleet(
+        db, run_row["project_id"], run_row["run_name"]
+    )
+
+
+async def _handle_no_capacity(db: Database, run_row, job_rows: List, profile: Profile) -> None:
+    """No-capacity path: with an active retry window the gang stays queued; otherwise it
+    fails (reference exp-backoff re-processing happens naturally via the loop cadence)."""
+    retry = profile.retry
+    submitted = [r for r in job_rows if r["status"] == "submitted"]
+    if retry is not None and RetryEvent.NO_CAPACITY in retry.on_events:
+        oldest = min(from_iso(r["submitted_at"]) for r in job_rows)
+        if (now_utc() - oldest).total_seconds() < (retry.duration or 3600):
+            await db.executemany(
+                "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
+                [(to_iso(now_utc()), r["id"]) for r in submitted],
+            )
+            return
+    for r in job_rows:
+        await terminate_job(
+            db,
+            r,
+            JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
+            "no offers with capacity matched the requirements",
+        )
+
+
+# =====================================================================================
+# process_running_jobs
+
+
+async def process_running_jobs(db: Database, batch: Optional[int] = None) -> None:
+    batch = batch or settings.PROCESS_BATCH_SIZE
+    rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status IN ('provisioning', 'pulling', 'running')"
+        " ORDER BY last_processed_at LIMIT ?",
+        (batch,),
+    )
+    for row in rows:
+        async with get_locker().lock(f"run:{row['run_id']}"):
+            fresh = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (row["id"],))
+            if fresh is None or fresh["status"] not in ("provisioning", "pulling", "running"):
+                continue
+            try:
+                await _process_active_job(db, fresh)
+            except Exception:
+                logger.exception("job %s processing failed", row["id"])
+                await db.execute(
+                    "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
+                    (to_iso(now_utc()), row["id"]),
+                )
+
+
+async def _process_active_job(db: Database, job_row) -> None:
+    status = JobStatus(job_row["status"])
+    if status == JobStatus.PROVISIONING:
+        await _process_provisioning(db, job_row)
+    else:
+        await _process_pulling_or_running(db, job_row)
+
+
+async def _replica_rows(db: Database, job_row) -> List:
+    return await db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND submission_num = ?"
+        " ORDER BY job_num",
+        (job_row["run_id"], job_row["replica_num"], job_row["submission_num"]),
+    )
+
+
+async def _process_provisioning(db: Database, job_row) -> None:
+    """Wait for the whole gang to be placed and the runner to come up, then submit the
+    job spec + TPU cluster contract (reference _submit_job_to_runner :855)."""
+    replica = await _replica_rows(db, job_row)
+    spec = load_job_spec(job_row)
+
+    # Gang gate: every job of the replica must hold provisioning data first.
+    if any(r["status"] == "submitted" or not loads(r["job_provisioning_data"]) for r in replica):
+        await _check_provisioning_deadline(db, job_row)
+        return
+
+    run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (job_row["run_id"],))
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    conf = run_spec.configuration
+
+    # startup_order gating (reference _should_wait_for_other_nodes :402).
+    order = getattr(conf, "startup_order", StartupOrder.ANY)
+    if order == StartupOrder.MASTER_FIRST and spec.job_num != 0:
+        master = replica[0]
+        if master["status"] not in ("running",):
+            await _touch(db, job_row)
+            return
+    if order == StartupOrder.WORKERS_FIRST and spec.job_num == 0:
+        if any(r["status"] not in ("running",) for r in replica[1:]):
+            await _touch(db, job_row)
+            return
+
+    jpd = job_jpd(job_row)
+    jrd = job_jrd(job_row) or JobRuntimeData()
+    client = get_runner_client(jpd, jrd)
+    health = await client.healthcheck()
+    if health is None:
+        await _check_provisioning_deadline(db, job_row)
+        return
+
+    pairs = [(load_job_spec(r), job_jpd(r)) for r in replica]
+    hosts_per_slice = pairs[0][1].hosts_per_slice or 1
+    num_slices = max(1, len(pairs) // max(1, hosts_per_slice))
+    infos = build_cluster_info(pairs, num_slices=num_slices)
+    info = infos[spec.job_num]
+
+    secrets = await _project_secrets(db, job_row["project_id"])
+    await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
+    code = await _get_code(db, run_spec)
+    if code:
+        await client.upload_code(code)
+    await client.run_job()
+
+    if job_row["instance_id"]:
+        await db.execute(
+            "UPDATE instances SET status = 'busy' WHERE id = ? AND status = 'provisioning'",
+            (job_row["instance_id"],),
+        )
+    await db.execute(
+        "UPDATE jobs SET status = 'pulling', job_runtime_data = ?, last_processed_at = ?"
+        " WHERE id = ?",
+        (jrd.model_dump_json(), to_iso(now_utc()), job_row["id"]),
+    )
+
+
+async def _process_pulling_or_running(db: Database, job_row) -> None:
+    jpd = job_jpd(job_row)
+    jrd = job_jrd(job_row) or JobRuntimeData()
+    spec = load_job_spec(job_row)
+    client = get_runner_client(jpd, jrd)
+    try:
+        result = await client.pull(offset=jrd.pull_offset)
+    except Exception:
+        await _handle_runner_disconnect(db, job_row)
+        return
+    if result is None:
+        await _handle_runner_disconnect(db, job_row)
+        return
+    await db.execute(
+        "UPDATE jobs SET disconnected_at = NULL WHERE id = ?", (job_row["id"],)
+    )
+
+    run_row = await db.fetchone("SELECT run_name, project_id FROM runs WHERE id = ?", (job_row["run_id"],))
+    events = [
+        LogEvent.model_validate(
+            {"timestamp": ev.get("ts") or to_iso(now_utc()), "message": ev.get("message", ""),
+             "log_source": ev.get("source", "stdout")}
+        )
+        for ev in result.get("logs", [])
+    ]
+    if events:
+        logs_service.get_log_storage().write_logs(
+            job_row["project_id"], run_row["run_name"], job_row["id"], events
+        )
+
+    jrd.pull_offset = result.get("offset", jrd.pull_offset)
+    new_status: Optional[JobStatus] = None
+    reason: Optional[JobTerminationReason] = None
+    reason_msg: Optional[str] = None
+    exit_status: Optional[int] = None
+    for ev in result.get("job_states", []):
+        state = ev.get("state")
+        if state == "running":
+            new_status = JobStatus.RUNNING
+            if jrd.started_at is None:
+                jrd.started_at = now_utc()
+        elif state in ("done", "failed", "terminated", "aborted"):
+            new_status = JobStatus.TERMINATING
+            exit_status = ev.get("exit_status")
+            if state == "done":
+                reason = JobTerminationReason.DONE_BY_RUNNER
+            elif state == "failed":
+                reason = JobTerminationReason.CONTAINER_EXITED_WITH_ERROR
+                reason_msg = ev.get("message") or f"exit status {exit_status}"
+            else:
+                reason = JobTerminationReason.TERMINATED_BY_SERVER
+                reason_msg = ev.get("message")
+
+    now = to_iso(now_utc())
+    if new_status == JobStatus.TERMINATING:
+        await db.execute(
+            "UPDATE jobs SET status = 'terminating', termination_reason = ?,"
+            " termination_reason_message = ?, exit_status = ?, job_runtime_data = ?,"
+            " last_processed_at = ? WHERE id = ?",
+            (reason.value if reason else None, reason_msg, exit_status,
+             jrd.model_dump_json(), now, job_row["id"]),
+        )
+        return
+    status_val = (
+        new_status.value
+        if new_status is not None
+        else ("running" if job_row["status"] == "running" else job_row["status"])
+    )
+    await db.execute(
+        "UPDATE jobs SET status = ?, job_runtime_data = ?, last_processed_at = ? WHERE id = ?",
+        (status_val, jrd.model_dump_json(), now, job_row["id"]),
+    )
+
+    # max_duration enforcement, measured from the observed RUNNING transition so queue
+    # and provisioning time don't count against the run-time budget.
+    if spec.max_duration and jrd.started_at is not None:
+        if (now_utc() - jrd.started_at).total_seconds() > spec.max_duration:
+            await terminate_job(
+                db, job_row, JobTerminationReason.MAX_DURATION_EXCEEDED,
+                f"max_duration {spec.max_duration}s exceeded",
+            )
+
+
+async def _handle_runner_disconnect(db: Database, job_row) -> None:
+    """Tolerate transient runner unreachability; fail the job after the grace window
+    (reference process_running_jobs.py job_disconnected handling)."""
+    now = now_utc()
+    if job_row["disconnected_at"] is None:
+        await db.execute(
+            "UPDATE jobs SET disconnected_at = ?, last_processed_at = ? WHERE id = ?",
+            (to_iso(now), to_iso(now), job_row["id"]),
+        )
+        return
+    disconnected = from_iso(job_row["disconnected_at"])
+    if (now - disconnected).total_seconds() > settings.RUNNER_DISCONNECT_TIMEOUT:
+        await terminate_job(
+            db, job_row, JobTerminationReason.INSTANCE_UNREACHABLE,
+            f"runner unreachable for {settings.RUNNER_DISCONNECT_TIMEOUT}s",
+        )
+    else:
+        await _touch(db, job_row)
+
+
+async def _check_provisioning_deadline(db: Database, job_row) -> None:
+    submitted = from_iso(job_row["submitted_at"])
+    if (now_utc() - submitted).total_seconds() > settings.PROVISIONING_TIMEOUT:
+        await terminate_job(
+            db, job_row, JobTerminationReason.INSTANCE_UNREACHABLE,
+            f"instance did not become reachable within {settings.PROVISIONING_TIMEOUT}s",
+        )
+    else:
+        await _touch(db, job_row)
+
+
+async def _touch(db: Database, job_row) -> None:
+    await db.execute(
+        "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
+        (to_iso(now_utc()), job_row["id"]),
+    )
+
+
+async def _project_secrets(db: Database, project_id: str) -> Dict[str, str]:
+    rows = await db.fetchall("SELECT name, value FROM secrets WHERE project_id = ?", (project_id,))
+    from dstack_tpu.server.services.encryption import decrypt
+
+    return {r["name"]: decrypt(r["value"]) for r in rows}
+
+
+async def _get_code(db: Database, run_spec: RunSpec) -> Optional[bytes]:
+    repo_data = run_spec.repo_data or {}
+    code_hash = repo_data.get("code_hash")
+    if not run_spec.repo_id or not code_hash:
+        return None
+    row = await db.fetchone(
+        "SELECT c.blob FROM codes c JOIN repos r ON r.id = c.repo_id"
+        " WHERE r.name = ? AND c.blob_hash = ?",
+        (run_spec.repo_id, code_hash),
+    )
+    return row["blob"] if row else None
+
+
+# =====================================================================================
+# process_terminating_jobs
+
+
+async def process_terminating_jobs(db: Database, batch: Optional[int] = None) -> None:
+    batch = batch or settings.PROCESS_BATCH_SIZE
+    rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at LIMIT ?",
+        (batch,),
+    )
+    for row in rows:
+        async with get_locker().lock(f"run:{row['run_id']}"):
+            fresh = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (row["id"],))
+            if fresh is None or fresh["status"] != "terminating":
+                continue
+            try:
+                await _process_terminating_job(db, fresh)
+            except Exception:
+                logger.exception("terminating job %s failed", row["id"])
+
+
+async def _process_terminating_job(db: Database, job_row) -> None:
+    """Stop the runner best-effort, release the slice back to the pool, finalize status
+    (reference jobs/__init__.py:209 process_terminating_job)."""
+    jpd = job_jpd(job_row)
+    jrd = job_jrd(job_row)
+    reason = (
+        JobTerminationReason(job_row["termination_reason"])
+        if job_row["termination_reason"]
+        else JobTerminationReason.TERMINATED_BY_SERVER
+    )
+    if jpd is not None and job_row["status"] == "terminating":
+        client = get_runner_client(jpd, jrd)
+        try:
+            await client.stop(abort=reason == JobTerminationReason.ABORTED_BY_USER)
+        except Exception:
+            pass
+    if job_row["instance_id"]:
+        await instances_service.release_instance(db, job_row["instance_id"])
+        await db.execute(
+            "UPDATE jobs SET used_instance_id = instance_id, instance_id = NULL WHERE id = ?",
+            (job_row["id"],),
+        )
+    await set_job_status(db, job_row, reason.to_status(), reason)
+
+
+# =====================================================================================
+# process_runs
+
+
+async def process_runs(db: Database, batch: Optional[int] = None) -> None:
+    batch = batch or settings.PROCESS_BATCH_SIZE * 2
+    rows = await db.fetchall(
+        "SELECT * FROM runs WHERE deleted = 0 AND status NOT IN ('terminated', 'failed', 'done')"
+        " ORDER BY last_processed_at IS NOT NULL, last_processed_at LIMIT ?",
+        (batch,),
+    )
+    for row in rows:
+        async with get_locker().lock(f"run:{row['id']}"):
+            fresh = await db.fetchone("SELECT * FROM runs WHERE id = ?", (row["id"],))
+            if fresh is None or RunStatus(fresh["status"]).is_finished():
+                continue
+            try:
+                if fresh["status"] == "terminating":
+                    await _process_terminating_run(db, fresh)
+                else:
+                    await _process_active_run(db, fresh)
+            except Exception:
+                logger.exception("run %s processing failed", row["id"])
+            await db.execute(
+                "UPDATE runs SET last_processed_at = ? WHERE id = ?",
+                (to_iso(now_utc()), row["id"]),
+            )
+
+
+def _latest_submissions(job_rows: List) -> Dict[Tuple[int, int], object]:
+    latest: Dict[Tuple[int, int], object] = {}
+    for r in job_rows:
+        key = (r["replica_num"], r["job_num"])
+        cur = latest.get(key)
+        if cur is None or r["submission_num"] > cur["submission_num"]:
+            latest[key] = r
+    return latest
+
+
+async def _process_terminating_run(db: Database, run_row) -> None:
+    reason = (
+        RunTerminationReason(run_row["termination_reason"])
+        if run_row["termination_reason"]
+        else RunTerminationReason.STOPPED_BY_USER
+    )
+    job_rows = await db.fetchall("SELECT * FROM jobs WHERE run_id = ?", (run_row["id"],))
+    latest = _latest_submissions(job_rows)
+    active = [r for r in latest.values() if not JobStatus(r["status"]).is_finished()]
+    for r in active:
+        if r["status"] != "terminating":
+            await terminate_job(db, r, reason.to_job_termination_reason())
+    if not active:
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (reason.to_status().value, run_row["id"]),
+        )
+
+
+async def _process_active_run(db: Database, run_row) -> None:
+    """Aggregate job statuses into the run FSM; drive retries and stop criteria
+    (reference process_runs.py:212 _process_active_run)."""
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    conf = run_spec.configuration
+    profile = run_spec.merged_profile()
+    job_rows = await db.fetchall("SELECT * FROM jobs WHERE run_id = ?", (run_row["id"],))
+    latest = _latest_submissions(job_rows)
+
+    # Replica view: replica is done/failed as a unit.
+    replicas: Dict[int, List] = {}
+    for (replica_num, _), r in sorted(latest.items()):
+        replicas.setdefault(replica_num, []).append(r)
+
+    # stop_criteria: master-done ends the run when job 0 of replica 0 finishes OK
+    # (reference _should_stop_on_master_done :443).
+    if getattr(conf, "stop_criteria", None) == StopCriteria.MASTER_DONE:
+        master = latest.get((0, 0))
+        if master is not None and master["status"] == "done":
+            await _terminate_run(db, run_row, RunTerminationReason.ALL_JOBS_DONE)
+            return
+
+    any_failed_no_retry = False
+    for replica_num, rows in replicas.items():
+        failed = [r for r in rows if JobStatus(r["status"]) in (JobStatus.FAILED, JobStatus.ABORTED)]
+        if not failed:
+            continue
+        if await _maybe_retry_replica(db, run_row, profile, rows, failed):
+            continue
+        any_failed_no_retry = True
+    if any_failed_no_retry:
+        await _terminate_run(db, run_row, RunTerminationReason.JOB_FAILED)
+        return
+
+    statuses = [JobStatus(r["status"]) for r in latest.values()]
+    if statuses and all(s == JobStatus.DONE for s in statuses):
+        await _terminate_run(db, run_row, RunTerminationReason.ALL_JOBS_DONE)
+        return
+
+    new_status = RunStatus(run_row["status"])
+    if any(s == JobStatus.RUNNING for s in statuses):
+        new_status = RunStatus.RUNNING
+    elif any(s in (JobStatus.PROVISIONING, JobStatus.PULLING) for s in statuses):
+        new_status = RunStatus.PROVISIONING
+    if new_status != RunStatus(run_row["status"]):
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?", (new_status.value, run_row["id"])
+        )
+
+
+def _retry_delay(submission_num: int) -> float:
+    """Exponential backoff between resubmissions (reference _get_retry_delay :206)."""
+    return min(settings.RETRY_BACKOFF_BASE * (2 ** submission_num), settings.RETRY_BACKOFF_MAX)
+
+
+async def _maybe_retry_replica(
+    db: Database, run_row, profile: Profile, replica_rows: List, failed: List
+) -> bool:
+    """Gang retry: when any job of a replica fails retryably, the whole replica is
+    resubmitted together (a slice gang can't partially restart)."""
+    retry = profile.retry
+    if retry is None:
+        return False
+    for r in failed:
+        reason = (
+            JobTerminationReason(r["termination_reason"]) if r["termination_reason"] else None
+        )
+        event = _REASON_TO_RETRY_EVENT.get(reason)
+        if event is None or event not in retry.on_events:
+            return False
+    # Duration window is anchored at the replica's FIRST submission (submission_num 0),
+    # not the latest resubmission — otherwise every retry would reset the clock.
+    first_row = await db.fetchone(
+        "SELECT MIN(submitted_at) AS t FROM jobs WHERE run_id = ? AND replica_num = ?",
+        (run_row["id"], replica_rows[0]["replica_num"]),
+    )
+    first_submitted = from_iso(first_row["t"])
+    if (now_utc() - first_submitted).total_seconds() > (retry.duration or 3600):
+        await _terminate_run(db, run_row, RunTerminationReason.RETRY_LIMIT_EXCEEDED)
+        return True  # handled (run is terminating)
+
+    active = [r for r in replica_rows if not JobStatus(r["status"]).is_finished()]
+    for r in active:
+        await terminate_job(db, r, JobTerminationReason.TERMINATED_BY_SERVER, "gang retry")
+    if active:
+        return True  # wait for teardown; resubmit next pass
+
+    last_finished = max(
+        (from_iso(r["finished_at"]) for r in failed if r["finished_at"]), default=None
+    )
+    submission_num = max(r["submission_num"] for r in replica_rows)
+    if last_finished is not None and (now_utc() - last_finished).total_seconds() < _retry_delay(
+        submission_num
+    ):
+        return True  # backoff window
+
+    now = to_iso(now_utc())
+    for r in replica_rows:
+        await db.execute(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+            " submission_num, job_spec, status, submitted_at)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
+            (
+                new_id(),
+                r["project_id"],
+                r["run_id"],
+                r["run_name"],
+                r["job_num"],
+                r["replica_num"],
+                submission_num + 1,
+                r["job_spec"],
+                now,
+            ),
+        )
+    logger.info(
+        "run %s: retrying replica %s (submission %s)",
+        run_row["run_name"], replica_rows[0]["replica_num"], submission_num + 1,
+    )
+    return True
+
+
+async def _terminate_run(db: Database, run_row, reason: RunTerminationReason) -> None:
+    await db.execute(
+        "UPDATE runs SET status = 'terminating', termination_reason = ? WHERE id = ?",
+        (reason.value, run_row["id"]),
+    )
+    run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_row["id"],))
+    await _process_terminating_run(db, run_row)
+
+
+# =====================================================================================
+# process_instances
+
+
+async def process_instances(db: Database, batch: Optional[int] = None) -> None:
+    batch = batch or settings.PROCESS_BATCH_SIZE * 2
+    rows = await db.fetchall(
+        "SELECT * FROM instances WHERE deleted = 0 AND status NOT IN ('terminated')"
+        " ORDER BY last_processed_at IS NOT NULL, last_processed_at LIMIT ?",
+        (batch,),
+    )
+    for row in rows:
+        try:
+            await _process_instance(db, row)
+        except Exception:
+            logger.exception("instance %s processing failed", row["id"])
+        await db.execute(
+            "UPDATE instances SET last_processed_at = ? WHERE id = ?",
+            (to_iso(now_utc()), row["id"]),
+        )
+    await _cleanup_auto_fleets(db)
+
+
+async def _process_instance(db: Database, row) -> None:
+    status = InstanceStatus(row["status"])
+    if status == InstanceStatus.PENDING:
+        await _provision_pending_instance(db, row)
+        return
+    if status == InstanceStatus.PROVISIONING and row["busy_blocks"] == 0:
+        # Unassigned slice coming up (fleet-provisioned, or released by a job before it
+        # was ready): poll the runner; pool it as idle once reachable.
+        jpd = loads(row["job_provisioning_data"])
+        healthy = None
+        if jpd:
+            from dstack_tpu.core.models.runs import JobProvisioningData
+
+            client = get_runner_client(JobProvisioningData.model_validate(jpd), None)
+            healthy = await client.healthcheck()
+        if healthy is not None:
+            await db.execute(
+                "UPDATE instances SET status = 'idle', idle_since = ? WHERE id = ?",
+                (to_iso(now_utc()), row["id"]),
+            )
+        elif (now_utc() - from_iso(row["created_at"])).total_seconds() > settings.PROVISIONING_TIMEOUT:
+            await db.execute(
+                "UPDATE instances SET status = 'terminating', termination_reason = ?"
+                " WHERE id = ?",
+                ("did not become reachable while provisioning", row["id"]),
+            )
+        return
+    if status == InstanceStatus.IDLE:
+        await _check_idle_expiry(db, row)
+        return
+    if status == InstanceStatus.TERMINATING:
+        await _terminate_slice_when_drained(db, row)
+
+
+async def _provision_pending_instance(db: Database, row) -> None:
+    """Provision a cloud fleet's pending slice marker: one marker row becomes the
+    slice's worker rows (reference process_instances.py:457 _create_instance)."""
+    if row["remote_connection_info"]:
+        return  # SSH-fleet host; provisioned by the SSH provisioner (separate milestone)
+    if row["fleet_id"] is None:
+        return
+    fleet_row = await db.fetchone("SELECT * FROM fleets WHERE id = ?", (row["fleet_id"],))
+    if fleet_row is None:
+        return
+    from dstack_tpu.core.models.fleets import FleetSpec
+    from dstack_tpu.core.models.runs import Requirements
+
+    spec = FleetSpec.model_validate(loads(fleet_row["spec"]))
+    conf = spec.configuration
+    project_row = await db.fetchone("SELECT * FROM projects WHERE id = ?", (row["project_id"],))
+    requirements = Requirements(resources=conf.resources)
+    profile = fleets_service.fleet_profile(conf)
+    offers = await offers_service.get_offers_by_requirements(
+        db, project_row, requirements, profile
+    )
+    offers = [o for o in offers if o.availability.is_available()]
+    for offer in offers[: settings.MAX_OFFERS_TRIED]:
+        try:
+            compute = await backends_service.get_compute(db, project_row, offer.backend)
+        except Exception:
+            continue
+        try:
+            jpds = await compute.create_slice(offer, row["name"])
+        except BackendError as e:
+            logger.debug("fleet %s offer failed: %s", fleet_row["name"], e)
+            continue
+        # The marker becomes worker 0; extra workers get their own rows.
+        await db.execute(
+            "UPDATE instances SET status = 'provisioning', backend = ?, region = ?,"
+            " availability_zone = ?, price = ?, instance_type = ?, offer = ?,"
+            " job_provisioning_data = ?, slice_id = ?, slice_name = ?, worker_num = 0,"
+            " hosts_per_slice = ? WHERE id = ?",
+            (
+                jpds[0].backend,
+                jpds[0].region,
+                jpds[0].availability_zone,
+                jpds[0].price,
+                jpds[0].instance_type.model_dump_json(),
+                offer.model_dump_json(),
+                jpds[0].model_dump_json(),
+                jpds[0].slice_id,
+                jpds[0].slice_name,
+                jpds[0].hosts_per_slice,
+                row["id"],
+            ),
+        )
+        if len(jpds) > 1:
+            await instances_service.create_slice_instances(
+                db,
+                row["project_id"],
+                row["fleet_id"],
+                row["name"],
+                jpds[1:],
+                offer,
+                status=InstanceStatus.PROVISIONING,
+            )
+        await db.execute(
+            "UPDATE fleets SET status = 'active' WHERE id = ? AND status = 'submitted'",
+            (row["fleet_id"],),
+        )
+        return
+    logger.info("fleet %s: no capacity for pending instance %s", fleet_row["name"], row["name"])
+
+
+async def _check_idle_expiry(db: Database, row) -> None:
+    idle_since = from_iso(row["idle_since"]) if row["idle_since"] else from_iso(row["created_at"])
+    idle_duration = row["idle_duration"]
+    if idle_duration is None:
+        idle_duration = DEFAULT_RUN_TERMINATION_IDLE_TIME
+    if idle_duration < 0:  # dont-destroy
+        return
+    if (now_utc() - idle_since).total_seconds() > idle_duration:
+        # The whole slice retires together (it is one cloud resource).
+        if row["slice_id"]:
+            await db.execute(
+                "UPDATE instances SET status = 'terminating', termination_reason = ?"
+                " WHERE slice_id = ? AND deleted = 0 AND status = 'idle'",
+                (f"idle for more than {idle_duration}s", row["slice_id"]),
+            )
+        else:
+            await db.execute(
+                "UPDATE instances SET status = 'terminating', termination_reason = ?"
+                " WHERE id = ?",
+                (f"idle for more than {idle_duration}s", row["id"]),
+            )
+
+
+async def _terminate_slice_when_drained(db: Database, row) -> None:
+    """A slice is one cloud resource: call terminate once, after every worker row of the
+    slice has reached TERMINATING (SURVEY §7 hard part (a))."""
+    slice_id = row["slice_id"]
+    if slice_id:
+        workers = await db.fetchall(
+            "SELECT * FROM instances WHERE slice_id = ? AND deleted = 0", (slice_id,)
+        )
+        if any(w["status"] not in ("terminating", "terminated") for w in workers):
+            return
+    else:
+        workers = [row]
+    if row["worker_num"] != 0:
+        return  # worker 0 owns the cloud call
+    backend_type = row["backend"]
+    if backend_type and backend_type != "ssh":  # ssh hosts have no cloud resource
+        project_row = await db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        try:
+            compute = await backends_service.get_compute(db, project_row, backend_type)
+            jpd = loads(row["job_provisioning_data"]) or {}
+            await compute.terminate_slice(
+                slice_id or row["id"], row["region"] or "", jpd.get("backend_data")
+            )
+        except Exception as e:
+            logger.warning("terminate slice %s failed: %s", slice_id, e)
+            deadline = row["termination_deadline"]
+            ids = [w["id"] for w in workers]
+            if deadline is None:
+                await db.execute(
+                    f"UPDATE instances SET termination_deadline = ? WHERE id IN"
+                    f" ({','.join('?' for _ in ids)})",
+                    [to_iso(now_utc()), *ids],
+                )
+                return
+            if (now_utc() - from_iso(deadline)).total_seconds() < settings.TERMINATION_RETRY_WINDOW:
+                return  # retry next pass; give up after the window to avoid a stuck row
+    now = to_iso(now_utc())
+    ids = [w["id"] for w in workers]
+    await db.execute(
+        f"UPDATE instances SET status = 'terminated', finished_at = ? WHERE id IN"
+        f" ({','.join('?' for _ in ids)})",
+        [now, *ids],
+    )
+
+
+async def _cleanup_auto_fleets(db: Database) -> None:
+    await db.execute(
+        "UPDATE fleets SET deleted = 1, status = 'terminated' WHERE auto_created = 1"
+        " AND deleted = 0 AND NOT EXISTS (SELECT 1 FROM instances i WHERE i.fleet_id ="
+        " fleets.id AND i.deleted = 0 AND i.status != 'terminated')"
+        " AND NOT EXISTS (SELECT 1 FROM runs r WHERE r.fleet_id = fleets.id AND r.deleted = 0"
+        " AND r.status NOT IN ('terminated', 'failed', 'done'))",
+    )

@@ -1,0 +1,111 @@
+"""At-rest encryption of secrets/tokens.
+
+Parity: reference server/services/encryption/ (identity + AES keys, key rotation
+encryption/__init__.py:70-83). Default is the identity codec (plaintext, tagged);
+AES-256-GCM is used when a key is configured. Values are tagged with the key name so
+rotation can decrypt old rows while encrypting new ones with the head key.
+
+Wire format: ``enc:<codec>:<key-name>:<base64 payload>``.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Dict, List, Optional, Tuple
+
+_PREFIX = "enc"
+
+
+class EncryptionKey:
+    NAME = "identity"
+
+    def encrypt(self, plaintext: str) -> str:
+        raise NotImplementedError
+
+    def decrypt(self, payload: str) -> str:
+        raise NotImplementedError
+
+
+class IdentityKey(EncryptionKey):
+    NAME = "identity"
+
+    def __init__(self, name: str = "noname"):
+        self.name = name
+
+    def encrypt(self, plaintext: str) -> str:
+        return base64.b64encode(plaintext.encode()).decode()
+
+    def decrypt(self, payload: str) -> str:
+        return base64.b64decode(payload).decode()
+
+
+class AesGcmKey(EncryptionKey):
+    NAME = "aes"
+
+    def __init__(self, secret_b64: str, name: str = "default"):
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError("aes encryption requires the `cryptography` package") from e
+        self._aesgcm = AESGCM(base64.b64decode(secret_b64))
+        self.name = name
+
+    def encrypt(self, plaintext: str) -> str:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM  # noqa: F401
+
+        nonce = os.urandom(12)
+        ct = self._aesgcm.encrypt(nonce, plaintext.encode(), None)
+        return base64.b64encode(nonce + ct).decode()
+
+    def decrypt(self, payload: str) -> str:
+        raw = base64.b64decode(payload)
+        return self._aesgcm.decrypt(raw[:12], raw[12:], None).decode()
+
+
+# Head key encrypts; all keys can decrypt (rotation).
+_keys: List[EncryptionKey] = [IdentityKey()]
+
+
+def configure_keys(specs: List[dict]) -> None:
+    """specs: [{type: aes, secret: <b64 32 bytes>, name: k1} | {type: identity}]."""
+    keys: List[EncryptionKey] = []
+    for spec in specs:
+        t = spec.get("type", "identity")
+        if t == "aes":
+            keys.append(AesGcmKey(spec["secret"], spec.get("name", "default")))
+        elif t == "identity":
+            keys.append(IdentityKey(spec.get("name", "noname")))
+        else:
+            raise ValueError(f"unknown encryption key type {t!r}")
+    if not keys:
+        keys = [IdentityKey()]
+    global _keys
+    _keys = keys
+
+
+def reset_keys() -> None:
+    global _keys
+    _keys = [IdentityKey()]
+
+
+def encrypt(plaintext: str) -> str:
+    key = _keys[0]
+    return f"{_PREFIX}:{key.NAME}:{key.name}:{key.encrypt(plaintext)}"
+
+
+def decrypt(value: str) -> str:
+    if not value.startswith(_PREFIX + ":"):
+        return value  # legacy plaintext rows
+    _, codec, key_name, payload = value.split(":", 3)
+    for key in _keys:
+        if key.NAME == codec and (key.name == key_name or codec == "identity"):
+            return key.decrypt(payload)
+    # Fall back to any key of the right codec (rotated name mismatch).
+    for key in _keys:
+        if key.NAME == codec:
+            try:
+                return key.decrypt(payload)
+            except Exception:
+                continue
+    raise ValueError(f"no encryption key can decrypt codec={codec} name={key_name}")

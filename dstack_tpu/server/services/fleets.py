@@ -1,0 +1,236 @@
+"""Fleets service: declarative fleet CRUD + run auto-fleets.
+
+Parity: reference server/services/fleets.py (get_plan:232, create_fleet:338). A fleet is
+a named pool of slices; cloud fleets declare `nodes` x a slice resource spec, SSH fleets
+enumerate user hosts. Runs auto-create a fleet per run when none is targeted (reference
+process_submitted_jobs.py:490)."""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional
+
+from dstack_tpu.core.errors import (
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerClientError,
+)
+from dstack_tpu.core.models.fleets import (
+    ApplyFleetPlanInput,
+    Fleet,
+    FleetPlan,
+    FleetSpec,
+    FleetStatus,
+)
+from dstack_tpu.core.models.instances import InstanceStatus
+from dstack_tpu.server.db import Database, loads, new_id
+from dstack_tpu.server.services import instances as instances_service
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+
+def fleet_profile(conf):
+    """Scheduling profile implied by a FleetConfiguration's inline fields."""
+    from dstack_tpu.core.models.profiles import Profile
+
+    return Profile.model_validate(
+        {
+            k: v
+            for k, v in dict(
+                backends=conf.backends,
+                regions=conf.regions,
+                availability_zones=conf.availability_zones,
+                instance_types=conf.instance_types,
+                spot_policy=conf.spot_policy,
+                max_price=conf.max_price,
+                reservation=conf.reservation,
+            ).items()
+            if v is not None
+        }
+    )
+
+
+async def row_to_fleet(db: Database, row, project_name: str = "") -> Fleet:
+    instance_rows = await db.fetchall(
+        "SELECT * FROM instances WHERE fleet_id = ? AND deleted = 0 ORDER BY instance_num",
+        (row["id"],),
+    )
+    return Fleet(
+        id=uuid.UUID(row["id"]),
+        name=row["name"],
+        project_name=project_name,
+        spec=FleetSpec.model_validate(loads(row["spec"])),
+        created_at=from_iso(row["created_at"]),
+        status=FleetStatus(row["status"]),
+        status_message=row["status_message"],
+        instances=[
+            instances_service.row_to_instance(r, project_name, fleet_name=row["name"])
+            for r in instance_rows
+        ],
+    )
+
+
+async def get_fleet_row(db: Database, project_id: str, name: str):
+    return await db.fetchone(
+        "SELECT * FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, name),
+    )
+
+
+async def list_fleets(db: Database, project_row) -> List[Fleet]:
+    rows = await db.fetchall(
+        "SELECT * FROM fleets WHERE project_id = ? AND deleted = 0 ORDER BY created_at",
+        (project_row["id"],),
+    )
+    return [await row_to_fleet(db, r, project_row["name"]) for r in rows]
+
+
+async def get_fleet(db: Database, project_row, name: str) -> Fleet:
+    row = await get_fleet_row(db, project_row["id"], name)
+    if row is None:
+        raise ResourceNotExistsError(f"fleet {name} not found")
+    return await row_to_fleet(db, row, project_row["name"])
+
+
+async def get_plan(db: Database, project_row, user_row, spec: FleetSpec) -> FleetPlan:
+    from dstack_tpu.server.services import offers as offers_service
+    from dstack_tpu.core.models.runs import Requirements
+
+    conf = spec.configuration
+    effective_name = conf.name or f"fleet-{new_id()[:8]}"
+    offers = []
+    total = 0
+    max_price = None
+    if conf.ssh_config is None and conf.resources is not None:
+        req = Requirements(resources=conf.resources, spot=None)
+        offer_list = await offers_service.get_offers_by_requirements(
+            db, project_row, req, fleet_profile(conf)
+        )
+        offers = [o.model_dump(mode="json") for o in offer_list[:50]]
+        total = len(offer_list)
+        max_price = max((o.price for o in offer_list), default=None)
+    current = None
+    action = "create"
+    row = await get_fleet_row(db, project_row["id"], effective_name) if conf.name else None
+    if row is not None:
+        current = await row_to_fleet(db, row, project_row["name"])
+        action = "update"
+    return FleetPlan(
+        project_name=project_row["name"],
+        user=user_row["username"],
+        spec=spec,
+        effective_name=effective_name,
+        current_resource=current,
+        offers=offers,
+        total_offers=total,
+        max_offer_price=max_price,
+        action=action,
+    )
+
+
+async def create_fleet(db: Database, project_row, user_row, spec: FleetSpec) -> Fleet:
+    conf = spec.configuration
+    name = conf.name or f"fleet-{new_id()[:8]}"
+    existing = await get_fleet_row(db, project_row["id"], name)
+    if existing is not None:
+        raise ResourceExistsError(f"fleet {name} already exists")
+    fleet_id = new_id()
+    now = to_iso(now_utc())
+    await db.execute(
+        "INSERT INTO fleets (id, project_id, name, status, spec, created_at, auto_created)"
+        " VALUES (?, ?, ?, ?, ?, ?, 0)",
+        (fleet_id, project_row["id"], name, FleetStatus.SUBMITTED.value, spec.model_dump_json(), now),
+    )
+    if conf.ssh_config is not None:
+        # SSH fleet: one instance row per user-supplied host, provisioned by
+        # process_instances (shim upload over SSH).
+        for num, host in enumerate(conf.ssh_config.hosts):
+            await db.execute(
+                "INSERT INTO instances (id, project_id, fleet_id, name, instance_num,"
+                " status, created_at, backend, remote_connection_info)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, 'ssh', ?)",
+                (
+                    new_id(),
+                    project_row["id"],
+                    fleet_id,
+                    f"{name}-{num}",
+                    num,
+                    InstanceStatus.PENDING.value,
+                    now,
+                    host.model_dump_json(),
+                ),
+            )
+    else:
+        # Cloud fleet: `nodes` pending markers; process_fleets provisions slices.
+        nodes = conf.nodes.min or 0
+        for num in range(nodes):
+            await db.execute(
+                "INSERT INTO instances (id, project_id, fleet_id, name, instance_num,"
+                " status, created_at) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (
+                    new_id(),
+                    project_row["id"],
+                    fleet_id,
+                    f"{name}-{num}",
+                    num,
+                    InstanceStatus.PENDING.value,
+                    now,
+                ),
+            )
+    row = await db.fetchone("SELECT * FROM fleets WHERE id = ?", (fleet_id,))
+    return await row_to_fleet(db, row, project_row["name"])
+
+
+async def apply_plan(db: Database, project_row, user_row, plan: ApplyFleetPlanInput) -> Fleet:
+    conf = plan.spec.configuration
+    if conf.name:
+        existing = await get_fleet_row(db, project_row["id"], conf.name)
+        if existing is not None:
+            if not plan.force and loads(existing["spec"]) == loads(plan.spec.model_dump_json()):
+                return await row_to_fleet(db, existing, project_row["name"])
+            await _soft_delete_fleet(db, existing)
+    return await create_fleet(db, project_row, user_row, plan.spec)
+
+
+async def delete_fleets(db: Database, project_row, names: List[str]) -> None:
+    for name in names:
+        row = await get_fleet_row(db, project_row["id"], name)
+        if row is None:
+            raise ResourceNotExistsError(f"fleet {name} not found")
+        busy = await db.fetchone(
+            "SELECT COUNT(*) AS n FROM instances WHERE fleet_id = ? AND deleted = 0"
+            " AND busy_blocks > 0",
+            (row["id"],),
+        )
+        if busy["n"] > 0:
+            raise ServerClientError(f"fleet {name} has busy instances; stop runs first")
+        await db.execute(
+            "UPDATE fleets SET status = ? WHERE id = ?",
+            (FleetStatus.TERMINATING.value, row["id"]),
+        )
+        await db.execute(
+            "UPDATE instances SET status = 'terminating', termination_reason = 'fleet deleted'"
+            " WHERE fleet_id = ? AND deleted = 0 AND status NOT IN ('terminating', 'terminated')",
+            (row["id"],),
+        )
+
+
+async def _soft_delete_fleet(db: Database, row) -> None:
+    await db.execute("UPDATE fleets SET deleted = 1 WHERE id = ?", (row["id"],))
+
+
+async def get_or_create_auto_fleet(db: Database, project_id: str, run_name: str) -> str:
+    """Run-scoped fleet for instances provisioned on demand (no fleet targeted)."""
+    row = await db.fetchone(
+        "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, run_name),
+    )
+    if row is not None:
+        return row["id"]
+    fleet_id = new_id()
+    spec = FleetSpec.model_validate({"configuration": {"type": "fleet", "name": run_name}})
+    await db.execute(
+        "INSERT INTO fleets (id, project_id, name, status, spec, created_at, auto_created)"
+        " VALUES (?, ?, ?, 'active', ?, ?, 1)",
+        (fleet_id, project_id, run_name, spec.model_dump_json(), to_iso(now_utc())),
+    )
+    return fleet_id

@@ -1,0 +1,215 @@
+"""Instance pool service: slice-aware creation, matching, release.
+
+Parity: reference server/services/instances.py (filter_pool_instances:130,
+create_instance_model:407). TPU twist (SURVEY §7 hard part (a)): one cloud *slice* backs
+`hosts_per_slice` instance rows sharing `slice_id`; pool matching returns whole idle
+slices, never individual workers, so gang placement is atomic."""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import List, Optional
+
+from dstack_tpu.core.models.instances import (
+    Instance,
+    InstanceOffer,
+    InstanceStatus,
+    InstanceType,
+)
+from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.server.db import Database, loads, new_id
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+
+def row_to_instance(row, project_name: str = "", fleet_name: Optional[str] = None) -> Instance:
+    itype = loads(row["instance_type"])
+    return Instance(
+        id=uuid.UUID(row["id"]),
+        project_name=project_name,
+        backend=row["backend"],
+        instance_type=InstanceType.model_validate(itype) if itype else None,
+        name=row["name"],
+        fleet_id=uuid.UUID(row["fleet_id"]) if row["fleet_id"] else None,
+        fleet_name=fleet_name,
+        instance_num=row["instance_num"],
+        hostname=_jpd_hostname(row),
+        status=InstanceStatus(row["status"]),
+        unreachable=bool(row["unreachable"]),
+        termination_reason=row["termination_reason"],
+        created=from_iso(row["created_at"]),
+        region=row["region"],
+        availability_zone=row["availability_zone"],
+        price=row["price"],
+        slice_id=row["slice_id"],
+        slice_name=row["slice_name"],
+        worker_num=row["worker_num"],
+        hosts_per_slice=row["hosts_per_slice"],
+        total_blocks=row["total_blocks"],
+        busy_blocks=row["busy_blocks"],
+    )
+
+
+def _jpd_hostname(row) -> Optional[str]:
+    jpd = loads(row["job_provisioning_data"])
+    if jpd:
+        return jpd.get("hostname")
+    return None
+
+
+async def create_slice_instances(
+    db: Database,
+    project_id: str,
+    fleet_id: Optional[str],
+    name_base: str,
+    jpds: List[JobProvisioningData],
+    offer: InstanceOffer,
+    status: InstanceStatus = InstanceStatus.PROVISIONING,
+    instance_num_start: int = 0,
+) -> List[str]:
+    """Insert one instance row per slice worker; all rows share slice_id. Returns ids in
+    worker order."""
+    now = to_iso(now_utc())
+    ids: List[str] = []
+    rows = []
+    for jpd in jpds:
+        iid = new_id()
+        ids.append(iid)
+        rows.append(
+            (
+                iid,
+                project_id,
+                fleet_id,
+                f"{name_base}-{jpd.worker_num}" if jpd.hosts_per_slice > 1 else name_base,
+                instance_num_start + jpd.worker_num,
+                status.value,
+                now,
+                now,
+                jpd.backend,
+                jpd.region,
+                jpd.availability_zone,
+                jpd.price if jpd.worker_num == 0 else 0.0,  # price is per-slice; bill on worker 0
+                jpd.instance_type.model_dump_json(),
+                offer.model_dump_json(),
+                jpd.model_dump_json(),
+                jpd.slice_id,
+                jpd.slice_name,
+                jpd.worker_num,
+                jpd.hosts_per_slice,
+            )
+        )
+    await db.executemany(
+        "INSERT INTO instances (id, project_id, fleet_id, name, instance_num, status,"
+        " created_at, last_processed_at, backend, region, availability_zone, price,"
+        " instance_type, offer, job_provisioning_data, slice_id, slice_name, worker_num,"
+        " hosts_per_slice) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        rows,
+    )
+    return ids
+
+
+async def find_idle_slices(
+    db: Database,
+    project_id: str,
+    requirements: Requirements,
+    slice_name: Optional[str],
+    hosts_per_slice: int,
+    fleet_ids: Optional[List[str]] = None,
+    profile=None,
+) -> List[List]:
+    """Idle slices matching a job's requirements: every worker row idle, worker count
+    complete, host resources sufficient (parity: reference filter_pool_instances
+    instances.py:130). Returns a list of slices; each slice is its instance rows in
+    worker order."""
+    sql = (
+        "SELECT * FROM instances WHERE project_id = ? AND deleted = 0"
+        " AND status = 'idle' AND busy_blocks = 0 AND unreachable = 0"
+    )
+    params: list = [project_id]
+    if slice_name is not None:
+        sql += " AND slice_name = ?"
+        params.append(slice_name)
+    else:
+        sql += " AND (slice_name IS NULL OR slice_name = '')"
+    if fleet_ids:
+        sql += f" AND fleet_id IN ({','.join('?' for _ in fleet_ids)})"
+        params.extend(fleet_ids)
+    sql += " ORDER BY slice_id, worker_num"
+    rows = await db.fetchall(sql, params)
+
+    by_slice: dict = {}
+    for r in rows:
+        by_slice.setdefault(r["slice_id"] or r["id"], []).append(r)
+    result = []
+    for workers in by_slice.values():
+        if len(workers) != hosts_per_slice:
+            continue
+        if not _slice_matches(workers[0], requirements, profile):
+            continue
+        result.append(workers)
+    return result
+
+
+def _slice_matches(worker_row, requirements: Requirements, profile) -> bool:
+    offer = loads(worker_row["offer"]) or {}
+    if requirements.spot is not None and bool(offer.get("spot")) != requirements.spot:
+        return False
+    price = worker_row["price"] or 0.0
+    if requirements.max_price is not None and price > requirements.max_price:
+        return False
+    if profile is not None:
+        if profile.backends and worker_row["backend"] not in profile.backends:
+            return False
+        if profile.regions and worker_row["region"] not in profile.regions:
+            return False
+        if profile.max_price is not None and price > profile.max_price:
+            return False
+    itype = loads(worker_row["instance_type"]) or {}
+    host = itype.get("resources") or {}
+    res = requirements.resources
+    if res.cpu.count.min is not None and (host.get("cpus") or 0) < res.cpu.count.min:
+        return False
+    if res.memory.min is not None and (host.get("memory_gb") or 0.0) < res.memory.min:
+        return False
+    if (
+        res.disk is not None
+        and res.disk.size.min is not None
+        and (host.get("disk_gb") or 0.0) < res.disk.size.min
+    ):
+        return False
+    return True
+
+
+async def mark_slice_busy(db: Database, instance_ids: List[str]) -> None:
+    q = ",".join("?" for _ in instance_ids)
+    await db.execute(
+        f"UPDATE instances SET status = 'busy', busy_blocks = 1, idle_since = NULL"
+        f" WHERE id IN ({q})",
+        instance_ids,
+    )
+
+
+async def release_instance(db: Database, instance_id: str) -> None:
+    await db.execute(
+        "UPDATE instances SET busy_blocks = 0, idle_since = ?,"
+        " status = CASE WHEN status = 'busy' THEN 'idle' ELSE status END"
+        " WHERE id = ?",
+        (to_iso(now_utc()), instance_id),
+    )
+
+
+async def list_instances(
+    db: Database,
+    project_id: Optional[str] = None,
+    statuses: Optional[List[str]] = None,
+) -> List:
+    sql = "SELECT * FROM instances WHERE deleted = 0"
+    params: list = []
+    if project_id is not None:
+        sql += " AND project_id = ?"
+        params.append(project_id)
+    if statuses:
+        sql += f" AND status IN ({','.join('?' for _ in statuses)})"
+        params.extend(statuses)
+    sql += " ORDER BY created_at, worker_num"
+    return await db.fetchall(sql, params)

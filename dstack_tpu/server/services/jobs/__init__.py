@@ -1,0 +1,123 @@
+"""Job FSM helpers shared by the background loops.
+
+Parity: reference server/services/jobs/__init__.py (job_model_to_job_submission:109,
+process_terminating_job:209)."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from dstack_tpu.core.models.runs import (
+    ClusterInfo,
+    JobProvisioningData,
+    JobRuntimeData,
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+)
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.utils.common import now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_JAX_COORDINATOR_PORT = 8476
+DEFAULT_MEGASCALE_PORT = 8081
+
+
+def job_jpd(row) -> Optional[JobProvisioningData]:
+    data = loads(row["job_provisioning_data"])
+    return JobProvisioningData.model_validate(data) if data else None
+
+
+def job_jrd(row) -> Optional[JobRuntimeData]:
+    data = loads(row["job_runtime_data"])
+    return JobRuntimeData.model_validate(data) if data else None
+
+
+def job_spec(row) -> JobSpec:
+    return JobSpec.model_validate(loads(row["job_spec"]))
+
+
+async def set_job_status(
+    db: Database,
+    job_row,
+    status: JobStatus,
+    reason: Optional[JobTerminationReason] = None,
+    reason_message: Optional[str] = None,
+    exit_status: Optional[int] = None,
+) -> None:
+    now = to_iso(now_utc())
+    finished = now if status.is_finished() else None
+    await db.execute(
+        "UPDATE jobs SET status = ?,"
+        " termination_reason = COALESCE(?, termination_reason),"
+        " termination_reason_message = COALESCE(?, termination_reason_message),"
+        " exit_status = COALESCE(?, exit_status),"
+        " last_processed_at = ?, finished_at = COALESCE(finished_at, ?)"
+        " WHERE id = ?",
+        (
+            status.value,
+            reason.value if reason else None,
+            reason_message,
+            exit_status,
+            now,
+            finished,
+            job_row["id"],
+        ),
+    )
+
+
+async def terminate_job(
+    db: Database,
+    job_row,
+    reason: JobTerminationReason,
+    reason_message: Optional[str] = None,
+) -> None:
+    """Move an active job into TERMINATING; process_terminating_jobs finishes it."""
+    if JobStatus(job_row["status"]).is_finished():
+        return
+    await set_job_status(db, job_row, JobStatus.TERMINATING, reason, reason_message)
+
+
+def build_cluster_info(
+    specs_and_jpds: List[tuple],
+    num_slices: int = 1,
+) -> List[ClusterInfo]:
+    """Cluster contract for one replica: one ClusterInfo per job (SURVEY §2.6).
+
+    `specs_and_jpds` is [(JobSpec, JobProvisioningData)] ordered by job_num; jobs are
+    grouped into slices of jpd.hosts_per_slice workers. The JAX coordinator is worker 0
+    of slice 0; MegaScale coordination (multislice) also anchors there."""
+    if not specs_and_jpds:
+        return []
+    ips = [jpd.internal_ip or jpd.hostname or "" for _, jpd in specs_and_jpds]
+    master_ip = ips[0]
+    hosts_per_slice = specs_and_jpds[0][1].hosts_per_slice or 1
+    first = specs_and_jpds[0][1]
+    tpu = first.instance_type.resources.tpu
+    infos: List[ClusterInfo] = []
+    for (spec, jpd), ip in zip(specs_and_jpds, ips):
+        slice_idx = spec.job_num // hosts_per_slice
+        worker_id = spec.job_num % hosts_per_slice
+        slice_ips = ips[slice_idx * hosts_per_slice : (slice_idx + 1) * hosts_per_slice]
+        infos.append(
+            ClusterInfo(
+                master_node_ip=master_ip,
+                node_ips=ips,
+                nodes_num=len(specs_and_jpds),
+                node_rank=spec.job_num,
+                tpu_worker_id=worker_id,
+                tpu_worker_hostnames=slice_ips,
+                tpu_topology=(tpu.topology if tpu else None),
+                tpu_generation=(tpu.generation if tpu else None),
+                chips_per_host=(tpu.chips // max(1, tpu.hosts) if tpu and tpu.chips else 0),
+                num_slices=num_slices,
+                slice_id=slice_idx,
+                coordinator_address=f"{master_ip}:{DEFAULT_JAX_COORDINATOR_PORT}",
+                megascale_coordinator_address=(
+                    f"{master_ip}:{DEFAULT_MEGASCALE_PORT}" if num_slices > 1 else None
+                ),
+            )
+        )
+    return infos

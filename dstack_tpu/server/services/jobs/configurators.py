@@ -49,17 +49,19 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
     run_name = run_spec.run_name or "run"
 
     if conf.resources.tpu is not None:
-        jobs_per_replica = conf.resources.tpu.hosts
+        # One job per slice host; multislice (`tpu.count > 1`) multiplies the gang.
+        num_slices = conf.resources.tpu.count.min or 1
+        jobs_per_replica = conf.resources.tpu.hosts * num_slices
     elif isinstance(conf, TaskConfiguration) and conf.nodes > 0:
         jobs_per_replica = conf.nodes
     else:
         jobs_per_replica = 1
 
     if isinstance(conf, TaskConfiguration) and conf.nodes > 0 and conf.resources.tpu is not None:
-        if conf.nodes != conf.resources.tpu.hosts:
+        if conf.nodes != jobs_per_replica:
             raise ServerClientError(
-                f"`nodes: {conf.nodes}` conflicts with the {conf.resources.tpu.slice_name} "
-                f"slice topology ({conf.resources.tpu.hosts} hosts); omit `nodes` to derive it"
+                f"`nodes: {conf.nodes}` conflicts with the {conf.resources.tpu.pretty()} "
+                f"request ({jobs_per_replica} hosts); omit `nodes` to derive it"
             )
 
     from dstack_tpu.core.models.common import parse_duration

@@ -1,0 +1,61 @@
+"""In-process resource locking for the background loops.
+
+Parity: reference server/services/locking.py (sqlite lockset / postgres advisory locks).
+This server is single-process (sqlite single-writer model), so named asyncio locks are
+sufficient and cheaper: they serialize FSM transitions on one resource (a run, an
+instance slice) across concurrently-running background loops without DB round-trips.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict
+
+
+class Locker:
+    def __init__(self) -> None:
+        self._locks: Dict[str, asyncio.Lock] = {}
+        self._waiters: Dict[str, int] = {}
+
+    def lock(self, name: str) -> "_LockCtx":
+        return _LockCtx(self, name)
+
+    def _acquire_obj(self, name: str) -> asyncio.Lock:
+        lock = self._locks.get(name)
+        if lock is None:
+            lock = asyncio.Lock()
+            self._locks[name] = lock
+        self._waiters[name] = self._waiters.get(name, 0) + 1
+        return lock
+
+    def _release_obj(self, name: str) -> None:
+        # Drop the lock object once nobody holds or waits on it (unbounded resource
+        # names: run ids come and go).
+        n = self._waiters.get(name, 0) - 1
+        if n <= 0:
+            self._waiters.pop(name, None)
+            self._locks.pop(name, None)
+        else:
+            self._waiters[name] = n
+
+
+class _LockCtx:
+    def __init__(self, locker: Locker, name: str) -> None:
+        self._locker = locker
+        self._name = name
+        self._lock: asyncio.Lock = None  # type: ignore[assignment]
+
+    async def __aenter__(self) -> None:
+        self._lock = self._locker._acquire_obj(self._name)
+        await self._lock.acquire()
+
+    async def __aexit__(self, *exc) -> None:
+        self._lock.release()
+        self._locker._release_obj(self._name)
+
+
+_locker = Locker()
+
+
+def get_locker() -> Locker:
+    return _locker

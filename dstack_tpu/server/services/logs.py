@@ -1,0 +1,86 @@
+"""Log storage: pluggable, file-tree backed by default.
+
+Parity: reference server/services/logs/ (base ABC logs/base.py:47, FileLogStorage
+logs/filelog.py). Layout: <LOGS_DIR>/<project_id>/<run_name>/<job id>.jsonl — one JSON
+line per log event, append-only, so polling readers can seek by line offset."""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+from pathlib import Path
+from typing import List, Optional
+
+from dstack_tpu.core.models.logs import LogEvent
+from dstack_tpu.server import settings
+
+
+class LogStorage(abc.ABC):
+    @abc.abstractmethod
+    def write_logs(self, project_id: str, run_name: str, job_id: str, events: List[LogEvent]) -> None: ...
+
+    @abc.abstractmethod
+    def poll_logs(
+        self,
+        project_id: str,
+        run_name: str,
+        job_id: str,
+        start_line: int = 0,
+        limit: int = 1000,
+    ) -> List[LogEvent]: ...
+
+
+class FileLogStorage(LogStorage):
+    def __init__(self, root: Optional[str] = None):
+        self.root = Path(root) if root else settings.LOGS_DIR
+
+    def _path(self, project_id: str, run_name: str, job_id: str) -> Path:
+        return self.root / project_id / run_name / f"{job_id}.jsonl"
+
+    def write_logs(self, project_id: str, run_name: str, job_id: str, events: List[LogEvent]) -> None:
+        if not events:
+            return
+        path = self._path(project_id, run_name, job_id)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            for ev in events:
+                f.write(ev.model_dump_json() + "\n")
+
+    def poll_logs(
+        self,
+        project_id: str,
+        run_name: str,
+        job_id: str,
+        start_line: int = 0,
+        limit: int = 1000,
+    ) -> List[LogEvent]:
+        path = self._path(project_id, run_name, job_id)
+        if not path.exists():
+            return []
+        out: List[LogEvent] = []
+        with open(path, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                if i < start_line:
+                    continue
+                if len(out) >= limit:
+                    break
+                line = line.strip()
+                if line:
+                    out.append(LogEvent.model_validate(json.loads(line)))
+        return out
+
+
+_storage: Optional[LogStorage] = None
+
+
+def get_log_storage() -> LogStorage:
+    global _storage
+    if _storage is None:
+        _storage = FileLogStorage()
+    return _storage
+
+
+def set_log_storage(storage: Optional[LogStorage]) -> None:
+    global _storage
+    _storage = storage

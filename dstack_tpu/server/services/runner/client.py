@@ -1,0 +1,118 @@
+"""HTTP client for the dstack-tpu runner agent.
+
+Parity: reference server/services/runner/client.py (RunnerClient:49-134). The runner API
+is our own design (see runner/ C++ agent): submit carries the job spec AND cluster info
+in one call; pull streams both state events and log lines from a single monotonically
+increasing offset, so the server needs no websocket.
+
+For cloud instances the client talks through an SSH tunnel (services/runner/ssh.py);
+for the local backend it connects directly to 127.0.0.1:<runner_port>.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from dstack_tpu.core.models.runs import ClusterInfo, JobRuntimeData, JobSpec
+
+REQUEST_TIMEOUT = aiohttp.ClientTimeout(total=10)
+
+
+class RunnerError(Exception):
+    pass
+
+
+class RunnerClient:
+    """Async HTTP client; one instance per (host, port) conversation."""
+
+    def __init__(self, hostname: str, port: int):
+        self.base = f"http://{hostname}:{port}"
+
+    async def _request(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[dict] = None,
+        data: Optional[bytes] = None,
+        params: Optional[dict] = None,
+    ) -> Any:
+        try:
+            async with aiohttp.ClientSession(timeout=REQUEST_TIMEOUT) as session:
+                kwargs: dict = {}
+                if payload is not None:
+                    kwargs["json"] = payload
+                if data is not None:
+                    kwargs["data"] = data
+                if params is not None:
+                    kwargs["params"] = params
+                async with session.request(method, self.base + path, **kwargs) as resp:
+                    body = await resp.read()
+                    if resp.status >= 400:
+                        raise RunnerError(f"{path} -> {resp.status}: {body[:200]!r}")
+                    if not body:
+                        return None
+                    return json.loads(body)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            raise RunnerError(f"{path}: {e}") from e
+
+    async def healthcheck(self) -> Optional[dict]:
+        try:
+            return await self._request("GET", "/api/healthcheck")
+        except RunnerError:
+            return None
+
+    async def submit(
+        self,
+        job_spec: JobSpec,
+        cluster_info: ClusterInfo,
+        run_spec: Optional[dict] = None,
+        secrets: Optional[Dict[str, str]] = None,
+    ) -> None:
+        await self._request(
+            "POST",
+            "/api/submit",
+            payload={
+                "job_spec": job_spec.model_dump(mode="json"),
+                "cluster_info": cluster_info.model_dump(mode="json"),
+                "run_spec": run_spec or {},
+                "secrets": secrets or {},
+            },
+        )
+
+    async def upload_code(self, code: bytes) -> None:
+        await self._request("POST", "/api/upload_code", data=code)
+
+    async def run_job(self) -> None:
+        await self._request("POST", "/api/run")
+
+    async def pull(self, offset: int = 0) -> dict:
+        """Returns {"job_states": [{"state","termination_reason","exit_status","ts"}...],
+        "logs": [{"ts","message"}...], "offset": int, "has_more": bool}."""
+        return await self._request("GET", "/api/pull", params={"offset": str(offset)})
+
+    async def stop(self, abort: bool = False) -> None:
+        await self._request("POST", "/api/stop", payload={"abort": abort})
+
+    async def metrics(self) -> Optional[dict]:
+        try:
+            return await self._request("GET", "/api/metrics")
+        except RunnerError:
+            return None
+
+
+def get_runner_client(jpd, jrd: Optional[JobRuntimeData]) -> RunnerClient:
+    """Resolve how to reach a job's runner.
+
+    Local/dockerized=False instances expose the runner directly on a host port recorded
+    in JobRuntimeData; cloud instances are reached via an SSH local-forward established
+    by services/runner/ssh.py (the tunnel rewrites host/port before this call)."""
+    port = None
+    if jrd is not None and jrd.runner_port:
+        port = jrd.runner_port
+    if port is None:
+        port = 10999
+    return RunnerClient(jpd.hostname or "127.0.0.1", port)

@@ -37,6 +37,14 @@ PROCESS_METRICS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_METRICS_INTERVAL"
 PROCESS_BATCH_SIZE = int(os.getenv("DSTACK_TPU_PROCESS_BATCH_SIZE", "10"))
 METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL", "3600"))
 
+# Scheduler FSM knobs.
+MAX_OFFERS_TRIED = int(os.getenv("DSTACK_TPU_MAX_OFFERS_TRIED", "5"))
+PROVISIONING_TIMEOUT = float(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))
+RUNNER_DISCONNECT_TIMEOUT = float(os.getenv("DSTACK_TPU_RUNNER_DISCONNECT_TIMEOUT", "120"))
+RETRY_BACKOFF_BASE = float(os.getenv("DSTACK_TPU_RETRY_BACKOFF_BASE", "15"))
+RETRY_BACKOFF_MAX = float(os.getenv("DSTACK_TPU_RETRY_BACKOFF_MAX", "600"))
+TERMINATION_RETRY_WINDOW = float(os.getenv("DSTACK_TPU_TERMINATION_RETRY_WINDOW", "600"))
+
 LOCAL_BACKEND_ENABLED = _env_bool("DSTACK_TPU_LOCAL_BACKEND_ENABLED", True)
 ENABLE_PROMETHEUS_METRICS = _env_bool("DSTACK_TPU_ENABLE_PROMETHEUS_METRICS", True)
 

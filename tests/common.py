@@ -17,9 +17,14 @@ from dstack_tpu.server.app import create_app
 class ApiClient:
     """Thin wrapper: POST json with auth header, parse json, expose raw responses."""
 
-    def __init__(self, client: TestClient, token: str):
+    def __init__(self, client: TestClient, token: str, app=None):
         self.client = client
         self.token = token
+        self.app = app
+
+    @property
+    def db(self):
+        return self.app["db"]
 
     async def post(
         self,
@@ -46,9 +51,99 @@ async def api_server(run_background_tasks: bool = False) -> AsyncIterator[ApiCli
     client = TestClient(server)
     await client.start_server()
     try:
-        yield ApiClient(client, app["admin_token"])
+        yield ApiClient(client, app["admin_token"], app=app)
     finally:
         await client.close()
+
+
+class FakeRunnerClient:
+    """Scripted stand-in for the runner agent (parity: mocked shim/runner HTTP clients in
+    reference scheduler tests, test_process_running_jobs.py)."""
+
+    # Class-level registry shared across get_runner_client calls: key -> instance.
+    registry: dict = {}
+    healthy: bool = True
+
+    def __init__(self, key: str):
+        self.key = key
+        self.submitted = None
+        self.cluster_info = None
+        self.code = None
+        self.ran = False
+        self.stopped = False
+        self.aborted = False
+        self.pulls = 0
+        # Script: list of pull results to return in order; the last repeats.
+        self.script = self.default_script()
+
+    @classmethod
+    def reset(cls):
+        cls.registry = {}
+        cls.healthy = True
+
+    @classmethod
+    def for_jpd(cls, jpd, jrd) -> "FakeRunnerClient":
+        key = f"{jpd.hostname}:{jpd.instance_id}"
+        if key not in cls.registry:
+            cls.registry[key] = cls(key)
+        return cls.registry[key]
+
+    async def healthcheck(self):
+        return {"status": "ok"} if type(self).healthy else None
+
+    def default_script(self):
+        return [
+            {"job_states": [{"state": "running"}], "logs": [], "offset": 1},
+            {
+                "job_states": [{"state": "done", "exit_status": 0}],
+                "logs": [{"ts": "2026-01-01T00:00:00+00:00", "message": "hello\n"}],
+                "offset": 2,
+            },
+        ]
+
+    async def submit(self, job_spec, cluster_info, run_spec=None, secrets=None):
+        # A fresh submission restarts the scripted job (pool-reused slices get the same
+        # fake; the real runner also resets state on submit).
+        if self.submitted is not None:
+            self.script = self.default_script()
+            self.pulls = 0
+        self.submitted = job_spec
+        self.cluster_info = cluster_info
+        self.secrets = secrets
+
+    async def upload_code(self, code: bytes):
+        self.code = code
+
+    async def run_job(self):
+        self.ran = True
+
+    async def pull(self, offset: int = 0):
+        result = self.script[min(self.pulls, len(self.script) - 1)]
+        self.pulls += 1
+        return result
+
+    async def stop(self, abort: bool = False):
+        self.stopped = True
+        self.aborted = abort
+
+    async def metrics(self):
+        return None
+
+
+async def setup_mock_backend(api: ApiClient, project: str = "main") -> None:
+    await api.post(f"/api/project/{project}/backends/create", {"type": "mock"})
+
+
+async def drive(db, passes: int = 10) -> None:
+    """Run all scheduler loops until quiescent (bounded passes)."""
+    from dstack_tpu.server.background import tasks
+
+    for _ in range(passes):
+        await tasks.process_submitted_jobs(db)
+        await tasks.process_running_jobs(db)
+        await tasks.process_terminating_jobs(db)
+        await tasks.process_runs(db)
+        await tasks.process_instances(db)
 
 
 TASK_SPEC = {

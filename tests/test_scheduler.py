@@ -1,0 +1,354 @@
+"""Scheduler loop tests: real FSM loops + real DB + mock Compute + fake runner.
+
+Parity with the reference's distributed-without-a-cluster strategy (SURVEY §4,
+test_process_submitted_jobs.py / test_process_running_jobs.py / test_process_runs.py)."""
+
+import pytest
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+    tpu_task_spec,
+)
+
+CPU_TASK = {
+    "run_spec": {
+        "run_name": "cpu-task",
+        "configuration": {"type": "task", "commands": ["echo hi"]},
+    }
+}
+
+
+@pytest.fixture(autouse=True)
+def _fake_runner(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+    yield
+    FakeRunnerClient.reset()
+
+
+async def _job_rows(db, run_name=None):
+    sql = "SELECT * FROM jobs"
+    params = ()
+    if run_name:
+        sql += " WHERE run_name = ?"
+        params = (run_name,)
+    return await db.fetchall(sql + " ORDER BY replica_num, job_num, submission_num", params)
+
+
+class TestSubmittedJobs:
+    async def test_no_capacity_fails_run(self):
+        async with api_server() as api:
+            # TPU request with no TPU backend configured -> no offers -> failed.
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("t1"))
+            await drive(api.db, passes=3)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "t1"})
+            assert run["status"] == "failed"
+            job_sub = run["jobs"][0]["job_submissions"][-1]
+            assert job_sub["termination_reason"] == "failed_to_start_due_to_no_capacity"
+
+    async def test_cpu_task_runs_to_done_on_local(self):
+        async with api_server() as api:
+            await api.post("/api/project/main/runs/submit", CPU_TASK)
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "cpu-task"})
+            assert run["status"] == "done"
+            [(key, fake)] = FakeRunnerClient.registry.items()
+            assert fake.ran
+            assert fake.submitted.commands == ["echo hi"]
+
+    async def test_tpu_slice_gang_placement(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            # v5p-16 = 8 chips = 2 hosts -> 2 gang jobs on one slice.
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("tpu1", "v5p-16"))
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "tpu1"})
+            assert run["status"] == "done"
+            assert len(run["jobs"]) == 2
+
+            instances = await api.db.fetchall("SELECT * FROM instances")
+            slice_ids = {r["slice_id"] for r in instances}
+            assert len(slice_ids) == 1  # both workers on one slice
+            assert sorted(r["worker_num"] for r in instances) == [0, 1]
+
+            # Cluster contract: per-worker identity, shared coordinator.
+            fakes = sorted(FakeRunnerClient.registry.values(), key=lambda f: f.cluster_info.node_rank)
+            assert [f.cluster_info.tpu_worker_id for f in fakes] == [0, 1]
+            assert fakes[0].cluster_info.nodes_num == 2
+            assert fakes[0].cluster_info.coordinator_address == fakes[1].cluster_info.coordinator_address
+            env = fakes[1].cluster_info.to_env()
+            assert env["TPU_WORKER_ID"] == "1"
+            assert env["DSTACK_NODE_RANK"] == "1"
+            assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 2
+
+    async def test_pool_reuse_same_slice(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("r1", "v5e-8"))
+            await drive(api.db)
+            compute = None
+            for t, c in await backends_service.get_project_computes(
+                api.db, await api.db.fetchone("SELECT * FROM projects")
+            ):
+                if t == "mock":
+                    compute = c
+            assert len(compute.created) == 1
+            run = await api.post("/api/project/main/runs/get", {"run_name": "r1"})
+            assert run["status"] == "done"
+
+            # Second run reuses the idle slice: no new cloud create.
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("r2", "v5e-8"))
+            await drive(api.db)
+            run2 = await api.post("/api/project/main/runs/get", {"run_name": "r2"})
+            assert run2["status"] == "done"
+            assert len(compute.created) == 1
+
+    async def test_multislice_megascale_contract(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            spec = {
+                "run_spec": {
+                    "run_name": "ms",
+                    "configuration": {
+                        "type": "task",
+                        "commands": ["python train.py"],
+                        "resources": {"tpu": {"generation": "v5p", "chips": 8, "count": 2}},
+                    },
+                }
+            }
+            await api.post("/api/project/main/runs/submit", spec)
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "ms"})
+            assert run["status"] == "done"
+            assert len(run["jobs"]) == 4  # 2 slices x 2 hosts
+
+            instances = await api.db.fetchall("SELECT * FROM instances")
+            assert len({r["slice_id"] for r in instances}) == 2
+
+            fakes = sorted(FakeRunnerClient.registry.values(), key=lambda f: f.cluster_info.node_rank)
+            infos = [f.cluster_info for f in fakes]
+            assert [i.slice_id for i in infos] == [0, 0, 1, 1]
+            assert [i.tpu_worker_id for i in infos] == [0, 1, 0, 1]
+            env = infos[3].to_env()
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == "1"
+            assert "MEGASCALE_COORDINATOR_ADDRESS" in env
+
+
+class TestRetries:
+    async def test_no_capacity_retry_keeps_queued(self):
+        async with api_server() as api:
+            project = await api.db.fetchone("SELECT * FROM projects")
+            from dstack_tpu.backends.mock import MockTpuCompute
+
+            await setup_mock_backend(api)
+            backends_service._compute_cache[(project["id"], "mock")] = MockTpuCompute(
+                fail_provision=True
+            )
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("rt", "v5e-8", retry=True),
+            )
+            await drive(api.db, passes=3)
+            rows = await _job_rows(api.db, "rt")
+            assert all(r["status"] == "submitted" for r in rows)
+
+            # Capacity appears -> run completes.
+            backends_service._compute_cache[(project["id"], "mock")] = MockTpuCompute()
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "rt"})
+            assert run["status"] == "done"
+
+    async def test_gang_retry_on_job_failure(self, monkeypatch):
+        monkeypatch.setattr("dstack_tpu.server.settings.RETRY_BACKOFF_BASE", 0.0)
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            # First attempt fails on worker 1; whole gang resubmitted.
+            orig_for_jpd = FakeRunnerClient.for_jpd
+            injected = []
+
+            def failing_for_jpd(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                if jpd.worker_num == 1 and not injected and fake.submitted is None:
+                    injected.append(True)
+                    fake.script = [
+                        {
+                            "job_states": [{"state": "failed", "exit_status": 1}],
+                            "logs": [],
+                            "offset": 1,
+                        }
+                    ]
+                return fake
+
+            tasks.get_runner_client = failing_for_jpd
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("gr", "v5p-16", retry={"on_events": ["error"], "duration": "1h"}),
+            )
+            await drive(api.db, passes=20)
+            rows = await _job_rows(api.db, "gr")
+            # 2 jobs x 2 submissions
+            assert max(r["submission_num"] for r in rows) == 1
+            run = await api.post("/api/project/main/runs/get", {"run_name": "gr"})
+            assert run["status"] == "done"
+
+    async def test_failure_without_retry_fails_run(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            orig_for_jpd = FakeRunnerClient.for_jpd
+
+            def failing_for_jpd(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                fake.script = [
+                    {"job_states": [{"state": "failed", "exit_status": 2}], "logs": [], "offset": 1}
+                ]
+                return fake
+
+            tasks.get_runner_client = failing_for_jpd
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("f1", "v5e-8"))
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "f1"})
+            assert run["status"] == "failed"
+            sub = run["jobs"][0]["job_submissions"][-1]
+            assert sub["termination_reason"] == "container_exited_with_error"
+            assert sub["exit_status"] == 2
+
+
+class TestStopAndInstances:
+    async def test_stop_run_releases_instance(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            # Keep the job running forever.
+            orig_for_jpd = FakeRunnerClient.for_jpd
+
+            def running_for_jpd(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                fake.script = [{"job_states": [{"state": "running"}], "logs": [], "offset": 1}]
+                return fake
+
+            tasks.get_runner_client = running_for_jpd
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("s1", "v5e-8"))
+            await drive(api.db, passes=4)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "s1"})
+            assert run["status"] == "running"
+
+            await api.post("/api/project/main/runs/stop", {"runs_names": ["s1"]})
+            await drive(api.db, passes=4)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "s1"})
+            assert run["status"] == "terminated"
+            fake = next(iter(FakeRunnerClient.registry.values()))
+            assert fake.stopped
+
+            inst = await api.db.fetchone("SELECT * FROM instances")
+            assert inst["status"] == "idle"
+            assert inst["busy_blocks"] == 0
+
+    async def test_idle_instance_terminated_after_expiry(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("i1", "v5e-8"))
+            await drive(api.db)
+            # Force expiry: idle_since far in the past.
+            await api.db.execute(
+                "UPDATE instances SET idle_since = '2020-01-01T00:00:00+00:00'"
+            )
+            await drive(api.db, passes=3)
+            inst = await api.db.fetchone("SELECT * FROM instances")
+            assert inst["status"] == "terminated"
+            project = await api.db.fetchone("SELECT * FROM projects")
+            compute = dict(await backends_service.get_project_computes(api.db, project))["mock"]
+            assert len(compute.terminated) == 1
+            # Auto-created fleet is cleaned up with its last instance.
+            fleets = await api.db.fetchall("SELECT * FROM fleets WHERE deleted = 0")
+            assert fleets == []
+
+    async def test_unreachable_runner_fails_job_after_grace(self, monkeypatch):
+        async with api_server() as api:
+            monkeypatch.setattr(
+                "dstack_tpu.server.settings.RUNNER_DISCONNECT_TIMEOUT", 0.0
+            )
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("u1", "v5e-8"))
+            await drive(api.db, passes=2)
+            # Runner goes dark mid-run.
+            for fake in FakeRunnerClient.registry.values():
+                async def dead_pull(offset=0):
+                    raise RuntimeError("connection refused")
+
+                fake.pull = dead_pull
+            await drive(api.db, passes=4)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "u1"})
+            sub = run["jobs"][0]["job_submissions"][-1]
+            assert sub["termination_reason"] == "instance_unreachable"
+
+
+class TestFleets:
+    async def test_cloud_fleet_provisions_and_run_reuses_it(self):
+        from dstack_tpu.core.models.fleets import FleetSpec
+        from dstack_tpu.server.services import fleets as fleets_service
+
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            project = await api.db.fetchone("SELECT * FROM projects")
+            user = await api.db.fetchone("SELECT * FROM users")
+            spec = FleetSpec.model_validate(
+                {
+                    "configuration": {
+                        "type": "fleet",
+                        "name": "pool",
+                        "nodes": 1,
+                        "resources": {"tpu": "v5p-16"},
+                    }
+                }
+            )
+            await fleets_service.create_fleet(api.db, project, user, spec)
+            await drive(api.db, passes=3)
+            rows = await api.db.fetchall("SELECT * FROM instances ORDER BY worker_num")
+            assert [r["status"] for r in rows] == ["idle", "idle"]  # 2 hosts of one slice
+            assert len({r["slice_id"] for r in rows}) == 1
+
+            compute = dict(
+                await backends_service.get_project_computes(api.db, project)
+            )["mock"]
+            assert len(compute.created) == 1
+
+            # A run targeting the fleet reuses the idle slice: no new cloud create.
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("fr", "v5p-16", fleets=["pool"]),
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "fr"})
+            assert run["status"] == "done"
+            assert len(compute.created) == 1
+
+            # Fleet delete drains the slice.
+            await fleets_service.delete_fleets(api.db, project, ["pool"])
+            await drive(api.db, passes=3)
+            rows = await api.db.fetchall("SELECT * FROM instances")
+            assert all(r["status"] == "terminated" for r in rows)
+            assert compute.terminated == compute.created
+
+
+class TestLogsFromRunner:
+    async def test_logs_written_to_storage(self, tmp_path):
+        from dstack_tpu.server.services import logs as logs_service
+
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        try:
+            async with api_server() as api:
+                await api.post("/api/project/main/runs/submit", CPU_TASK)
+                await drive(api.db)
+                job = await api.db.fetchone("SELECT * FROM jobs")
+                events = logs_service.get_log_storage().poll_logs(
+                    job["project_id"], "cpu-task", job["id"]
+                )
+                assert [e.message for e in events] == ["hello\n"]
+        finally:
+            logs_service.set_log_storage(None)
