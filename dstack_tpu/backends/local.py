@@ -1,16 +1,25 @@
-"""Local backend: runs jobs as processes on the server host, shim-less.
+"""Local backend: runs jobs as host processes via the native runner agent, shim-less.
 
 Parity: reference backends/local (local/compute.py:26-116, LOCAL_BACKEND_ENABLED
 settings.py:98) — the dev/test backend exercising the full scheduler path with zero
-cloud dependencies. Offers a CPU-only "instance" plus a simulated TPU slice shape so
-slice gang-scheduling is testable locally."""
+cloud dependencies. `create_slice` spawns a real dstack-tpu-runner process on an
+ephemeral port, so the control plane drives the exact same HTTP protocol it uses
+against cloud instances."""
 
 from __future__ import annotations
 
+import asyncio
+import json
+import logging
 import os
+import re
+import signal
+import subprocess
+import tempfile
 from typing import List, Optional
 
 from dstack_tpu.backends.base import Compute
+from dstack_tpu.core.errors import ComputeError
 from dstack_tpu.core.models.instances import (
     HostResources,
     InstanceAvailability,
@@ -18,10 +27,20 @@ from dstack_tpu.core.models.instances import (
     InstanceType,
 )
 from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.utils.runner_binary import find_runner_binary
+
+logger = logging.getLogger(__name__)
+
+_LISTEN_RE = re.compile(r"listening on [\d.]+:(\d+)")
 
 
 class LocalCompute(Compute):
     TYPE = "local"
+
+    def __init__(self) -> None:
+        # Live runner processes by slice_id, so terminate can reap them (otherwise the
+        # children linger as zombies of the server process).
+        self._procs: dict = {}
 
     async def get_offers(self, requirements: Requirements, regions: Optional[List[str]] = None) -> List[InstanceOffer]:
         if requirements.resources.tpu is not None:
@@ -47,6 +66,26 @@ class LocalCompute(Compute):
         ssh_public_key: str = "",
         startup_script: Optional[str] = None,
     ) -> List[JobProvisioningData]:
+        loop = asyncio.get_running_loop()
+
+        def _spawn():
+            # Off the event loop: find_runner_binary may compile the agent (slow) and
+            # Popen/mkdtemp do blocking IO.
+            binary = find_runner_binary()
+            if binary is None:
+                raise ComputeError("dstack-tpu-runner binary not found and could not be built")
+            base_dir = tempfile.mkdtemp(prefix=f"dstack-tpu-{instance_name}-")
+            return base_dir, subprocess.Popen(
+                [binary, "--host", "127.0.0.1", "--port", "0", "--base-dir", base_dir],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+
+        base_dir, proc = await loop.run_in_executor(None, _spawn)
+        port = await self._read_port(proc)
+        logger.info("local runner %s: pid=%s port=%s", instance_name, proc.pid, port)
+        self._procs[f"local-{instance_name}"] = proc
         return [
             JobProvisioningData(
                 backend="local",
@@ -57,8 +96,9 @@ class LocalCompute(Compute):
                 region=offer.region,
                 price=0.0,
                 username="root",
-                ssh_port=0,
+                ssh_port=0,  # direct HTTP, no tunnel
                 dockerized=False,
+                backend_data=json.dumps({"runner_port": port, "runner_pid": proc.pid, "base_dir": base_dir}),
                 slice_id=f"local-{instance_name}",
                 slice_name=offer.slice_name,
                 worker_num=0,
@@ -66,5 +106,53 @@ class LocalCompute(Compute):
             )
         ]
 
+    async def _read_port(self, proc: subprocess.Popen) -> int:
+        loop = asyncio.get_running_loop()
+
+        def _read() -> int:
+            assert proc.stdout is not None
+            # Tolerate loader/env warnings before the listen line.
+            for _ in range(20):
+                line = proc.stdout.readline().decode(errors="replace")
+                if not line:
+                    break
+                m = _LISTEN_RE.search(line)
+                if m:
+                    return int(m.group(1))
+            raise ComputeError("runner did not report a listen port")
+
+        try:
+            return await asyncio.wait_for(loop.run_in_executor(None, _read), timeout=10)
+        except (asyncio.TimeoutError, ComputeError):
+            # Don't leak a half-born agent: kill and reap before propagating.
+            try:
+                proc.kill()
+                await loop.run_in_executor(None, proc.wait)
+            except Exception:
+                pass
+            raise ComputeError("runner failed to start")
+
     async def terminate_slice(self, slice_id: str, region: str, backend_data: Optional[str] = None) -> None:
-        return None
+        proc = self._procs.pop(slice_id, None)
+        pid = proc.pid if proc is not None else None
+        if pid is None and backend_data:
+            try:
+                pid = json.loads(backend_data).get("runner_pid")
+            except ValueError:
+                pid = None
+        if pid:
+            try:
+                os.killpg(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if proc is not None:
+            loop = asyncio.get_running_loop()
+
+            def _reap() -> None:
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5)
+
+            await loop.run_in_executor(None, _reap)

@@ -113,6 +113,11 @@ def get_runner_client(jpd, jrd: Optional[JobRuntimeData]) -> RunnerClient:
     port = None
     if jrd is not None and jrd.runner_port:
         port = jrd.runner_port
+    if port is None and jpd is not None and jpd.backend_data:
+        try:
+            port = json.loads(jpd.backend_data).get("runner_port")
+        except ValueError:
+            port = None
     if port is None:
         port = 10999
     return RunnerClient(jpd.hostname or "127.0.0.1", port)

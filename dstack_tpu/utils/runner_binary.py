@@ -1,0 +1,46 @@
+"""Locate (and build on demand) the native dstack-tpu-runner binary.
+
+Parity: the reference downloads prebuilt Go runner binaries from S3
+(base/compute.py:612-628); here the C++ agent ships in-tree (runner/) and is compiled
+once per host with make."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_RUNNER_DIR = _REPO_ROOT / "runner"
+_BINARY = _RUNNER_DIR / "build" / "dstack-tpu-runner"
+_build_lock = threading.Lock()
+
+
+def find_runner_binary(build: bool = True) -> Optional[str]:
+    env_path = os.getenv("DSTACK_TPU_RUNNER_BINARY")
+    if env_path and Path(env_path).exists():
+        return env_path
+    if _BINARY.exists():
+        return str(_BINARY)
+    on_path = shutil.which("dstack-tpu-runner")
+    if on_path:
+        return on_path
+    if build and (_RUNNER_DIR / "Makefile").exists() and shutil.which("make"):
+        with _build_lock:
+            if _BINARY.exists():
+                return str(_BINARY)
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_RUNNER_DIR)],
+                    check=True,
+                    capture_output=True,
+                    timeout=300,
+                )
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+                return None
+        if _BINARY.exists():
+            return str(_BINARY)
+    return None

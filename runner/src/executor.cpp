@@ -1,0 +1,367 @@
+#include "executor.hpp"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <pty.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <sstream>
+#include <vector>
+
+namespace drunner {
+
+static std::string iso_now() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  tm tmv;
+  gmtime_r(&ts.tv_sec, &tmv);
+  char buf[64];
+  size_t n = strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%S", &tmv);
+  snprintf(buf + n, sizeof(buf) - n, ".%06ld+00:00", ts.tv_nsec / 1000);
+  return buf;
+}
+
+Executor::Executor(std::string base_dir) : base_dir_(std::move(base_dir)) {
+  mkdir(base_dir_.c_str(), 0755);
+}
+
+Executor::~Executor() {
+  stop_requested_ = true;
+  pid_t pid = child_pid_.load();
+  if (pid > 0) kill(-pid, SIGKILL);
+  if (worker_.joinable()) worker_.join();
+}
+
+dj::Json Executor::health() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  dj::Json out = dj::Json::object();
+  out.set("status", "ok");
+  out.set("state", current_state_);
+  out.set("service", "dstack-tpu-runner");
+  return out;
+}
+
+dj::Json Executor::submit(const dj::Json& body) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (job_started_ && current_state_ == "running") {
+    // Idempotent re-submit of the same job (the control plane retries when a
+    // submit/run response is lost); a different job is a real conflict.
+    if (body["job_spec"]["job_name"].as_string() == job_spec_["job_name"].as_string()) {
+      return dj::Json::object();
+    }
+    throw std::runtime_error("a different job is already running");
+  }
+  job_spec_ = body["job_spec"];
+  cluster_info_ = body["cluster_info"];
+  secrets_ = body["secrets"];
+  has_job_ = true;
+  job_started_ = false;
+  stop_requested_ = false;
+  abort_requested_ = false;
+  code_path_.clear();
+  current_state_ = "submitted";
+  return dj::Json::object();
+}
+
+dj::Json Executor::upload_code(const std::string& bytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!has_job_) throw std::runtime_error("no job submitted");
+  code_path_ = base_dir_ + "/code.tar.gz";
+  std::ofstream f(code_path_, std::ios::binary | std::ios::trunc);
+  f.write(bytes.data(), static_cast<std::streamsize>(bytes.size()));
+  if (!f.good()) throw std::runtime_error("failed to write code archive");
+  return dj::Json::object();
+}
+
+dj::Json Executor::run() {
+  // Reap a previous worker OUTSIDE the lock: the fresh worker's first action takes
+  // mu_, so joining under mu_ could deadlock the whole agent.
+  std::thread prev;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!has_job_) throw std::runtime_error("no job submitted");
+    if (job_started_) return dj::Json::object();  // idempotent re-run
+    job_started_ = true;
+    prev = std::move(worker_);
+  }
+  if (prev.joinable()) prev.join();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    ++job_generation_;
+    worker_ = std::thread(&Executor::exec_thread, this);
+  }
+  return dj::Json::object();
+}
+
+dj::Json Executor::pull(int64_t offset) {
+  std::lock_guard<std::mutex> lk(mu_);
+  dj::Json states = dj::Json::array();
+  dj::Json logs = dj::Json::array();
+  int64_t max_seq = offset;
+  for (const auto& ev : events_) {
+    if (ev.seq <= offset) continue;
+    if (ev.is_state) {
+      dj::Json s = dj::Json::object();
+      s.set("state", ev.state);
+      s.set("exit_status", ev.exit_status);
+      s.set("message", ev.message);
+      s.set("ts", ev.ts);
+      states.push_back(std::move(s));
+    } else {
+      dj::Json l = dj::Json::object();
+      l.set("message", ev.message);
+      l.set("ts", ev.ts);
+      l.set("source", "stdout");
+      logs.push_back(std::move(l));
+    }
+    if (ev.seq > max_seq) max_seq = ev.seq;
+  }
+  dj::Json out = dj::Json::object();
+  out.set("job_states", std::move(states));
+  out.set("logs", std::move(logs));
+  out.set("offset", max_seq);
+  out.set("state", current_state_);
+  return out;
+}
+
+dj::Json Executor::stop(bool abort) {
+  stop_requested_ = true;
+  abort_requested_ = abort;
+  pid_t pid = child_pid_.load();
+  if (pid > 0) {
+    kill(-pid, abort ? SIGKILL : SIGTERM);
+  } else {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (current_state_ == "submitted" || current_state_ == "idle") {
+      current_state_ = "terminated";
+    }
+  }
+  return dj::Json::object();
+}
+
+dj::Json Executor::metrics() const {
+  pid_t pid = child_pid_.load();
+  dj::Json out = dj::Json::object();
+  int64_t cpu_micro = 0, rss_bytes = 0;
+  if (pid > 0) {
+    // utime+stime from /proc/<pid>/stat (fields 14,15, in clock ticks).
+    std::ifstream stat("/proc/" + std::to_string(pid) + "/stat");
+    std::string line;
+    if (std::getline(stat, line)) {
+      auto rparen = line.rfind(')');
+      std::istringstream rest(line.substr(rparen + 2));
+      std::string tok;
+      long utime = 0, stime = 0;
+      for (int i = 3; i <= 15 && rest >> tok; ++i) {
+        if (i == 14) utime = atol(tok.c_str());
+        if (i == 15) stime = atol(tok.c_str());
+      }
+      long ticks = sysconf(_SC_CLK_TCK);
+      if (ticks > 0) cpu_micro = (utime + stime) * (1000000L / ticks);
+    }
+    std::ifstream statm("/proc/" + std::to_string(pid) + "/statm");
+    long pages = 0, rss_pages = 0;
+    if (statm >> pages >> rss_pages) rss_bytes = rss_pages * sysconf(_SC_PAGESIZE);
+  }
+  out.set("timestamp", iso_now());
+  out.set("cpu_usage_micro", cpu_micro);
+  out.set("memory_usage_bytes", rss_bytes);
+  // TPU duty-cycle/HBM come from the shim's libtpu monitor on TPU hosts; the runner
+  // reports null so the server knows to ask the shim (reference: DCGM relay split).
+  out.set("tpu", dj::Json());
+  return out;
+}
+
+void Executor::add_state(const std::string& state, int exit_status, const std::string& msg) {
+  std::lock_guard<std::mutex> lk(mu_);
+  current_state_ = state;
+  events_.push_back(Event{next_seq_++, true, state, exit_status, msg, iso_now()});
+  trim_events_locked();
+}
+
+void Executor::add_log(const std::string& line) {
+  std::lock_guard<std::mutex> lk(mu_);
+  events_.push_back(Event{next_seq_++, false, "", 0, line, iso_now()});
+  trim_events_locked();
+}
+
+void Executor::trim_events_locked() {
+  // Bound memory; seq numbers stay monotonic so already-handed-out offsets survive.
+  const size_t kMax = 200000;
+  while (events_.size() > kMax) events_.pop_front();
+}
+
+std::string Executor::extract_code() {
+  std::string repo_dir = base_dir_ + "/repo";
+  mkdir(repo_dir.c_str(), 0755);
+  if (!code_path_.empty()) {
+    std::string cmd = "tar -xzf '" + code_path_ + "' -C '" + repo_dir + "' 2>/dev/null";
+    if (system(cmd.c_str()) != 0) {
+      add_log("warning: failed to extract code archive\n");
+    }
+  }
+  return repo_dir;
+}
+
+// Flat env from the submitted cluster_info (the TPU cluster contract; parity:
+// reference executor.go:262-274 but JAX/MegaScale instead of MPI/NCCL).
+static std::vector<std::string> cluster_env(const dj::Json& ci) {
+  std::vector<std::string> env;
+  auto add = [&env](const std::string& k, const std::string& v) { env.push_back(k + "=" + v); };
+  if (!ci.is_object()) return env;
+  add("DSTACK_NODE_RANK", std::to_string(ci["node_rank"].as_int()));
+  add("DSTACK_NODES_NUM", std::to_string(ci["nodes_num"].as_int(1)));
+  add("DSTACK_MASTER_NODE_IP", ci["master_node_ip"].as_string());
+  std::string ips;
+  for (const auto& ip : ci["node_ips"].as_array()) {
+    if (!ips.empty()) ips += "\n";
+    ips += ip.as_string();
+  }
+  add("DSTACK_NODES_IPS", ips);
+  add("TPU_WORKER_ID", std::to_string(ci["tpu_worker_id"].as_int()));
+  std::string hostnames;
+  for (const auto& h : ci["tpu_worker_hostnames"].as_array()) {
+    if (!hostnames.empty()) hostnames += ",";
+    hostnames += h.as_string();
+  }
+  add("TPU_WORKER_HOSTNAMES", hostnames);
+  if (!ci["tpu_topology"].is_null()) add("TPU_TOPOLOGY", ci["tpu_topology"].as_string());
+  if (!ci["tpu_generation"].is_null())
+    add("DSTACK_TPU_GENERATION", ci["tpu_generation"].as_string());
+  if (ci["chips_per_host"].as_int() > 0)
+    add("DSTACK_TPU_CHIPS_PER_HOST", std::to_string(ci["chips_per_host"].as_int()));
+  if (!ci["coordinator_address"].is_null())
+    add("DSTACK_JAX_COORDINATOR", ci["coordinator_address"].as_string());
+  int64_t num_slices = ci["num_slices"].as_int(1);
+  if (num_slices > 1) {
+    add("MEGASCALE_NUM_SLICES", std::to_string(num_slices));
+    add("MEGASCALE_SLICE_ID", std::to_string(ci["slice_id"].as_int()));
+    if (!ci["megascale_coordinator_address"].is_null())
+      add("MEGASCALE_COORDINATOR_ADDRESS", ci["megascale_coordinator_address"].as_string());
+  }
+  return env;
+}
+
+void Executor::exec_thread() {
+  uint64_t generation = job_generation_.load();
+  if (stop_requested_) {  // stopped before we ever started
+    add_state(abort_requested_ ? "aborted" : "terminated", -1, "stopped before start");
+    return;
+  }
+  add_state("running");
+  std::string repo_dir = extract_code();
+
+  // Join commands into one shell script (reference joins with && semantics via sh -c;
+  // we use strict mode so any failing command fails the job).
+  std::string script = "set -e\n";
+  for (const auto& cmd : job_spec_["commands"].as_array()) {
+    script += cmd.as_string();
+    script += "\n";
+  }
+
+  std::string workdir = repo_dir;
+  if (!job_spec_["working_dir"].is_null() && !job_spec_["working_dir"].as_string().empty()) {
+    workdir = job_spec_["working_dir"].as_string();
+    if (workdir[0] != '/') workdir = repo_dir + "/" + workdir;
+  }
+
+  std::vector<std::string> env_strings;
+  for (char** e = environ; *e; ++e) env_strings.push_back(*e);
+  for (const auto& kv : job_spec_["env"].as_object()) {
+    env_strings.push_back(kv.first + "=" + kv.second.as_string());
+  }
+  for (const auto& kv : secrets_.as_object()) {
+    env_strings.push_back(kv.first + "=" + kv.second.as_string());
+  }
+  for (auto& kv : cluster_env(cluster_info_)) env_strings.push_back(kv);
+  env_strings.push_back("DSTACK_REPO_DIR=" + repo_dir);
+
+  int master_fd;
+  pid_t pid = forkpty(&master_fd, nullptr, nullptr, nullptr);
+  if (pid < 0) {
+    add_state("failed", -1, "forkpty failed");
+    return;
+  }
+  if (pid == 0) {
+    // Child: own process group so stop() can signal the whole tree.
+    setpgid(0, 0);
+    if (chdir(workdir.c_str()) != 0) {
+      int rc = chdir("/");
+      (void)rc;
+    }
+    std::vector<char*> envp;
+    for (auto& s : env_strings) envp.push_back(const_cast<char*>(s.c_str()));
+    envp.push_back(nullptr);
+    execle("/bin/sh", "sh", "-c", script.c_str(), static_cast<char*>(nullptr), envp.data());
+    _exit(127);
+  }
+  setpgid(pid, pid);
+  child_pid_ = pid;
+  // Close the stop() race: a stop that arrived while we were extracting code (before
+  // child_pid_ was set) found nothing to signal — honor it now.
+  if (stop_requested_) kill(-pid, abort_requested_ ? SIGKILL : SIGTERM);
+
+  // Parent: stream pty output into the log buffer, line-buffered.
+  std::string partial;
+  char buf[4096];
+  while (true) {
+    pollfd pfd{master_fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, 200);
+    if (pr > 0) {
+      ssize_t n = read(master_fd, buf, sizeof(buf));
+      if (n <= 0) break;
+      partial.append(buf, static_cast<size_t>(n));
+      size_t nl;
+      while ((nl = partial.find('\n')) != std::string::npos) {
+        add_log(partial.substr(0, nl + 1));
+        partial.erase(0, nl + 1);
+      }
+    }
+    int status;
+    pid_t done = waitpid(pid, &status, WNOHANG);
+    if (done == pid) {
+      // Drain remaining pty output (non-blocking).
+      fcntl(master_fd, F_SETFL, O_NONBLOCK);
+      ssize_t n;
+      while ((n = read(master_fd, buf, sizeof(buf))) > 0) partial.append(buf, static_cast<size_t>(n));
+      if (!partial.empty()) add_log(partial);
+      close(master_fd);
+      child_pid_ = 0;
+      if (job_generation_.load() != generation) return;  // superseded
+      if (stop_requested_) {
+        add_state(abort_requested_ ? "aborted" : "terminated",
+                  WIFEXITED(status) ? WEXITSTATUS(status) : -1, "stopped by request");
+      } else if (WIFEXITED(status) && WEXITSTATUS(status) == 0) {
+        add_state("done", 0);
+      } else {
+        int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+        add_state("failed", code, "exit status " + std::to_string(code));
+      }
+      return;
+    }
+  }
+  // Pty EOF before exit; wait for the child.
+  int status;
+  waitpid(pid, &status, 0);
+  if (!partial.empty()) add_log(partial);
+  close(master_fd);
+  child_pid_ = 0;
+  if (stop_requested_) {
+    add_state(abort_requested_ ? "aborted" : "terminated",
+              WIFEXITED(status) ? WEXITSTATUS(status) : -1, "stopped by request");
+  } else if (WIFEXITED(status) && WEXITSTATUS(status) == 0) {
+    add_state("done", 0);
+  } else {
+    int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+    add_state("failed", code, "exit status " + std::to_string(code));
+  }
+}
+
+}  // namespace drunner

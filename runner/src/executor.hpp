@@ -1,0 +1,71 @@
+// Job executor: spawn the job's shell in a pty, collect logs + state events.
+//
+// Parity: reference runner/internal/executor/executor.go (execJob:254-418,
+// startCommand:614 — pty fork, env contract injection executor.go:262-274). TPU
+// re-design: instead of writing an MPI hostfile + SSH mesh, the executor injects the
+// JAX coordinator / TPU worker identity / MegaScale env from the cluster_info the
+// control plane submits (SURVEY §2.6).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "json.hpp"
+
+namespace drunner {
+
+struct Event {
+  int64_t seq;
+  bool is_state;  // state transition vs log line
+  std::string state;
+  int exit_status;
+  std::string message;
+  std::string ts;  // ISO-8601 UTC
+};
+
+class Executor {
+ public:
+  explicit Executor(std::string base_dir);
+  ~Executor();
+
+  // HTTP API surface (all JSON in/out, thread-safe).
+  dj::Json submit(const dj::Json& body);  // {job_spec, cluster_info, run_spec, secrets}
+  dj::Json upload_code(const std::string& bytes);
+  dj::Json run();
+  dj::Json pull(int64_t offset);
+  dj::Json stop(bool abort);
+  dj::Json metrics() const;
+  dj::Json health() const;
+
+ private:
+  void exec_thread();
+  void add_state(const std::string& state, int exit_status = 0, const std::string& msg = "");
+  void add_log(const std::string& line);
+  void trim_events_locked();
+  std::string extract_code();
+
+  std::string base_dir_;
+  dj::Json job_spec_;
+  dj::Json cluster_info_;
+  dj::Json secrets_;
+  std::string code_path_;
+  bool has_job_ = false;
+  bool job_started_ = false;  // guarded by mu_; reset by submit()
+
+  mutable std::mutex mu_;
+  std::deque<Event> events_;
+  int64_t next_seq_ = 1;
+  std::string current_state_ = "idle";
+
+  std::thread worker_;
+  std::atomic<pid_t> child_pid_{0};
+  std::atomic<bool> stop_requested_{false};
+  std::atomic<bool> abort_requested_{false};
+  std::atomic<uint64_t> job_generation_{0};
+};
+
+}  // namespace drunner

@@ -1,0 +1,197 @@
+#include "http.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+#include <stdexcept>
+#include <thread>
+
+namespace dhttp {
+
+Server::Server(const std::string& host, int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    throw std::runtime_error("bad bind host " + host);
+  }
+  if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    throw std::runtime_error("bind() failed on " + host + ":" + std::to_string(port));
+  }
+  if (listen(listen_fd_, 64) != 0) throw std::runtime_error("listen() failed");
+  socklen_t len = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+}
+
+Server::~Server() {
+  if (listen_fd_ >= 0) close(listen_fd_);
+}
+
+void Server::handle(const std::string& method, const std::string& path, Handler h) {
+  routes_[method + " " + path] = std::move(h);
+}
+
+void Server::stop() { stopping_ = true; }
+
+void Server::serve_forever() {
+  while (!stopping_) {
+    pollfd pfd{listen_fd_, POLLIN, 0};
+    int r = poll(&pfd, 1, 200);  // wake periodically to observe stop()
+    if (r <= 0) continue;
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(&Server::handle_connection, this, fd).detach();
+  }
+}
+
+static bool read_exact(int fd, std::string& buf, size_t want) {
+  char tmp[8192];
+  while (buf.size() < want) {
+    ssize_t n = recv(fd, tmp, std::min(sizeof(tmp), want - buf.size()), 0);
+    if (n <= 0) return false;
+    buf.append(tmp, static_cast<size_t>(n));
+  }
+  return true;
+}
+
+static std::string url_decode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size() && isxdigit(static_cast<unsigned char>(s[i + 1])) &&
+        isxdigit(static_cast<unsigned char>(s[i + 2]))) {
+      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+void Server::handle_connection(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string buf;
+  char tmp[8192];
+  // Serve keep-alive requests until the peer closes or an error occurs.
+  while (true) {
+    size_t header_end;
+    while ((header_end = buf.find("\r\n\r\n")) == std::string::npos) {
+      ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
+      if (n <= 0) {
+        close(fd);
+        return;
+      }
+      buf.append(tmp, static_cast<size_t>(n));
+      if (buf.size() > 64 * 1024 * 1024) {  // runaway header
+        close(fd);
+        return;
+      }
+    }
+
+    Request req;
+    {
+      std::istringstream hs(buf.substr(0, header_end));
+      std::string line;
+      std::getline(hs, line);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::istringstream rl(line);
+      std::string target, version;
+      rl >> req.method >> target >> version;
+      auto qpos = target.find('?');
+      req.path = qpos == std::string::npos ? target : target.substr(0, qpos);
+      if (qpos != std::string::npos) {
+        std::string qs = target.substr(qpos + 1);
+        size_t start = 0;
+        while (start < qs.size()) {
+          size_t amp = qs.find('&', start);
+          std::string pair = qs.substr(start, amp == std::string::npos ? amp : amp - start);
+          size_t eq = pair.find('=');
+          if (eq != std::string::npos) {
+            req.query[url_decode(pair.substr(0, eq))] = url_decode(pair.substr(eq + 1));
+          }
+          if (amp == std::string::npos) break;
+          start = amp + 1;
+        }
+      }
+      while (std::getline(hs, line)) {
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        auto colon = line.find(':');
+        if (colon == std::string::npos) continue;
+        std::string key = line.substr(0, colon);
+        for (auto& c : key) c = static_cast<char>(tolower(c));
+        size_t vstart = line.find_first_not_of(' ', colon + 1);
+        req.headers[key] = vstart == std::string::npos ? "" : line.substr(vstart);
+      }
+    }
+
+    size_t content_length = 0;
+    auto cl = req.headers.find("content-length");
+    if (cl != req.headers.end()) {
+      // A malformed or absurd Content-Length must not take the agent down.
+      try {
+        content_length = std::stoul(cl->second);
+      } catch (const std::exception&) {
+        close(fd);
+        return;
+      }
+      if (content_length > 1024ull * 1024 * 1024) {
+        close(fd);
+        return;
+      }
+    }
+    std::string rest = buf.substr(header_end + 4);
+    if (!read_exact(fd, rest, content_length)) {
+      close(fd);
+      return;
+    }
+    req.body = rest.substr(0, content_length);
+    buf = rest.substr(content_length);  // pipelined next request, if any
+
+    Response resp;
+    auto it = routes_.find(req.method + " " + req.path);
+    if (it == routes_.end()) {
+      resp.status = 404;
+      resp.body = "{\"error\":\"not found\"}";
+    } else {
+      try {
+        resp = it->second(req);
+      } catch (const std::exception& e) {
+        resp.status = 500;
+        resp.body = std::string("{\"error\":\"") + e.what() + "\"}";
+      }
+    }
+
+    std::ostringstream out;
+    out << "HTTP/1.1 " << resp.status << (resp.status == 200 ? " OK" : " ERR") << "\r\n"
+        << "Content-Type: " << resp.content_type << "\r\n"
+        << "Content-Length: " << resp.body.size() << "\r\n"
+        << "Connection: keep-alive\r\n\r\n"
+        << resp.body;
+    std::string data = out.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = send(fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+      if (n <= 0) {
+        close(fd);
+        return;
+      }
+      sent += static_cast<size_t>(n);
+    }
+  }
+}
+
+}  // namespace dhttp

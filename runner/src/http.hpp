@@ -1,0 +1,52 @@
+// Minimal threaded HTTP/1.1 server (no external deps).
+//
+// Parity: the reference runner serves its API with Go's net/http (runner/api/http.go);
+// here a small accept-loop + thread-per-connection server is enough: the only clients
+// are the control plane (one poll every few seconds) and the shim.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <string>
+
+namespace dhttp {
+
+struct Request {
+  std::string method;
+  std::string path;                          // without query string
+  std::map<std::string, std::string> query;  // parsed query params
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+struct Response {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+};
+
+using Handler = std::function<Response(const Request&)>;
+
+class Server {
+ public:
+  // Binds immediately; port 0 picks an ephemeral port (readable via port()).
+  explicit Server(const std::string& host, int port);
+  ~Server();
+
+  void handle(const std::string& method, const std::string& path, Handler h);
+  int port() const { return port_; }
+
+  // Blocks serving requests until stop() is called from a handler/another thread.
+  void serve_forever();
+  void stop();
+
+ private:
+  void handle_connection(int fd);
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  volatile bool stopping_ = false;
+  std::map<std::string, Handler> routes_;  // "METHOD path" -> handler
+};
+
+}  // namespace dhttp

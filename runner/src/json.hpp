@@ -1,0 +1,326 @@
+// Minimal JSON value: parse + serialize, no external deps.
+//
+// The runner agent (parity: reference runner/internal/* in Go) needs only plain JSON
+// for its HTTP API; this is a small recursive-descent parser with a tagged-union value.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace dj {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const { return type_ == Type::Bool ? bool_ : dflt; }
+  double as_number(double dflt = 0) const { return type_ == Type::Number ? num_ : dflt; }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+
+  // Object access; returns Null json for missing keys.
+  const Json& operator[](const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  Json& set(const std::string& key, Json v) {
+    type_ = Type::Object;
+    obj_[key] = std::move(v);
+    return *this;
+  }
+  void push_back(Json v) {
+    type_ = Type::Array;
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (type_ == Type::Array) return arr_.size();
+    if (type_ == Type::Object) return obj_.size();
+    return 0;
+  }
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON content");
+    return v;
+  }
+
+ private:
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) && std::fabs(num_) < 1e15) {
+          os << static_cast<int64_t>(num_);
+        } else {
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) os << ',';
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, k);
+          os << ':';
+          v.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() && std::isspace(static_cast<unsigned char>(t[p]))) ++p;
+  }
+
+  static Json parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Json(parse_string(t, p));
+    if (c == 't' || c == 'f') return parse_bool(t, p);
+    if (c == 'n') {
+      expect(t, p, "null");
+      return Json();
+    }
+    return parse_number(t, p);
+  }
+
+  static void expect(const std::string& t, size_t& p, const char* word) {
+    size_t n = strlen(word);
+    if (t.compare(p, n, word) != 0) throw std::runtime_error("invalid JSON literal");
+    p += n;
+  }
+
+  static Json parse_bool(const std::string& t, size_t& p) {
+    if (t[p] == 't') {
+      expect(t, p, "true");
+      return Json(true);
+    }
+    expect(t, p, "false");
+    return Json(false);
+  }
+
+  static Json parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    if (p < t.size() && (t[p] == '-' || t[p] == '+')) ++p;
+    while (p < t.size() &&
+           (std::isdigit(static_cast<unsigned char>(t[p])) || t[p] == '.' || t[p] == 'e' ||
+            t[p] == 'E' || t[p] == '-' || t[p] == '+')) {
+      ++p;
+    }
+    if (p == start) throw std::runtime_error("invalid JSON number");
+    return Json(std::stod(t.substr(start, p - start)));
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    ++p;  // opening quote
+    std::string out;
+    while (p < t.size() && t[p] != '"') {
+      char c = t[p];
+      if (c == '\\') {
+        ++p;
+        if (p >= t.size()) break;
+        char e = t[p];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case '/': out += '/'; break;
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case 'u': {
+            if (p + 4 >= t.size()) throw std::runtime_error("bad \\u escape");
+            unsigned int cp = std::stoul(t.substr(p + 1, 4), nullptr, 16);
+            p += 4;
+            // UTF-8 encode (surrogate pairs folded to the replacement char — the
+            // runner only relays log text, exact astral-plane fidelity not needed).
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+        ++p;
+      } else {
+        out += c;
+        ++p;
+      }
+    }
+    if (p >= t.size()) throw std::runtime_error("unterminated string");
+    ++p;  // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& t, size_t& p) {
+    ++p;
+    JsonArray arr;
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') {
+      ++p;
+      return Json(std::move(arr));
+    }
+    while (true) {
+      arr.push_back(parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[p] == ',') {
+        ++p;
+        continue;
+      }
+      if (t[p] == ']') {
+        ++p;
+        break;
+      }
+      throw std::runtime_error("expected , or ] in array");
+    }
+    return Json(std::move(arr));
+  }
+
+  static Json parse_object(const std::string& t, size_t& p) {
+    ++p;
+    JsonObject obj;
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') {
+      ++p;
+      return Json(std::move(obj));
+    }
+    while (true) {
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != '"') throw std::runtime_error("expected object key");
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':') throw std::runtime_error("expected :");
+      ++p;
+      obj[key] = parse_value(t, p);
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[p] == ',') {
+        ++p;
+        continue;
+      }
+      if (t[p] == '}') {
+        ++p;
+        break;
+      }
+      throw std::runtime_error("expected , or } in object");
+    }
+    return Json(std::move(obj));
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace dj

@@ -1,0 +1,70 @@
+// dstack-tpu-runner: the on-host job agent.
+//
+// Parity: reference runner/cmd/runner + runner/internal/runner/api (http.go:20-122):
+// an HTTP API the control plane drives over an SSH tunnel (or directly for the local
+// backend): submit -> upload_code -> run -> pull(offset) -> stop, plus health/metrics.
+#include <signal.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "executor.hpp"
+#include "http.hpp"
+#include "json.hpp"
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 10999;
+  std::string base_dir = "/tmp/dstack-tpu-runner";
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--host") host = next();
+    else if (a == "--port") port = atoi(next().c_str());
+    else if (a == "--base-dir") base_dir = next();
+    else if (a == "--help") {
+      printf("usage: dstack-tpu-runner [--host H] [--port P] [--base-dir DIR]\n");
+      return 0;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  drunner::Executor executor(base_dir);
+  dhttp::Server server(host, port);
+
+  server.handle("GET", "/api/healthcheck", [&](const dhttp::Request&) {
+    return dhttp::Response{200, "application/json", executor.health().dump()};
+  });
+  server.handle("POST", "/api/submit", [&](const dhttp::Request& req) {
+    return dhttp::Response{200, "application/json",
+                           executor.submit(dj::Json::parse(req.body)).dump()};
+  });
+  server.handle("POST", "/api/upload_code", [&](const dhttp::Request& req) {
+    return dhttp::Response{200, "application/json", executor.upload_code(req.body).dump()};
+  });
+  server.handle("POST", "/api/run", [&](const dhttp::Request&) {
+    return dhttp::Response{200, "application/json", executor.run().dump()};
+  });
+  server.handle("GET", "/api/pull", [&](const dhttp::Request& req) {
+    int64_t offset = 0;
+    auto it = req.query.find("offset");
+    if (it != req.query.end()) offset = atoll(it->second.c_str());
+    return dhttp::Response{200, "application/json", executor.pull(offset).dump()};
+  });
+  server.handle("POST", "/api/stop", [&](const dhttp::Request& req) {
+    bool abort = false;
+    if (!req.body.empty()) abort = dj::Json::parse(req.body)["abort"].as_bool();
+    return dhttp::Response{200, "application/json", executor.stop(abort).dump()};
+  });
+  server.handle("GET", "/api/metrics", [&](const dhttp::Request&) {
+    return dhttp::Response{200, "application/json", executor.metrics().dump()};
+  });
+
+  // Port 0 resolves to an ephemeral port; print it so the spawner can read it.
+  printf("dstack-tpu-runner listening on %s:%d\n", host.c_str(), server.port());
+  fflush(stdout);
+  server.serve_forever();
+  return 0;
+}
