@@ -1,0 +1,139 @@
+"""Benchmark entry point. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+On a TPU host: Llama-style training-step MFU on one chip (the reference's north-star
+axis — BASELINE.json "MaxText Llama-3-8B ... >=50% MFU"; baseline = 50% MFU, so
+vs_baseline = MFU/50). The model is sized to a single chip's HBM; MFU is
+size-independent, making it the honest single-chip comparable.
+
+Without a TPU: control-plane scheduling throughput vs the reference's documented cap
+(75 submitted jobs/min/replica, reference server/background/__init__.py:57).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _tpu_peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    # Public per-chip bf16 peaks (workloads/config cites them too).
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
+def bench_tpu_train() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads import train as train_lib
+    from dstack_tpu.workloads.config import LlamaConfig
+
+    dev = jax.devices()[0]
+    # ~440M-param model: fp32 master + AdamW fits a 16GB v5e chip with remat.
+    cfg = LlamaConfig(
+        vocab_size=32000, d_model=1536, n_layers=12, n_heads=12, n_kv_heads=12,
+        d_ff=4096, max_seq_len=2048, remat=True,
+    )
+    batch, seq = 8, 2048
+    optimizer = train_lib.make_optimizer()
+    state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer)
+    step_fn = train_lib.make_train_step(cfg, optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+
+    # Warmup/compile. float() forces a device sync (block_until_ready is not reliable
+    # through every PJRT transport).
+    state, m = step_fn(state, tokens, targets)
+    float(m["loss"])
+
+    steps = 5
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, tokens, targets)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    flops_per_sec = tokens_per_sec * cfg.flops_per_token(seq)
+    mfu_pct = 100.0 * flops_per_sec / _tpu_peak_tflops(dev)
+    return {
+        "metric": "llama_train_step_mfu_1chip",
+        "value": round(mfu_pct, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu_pct / 50.0, 4),
+        "extra": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+            "params_m": round(cfg.num_params() / 1e6, 1),
+            "device": getattr(dev, "device_kind", "unknown"),
+            "batch": batch,
+            "seq": seq,
+        },
+    }
+
+
+def bench_scheduler() -> dict:
+    """150 single-job runs through the real scheduler loops against the mock TPU
+    backend + scripted runner (no cloud, no network)."""
+    import asyncio
+
+    from dstack_tpu.server.background import tasks
+    from tests.common import FakeRunnerClient, api_server, setup_mock_backend, tpu_task_spec
+
+    N = 150  # the reference's per-replica active-run capacity (BASELINE.md)
+
+    async def run() -> float:
+        FakeRunnerClient.reset()
+        tasks.get_runner_client = FakeRunnerClient.for_jpd
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            for i in range(N):
+                await api.post(
+                    "/api/project/main/runs/submit", tpu_task_spec(f"bench-{i}", "v5e-8")
+                )
+            t0 = time.perf_counter()
+            for _ in range(1000):
+                await tasks.process_submitted_jobs(api.db, batch=25)
+                await tasks.process_running_jobs(api.db, batch=50)
+                await tasks.process_terminating_jobs(api.db, batch=50)
+                await tasks.process_runs(api.db, batch=50)
+                done = await api.db.fetchone(
+                    "SELECT COUNT(*) AS n FROM runs WHERE status = 'done'"
+                )
+                if done["n"] >= N:
+                    break
+            return time.perf_counter() - t0
+
+    dt = asyncio.run(run())
+    rate = N * 60.0 / dt
+    return {
+        "metric": "runs_scheduled_to_done_per_min",
+        "value": round(rate, 1),
+        "unit": "runs/min",
+        "vs_baseline": round(rate / 75.0, 4),
+        "extra": {"runs": N, "seconds": round(dt, 2)},
+    }
+
+
+def main() -> None:
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        on_tpu = False
+    result = bench_tpu_train() if on_tpu else bench_scheduler()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,20 @@ logger = logging.getLogger(__name__)
 _LISTEN_RE = re.compile(r"listening on [\d.]+:(\d+)")
 
 
+def _pid_is_runner(pid: int, base_dir: Optional[str] = None) -> bool:
+    """True if pid is (still) one of our runner agents. The per-slice tempdir passed as
+    --base-dir is the discriminator — it survives custom binary names
+    (DSTACK_TPU_RUNNER_BINARY) and is unique per spawn."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            argv = f.read().split(b"\0")
+    except OSError:
+        return False
+    if base_dir is not None:
+        return base_dir.encode() in argv
+    return any(b"dstack-tpu-runner" in a for a in argv)
+
+
 class LocalCompute(Compute):
     TYPE = "local"
 
@@ -137,8 +151,14 @@ class LocalCompute(Compute):
         pid = proc.pid if proc is not None else None
         if pid is None and backend_data:
             try:
-                pid = json.loads(backend_data).get("runner_pid")
+                data = json.loads(backend_data)
+                pid = data.get("runner_pid")
+                base_dir = data.get("base_dir")
             except ValueError:
+                pid, base_dir = None, None
+            # After a server restart the persisted pid may have been recycled by an
+            # unrelated process: only signal if it is still our runner agent.
+            if pid is not None and not _pid_is_runner(pid, base_dir):
                 pid = None
         if pid:
             try:

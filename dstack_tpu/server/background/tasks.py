@@ -379,21 +379,39 @@ async def _process_pulling_or_running(db: Database, job_row) -> None:
     await db.execute(
         "UPDATE jobs SET disconnected_at = NULL WHERE id = ?", (job_row["id"],)
     )
-
     run_row = await db.fetchone("SELECT run_name, project_id FROM runs WHERE id = ?", (job_row["run_id"],))
-    events = [
-        LogEvent.model_validate(
-            {"timestamp": ev.get("ts") or to_iso(now_utc()), "message": ev.get("message", ""),
-             "log_source": ev.get("source", "stdout")}
-        )
-        for ev in result.get("logs", [])
-    ]
-    if events:
-        logs_service.get_log_storage().write_logs(
-            job_row["project_id"], run_row["run_name"], job_row["id"], events
-        )
 
-    jrd.pull_offset = result.get("offset", jrd.pull_offset)
+    # Drain the paginated backlog, persisting each page's logs + offset as it lands so
+    # a mid-drain failure never discards progress (the next tick resumes where this
+    # one stopped).
+    all_states: List[dict] = []
+    for _ in range(20):
+        events = [
+            LogEvent.model_validate(
+                {"timestamp": ev.get("ts") or to_iso(now_utc()), "message": ev.get("message", ""),
+                 "log_source": ev.get("source", "stdout")}
+            )
+            for ev in result.get("logs", [])
+        ]
+        if events:
+            logs_service.get_log_storage().write_logs(
+                job_row["project_id"], run_row["run_name"], job_row["id"], events
+            )
+        all_states.extend(result.get("job_states", []))
+        jrd.pull_offset = result.get("offset", jrd.pull_offset)
+        if not result.get("has_more"):
+            break
+        await db.execute(
+            "UPDATE jobs SET job_runtime_data = ? WHERE id = ?",
+            (jrd.model_dump_json(), job_row["id"]),
+        )
+        try:
+            result = await client.pull(offset=jrd.pull_offset)
+        except Exception:
+            break  # progress persisted; resume next tick
+        if not result:
+            break
+    result = {"job_states": all_states}
     new_status: Optional[JobStatus] = None
     reason: Optional[JobTerminationReason] = None
     reason_msg: Optional[str] = None

@@ -33,13 +33,24 @@ def find_runner_binary(build: bool = True) -> Optional[str]:
             if _BINARY.exists():
                 return str(_BINARY)
             try:
-                subprocess.run(
-                    ["make", "-C", str(_RUNNER_DIR)],
-                    check=True,
-                    capture_output=True,
-                    timeout=300,
-                )
-            except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+                # File lock so concurrent *processes* (server + tests) don't race the
+                # same build directory; the threading.Lock only covers this process.
+                import fcntl
+
+                lock_path = _RUNNER_DIR / ".build.lock"
+                with open(lock_path, "w") as lock_file:
+                    fcntl.flock(lock_file, fcntl.LOCK_EX)
+                    try:
+                        if not _BINARY.exists():
+                            subprocess.run(
+                                ["make", "-C", str(_RUNNER_DIR)],
+                                check=True,
+                                capture_output=True,
+                                timeout=300,
+                            )
+                    finally:
+                        fcntl.flock(lock_file, fcntl.LOCK_UN)
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
                 return None
         if _BINARY.exists():
             return str(_BINARY)

@@ -1,0 +1,10 @@
+"""Reference TPU workloads shipped with the orchestrator.
+
+The reference repo ships torch/vLLM example workloads under examples/ (SURVEY §2.6:
+parallelism lives in the user's container, the orchestrator only provides the cluster
+contract). This package is the TPU analog — a MaxText-style Llama training workload in
+pure JAX, sharded over a (dp, fsdp, tp, sp) mesh with ring attention for long context —
+used by the shipped examples, the benchmark, and the multi-chip dry run.
+"""
+
+from dstack_tpu.workloads.config import LlamaConfig  # noqa: F401

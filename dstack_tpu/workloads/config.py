@@ -1,0 +1,68 @@
+"""Model configurations for the Llama-style workload."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"  # compute/activation dtype
+    param_dtype: str = "float32"  # master weights
+    remat: bool = True  # rematerialize each block on the backward pass
+    # "full" recomputes everything; "dots" saves MXU outputs and recomputes only
+    # elementwise ops (less recompute, more HBM).
+    remat_policy: str = "full"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        """Parameter count (embeddings counted once; lm head untied)."""
+        d, v = self.d_model, self.vocab_size
+        attn = d * self.n_heads * self.head_dim + 2 * d * self.n_kv_heads * self.head_dim
+        attn += self.n_heads * self.head_dim * d
+        mlp = 3 * d * self.d_ff
+        per_layer = attn + mlp + 2 * d  # + norms
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs per token: 6*N plus attention score FLOPs
+        (12*L*T*d per token for fwd+bwd QK^T and AV)."""
+        return 6.0 * self.num_params() + 12.0 * self.n_layers * seq_len * self.d_model
+
+
+# Presets. llama3_8b mirrors the reference north-star workload (BASELINE.json:
+# "MaxText Llama-3-8B ... on v5p-16").
+PRESETS = {
+    "test": LlamaConfig(
+        vocab_size=4096, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4, d_ff=688,
+        max_seq_len=2048, param_dtype="float32",
+    ),
+    "llama3_1b": LlamaConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8, d_ff=5504,
+        max_seq_len=8192,
+    ),
+    "llama3_8b": LlamaConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        max_seq_len=8192,
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> LlamaConfig:
+    cfg = PRESETS[name]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg

@@ -1,0 +1,171 @@
+"""Llama-style decoder in pure functional JAX, sharded via NamedSharding constraints.
+
+TPU-first choices:
+- layer weights are stacked on a leading axis and the block runs under ``lax.scan`` —
+  one compiled block regardless of depth (fast compile, XLA-friendly);
+- activations stay bfloat16, matmuls hit the MXU with fp32 accumulation
+  (``preferred_element_type``);
+- per-block rematerialization (``jax.checkpoint``) trades FLOPs for HBM;
+- attention is blockwise/ring (attention.py) so long context never materializes T².
+
+Parity: the MaxText-analog workload for the reference's distributed-training examples
+(reference examples/distributed-training; BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.workloads.attention import blockwise_attention, ring_attention
+from dstack_tpu.workloads.config import LlamaConfig
+
+Params = Dict[str, jax.Array]
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Initialize the stacked-layer parameter tree (shapes documented in
+    sharding.PARAM_SPECS)."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    d, v, f = cfg.d_model, cfg.vocab_size, cfg.d_ff
+    h, kh, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    keys = jax.random.split(key, 10)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, pdt)
+
+    def dense_init(k, *shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(pdt)
+
+    return {
+        "embed": dense_init(keys[0], v, d, fan_in=d),
+        "wq": dense_init(keys[1], L, d, h * hd, fan_in=d),
+        "wk": dense_init(keys[2], L, d, kh * hd, fan_in=d),
+        "wv": dense_init(keys[3], L, d, kh * hd, fan_in=d),
+        "wo": dense_init(keys[4], L, h * hd, d, fan_in=h * hd),
+        "w_gate": dense_init(keys[5], L, d, f, fan_in=d),
+        "w_up": dense_init(keys[6], L, d, f, fan_in=d),
+        "w_down": dense_init(keys[7], L, f, d, fan_in=f),
+        "attn_norm": norm_init(L, d),
+        "mlp_norm": norm_init(L, d),
+        "final_norm": norm_init(d),
+        "lm_head": dense_init(keys[8], d, v, fan_in=d),
+    }
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x [B,T,H,D], positions [T] (global, so sequence-parallel
+    chunks rotate correctly)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Returns logits [B, T, V] (float32). When `mesh` is given, activation sharding
+    constraints are inserted and attention runs ring-parallel over `sp`."""
+    adt = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+
+    def act_constraint(x, spec):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    x = params["embed"].astype(adt)[tokens]  # [B,T,D]
+    x = act_constraint(x, P(("dp", "fsdp"), "sp", None))
+    positions = jnp.arange(t)
+
+    def block(x, layer):
+        h_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
+                       preferred_element_type=jnp.float32).astype(adt)
+        k = jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
+                       preferred_element_type=jnp.float32).astype(adt)
+        v = jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
+                       preferred_element_type=jnp.float32).astype(adt)
+        q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        q = act_constraint(q, P(("dp", "fsdp"), "sp", "tp", None))
+        k = act_constraint(k, P(("dp", "fsdp"), "sp", "tp", None))
+        v = act_constraint(v, P(("dp", "fsdp"), "sp", "tp", None))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if use_sp:
+            o = ring_attention(q, k, v, mesh)
+        else:
+            o = blockwise_attention(q, k, v)
+        o = o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim)
+        attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
+                              preferred_element_type=jnp.float32).astype(adt)
+        x = x + act_constraint(attn_out, P(("dp", "fsdp"), "sp", None))
+
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
+                          preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
+                        preferred_element_type=jnp.float32)
+        hidden = (jax.nn.silu(gate) * up).astype(adt)
+        hidden = act_constraint(hidden, P(("dp", "fsdp"), "sp", "tp"))
+        mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
+                             preferred_element_type=jnp.float32).astype(adt)
+        x = x + act_constraint(mlp_out, P(("dp", "fsdp"), "sp", None))
+        return x
+
+    layer_params = {
+        k: params[k]
+        for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm")
+    }
+    block_fn = block
+    if cfg.remat:
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        block_fn = jax.checkpoint(block, prevent_cse=True, policy=policy)
+
+    def scan_body(x, layer):
+        return block_fn(x, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, layer_params)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return act_constraint(logits, P(("dp", "fsdp"), "sp", None))
+
+
+def loss_fn(
+    params: Params,
+    tokens: jax.Array,   # [B, T]
+    targets: jax.Array,  # [B, T]; -1 = ignore
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    logits = forward(params, tokens, cfg, mesh)
+    mask = targets >= 0
+    safe_targets = jnp.where(mask, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)

@@ -1,0 +1,88 @@
+"""Mesh + sharding rules for the Llama workload.
+
+TPU-first design (scaling-book recipe): pick a mesh, annotate shardings with
+NamedSharding, let XLA insert the collectives. Axes:
+
+- ``dp``   data parallel (pure replication of params, batch split)
+- ``fsdp`` fully-sharded data parallel (params sharded over it, batch split;
+           XLA inserts all-gather on use / reduce-scatter on grads)
+- ``tp``   tensor parallel (attention heads / MLP hidden sharded)
+- ``sp``   sequence/context parallel (activations sharded over sequence; ring
+           attention moves KV blocks around this axis over ICI)
+
+Parity note: the reference has no model parallelism of its own (SURVEY §2.6); this is
+the workload-side counterpart the TPU framework ships as a first-class example.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "fsdp", "tp", "sp")
+
+
+def make_mesh(
+    dp: int = 1,
+    fsdp: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a (dp, fsdp, tp, sp) mesh; fsdp=None absorbs the remaining devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if fsdp is None:
+        denom = dp * tp * sp
+        if n % denom != 0:
+            raise ValueError(f"{n} devices not divisible by dp*tp*sp={denom}")
+        fsdp = n // denom
+    if dp * fsdp * tp * sp != n:
+        raise ValueError(f"mesh {dp}x{fsdp}x{tp}x{sp} != {n} devices")
+    arr = np.array(devices).reshape(dp, fsdp, tp, sp)
+    return Mesh(arr, MESH_AXES)
+
+
+# Logical -> physical sharding rules for the stacked-layer parameter tree (model.py).
+# Layer-stacked tensors carry a leading L axis that stays unsharded.
+PARAM_SPECS: Dict[str, P] = {
+    "embed": P("tp", ("dp", "fsdp")),          # [V, D] vocab over tp
+    "wq": P(None, ("dp", "fsdp"), "tp"),       # [L, D, H*Dh]
+    "wk": P(None, ("dp", "fsdp"), "tp"),       # [L, D, Hkv*Dh]
+    "wv": P(None, ("dp", "fsdp"), "tp"),
+    "wo": P(None, "tp", ("dp", "fsdp")),       # [L, H*Dh, D]
+    "w_gate": P(None, ("dp", "fsdp"), "tp"),   # [L, D, F]
+    "w_up": P(None, ("dp", "fsdp"), "tp"),
+    "w_down": P(None, "tp", ("dp", "fsdp")),   # [L, F, D]
+    "attn_norm": P(None, None),                # [L, D]
+    "mlp_norm": P(None, None),
+    "final_norm": P(None),                     # [D]
+    "lm_head": P(("dp", "fsdp"), "tp"),        # [D, V]
+}
+
+# Note: params are sharded over BOTH dp and fsdp ("zero-3 over the dp axis too") —
+# with dp=1 this degenerates to classic FSDP; replicated-dp is recovered by dp=1.
+
+BATCH_SPEC = P(("dp", "fsdp"), "sp")  # tokens [B, T]
+ACT_SPEC = P(("dp", "fsdp"), "sp", "tp")  # activations [B, T, D']
+
+
+def param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, spec) for k, spec in PARAM_SPECS.items()}
+
+
+def shard_params(params: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:
+    shardings = param_sharding(mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, BATCH_SPEC)
+
+
+def pad_to_multiple(x: int, m: int) -> int:
+    return int(math.ceil(x / m) * m)

@@ -1,14 +1,17 @@
 #include "executor.hpp"
 
+#include <cerrno>
 #include <fcntl.h>
 #include <poll.h>
 #include <pty.h>
+#include <sys/ioctl.h>
 #include <signal.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstring>
 #include <ctime>
 #include <fstream>
@@ -48,11 +51,17 @@ dj::Json Executor::health() const {
   return out;
 }
 
+static bool state_is_terminal(const std::string& s) {
+  return s == "done" || s == "failed" || s == "terminated" || s == "aborted";
+}
+
 dj::Json Executor::submit(const dj::Json& body) {
   std::lock_guard<std::mutex> lk(mu_);
-  if (job_started_ && current_state_ == "running") {
-    // Idempotent re-submit of the same job (the control plane retries when a
-    // submit/run response is lost); a different job is a real conflict.
+  // While a started job is live (anywhere between run() and its terminal state) the
+  // spec MUST NOT be mutated: exec_thread reads it without the lock. A retried
+  // submit of the same job (the control plane retries when a submit/run response is
+  // lost) is answered idempotently; a different job is a real conflict.
+  if (job_started_ && !state_is_terminal(current_state_)) {
     if (body["job_spec"]["job_name"].as_string() == job_spec_["job_name"].as_string()) {
       return dj::Json::object();
     }
@@ -105,8 +114,20 @@ dj::Json Executor::pull(int64_t offset) {
   dj::Json states = dj::Json::array();
   dj::Json logs = dj::Json::array();
   int64_t max_seq = offset;
-  for (const auto& ev : events_) {
-    if (ev.seq <= offset) continue;
+  // seq is strictly monotonic: binary-search the resume point instead of scanning the
+  // whole window, and cap the page so a chatty job can't blow the client's timeout.
+  const size_t kMaxEvents = 5000;
+  auto it = std::lower_bound(
+      events_.begin(), events_.end(), offset,
+      [](const Event& ev, int64_t off) { return ev.seq <= off; });
+  bool has_more = false;
+  size_t taken = 0;
+  for (; it != events_.end(); ++it) {
+    const Event& ev = *it;
+    if (++taken > kMaxEvents) {
+      has_more = true;
+      break;
+    }
     if (ev.is_state) {
       dj::Json s = dj::Json::object();
       s.set("state", ev.state);
@@ -127,6 +148,7 @@ dj::Json Executor::pull(int64_t offset) {
   out.set("job_states", std::move(states));
   out.set("logs", std::move(logs));
   out.set("offset", max_seq);
+  out.set("has_more", has_more);
   out.set("state", current_state_);
   return out;
 }
@@ -283,15 +305,31 @@ void Executor::exec_thread() {
   for (auto& kv : cluster_env(cluster_info_)) env_strings.push_back(kv);
   env_strings.push_back("DSTACK_REPO_DIR=" + repo_dir);
 
-  int master_fd;
-  pid_t pid = forkpty(&master_fd, nullptr, nullptr, nullptr);
+  // Manual openpty+fork instead of forkpty: glibc's forkpty child _exit(1)s when
+  // TIOCSCTTY fails, which happens when the kernel recycles a pty index that is still
+  // the controlling tty of a lingering older session (intermittent silent exit-1 under
+  // job churn). We don't need job control -- a failed TIOCSCTTY is fine.
+  int master_fd, slave_fd;
+  if (openpty(&master_fd, &slave_fd, nullptr, nullptr, nullptr) != 0) {
+    add_state("failed", -1, "openpty failed");
+    return;
+  }
+  pid_t pid = fork();
   if (pid < 0) {
-    add_state("failed", -1, "forkpty failed");
+    close(master_fd);
+    close(slave_fd);
+    add_state("failed", -1, "fork failed");
     return;
   }
   if (pid == 0) {
-    // Child: own process group so stop() can signal the whole tree.
-    setpgid(0, 0);
+    // Child: new session + own process group so stop() can signal the whole tree.
+    setsid();
+    (void)ioctl(slave_fd, TIOCSCTTY, 0);  // best-effort; see above
+    dup2(slave_fd, 0);
+    dup2(slave_fd, 1);
+    dup2(slave_fd, 2);
+    if (slave_fd > 2) close(slave_fd);
+    close(master_fd);
     if (chdir(workdir.c_str()) != 0) {
       int rc = chdir("/");
       (void)rc;
@@ -302,6 +340,7 @@ void Executor::exec_thread() {
     execle("/bin/sh", "sh", "-c", script.c_str(), static_cast<char*>(nullptr), envp.data());
     _exit(127);
   }
+  close(slave_fd);
   setpgid(pid, pid);
   child_pid_ = pid;
   // Close the stop() race: a stop that arrived while we were extracting code (before

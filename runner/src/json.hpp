@@ -230,17 +230,37 @@ class Json {
           case '\\': out += '\\'; break;
           case 'u': {
             if (p + 4 >= t.size()) throw std::runtime_error("bad \\u escape");
-            unsigned int cp = std::stoul(t.substr(p + 1, 4), nullptr, 16);
+            unsigned long cp = std::stoul(t.substr(p + 1, 4), nullptr, 16);
             p += 4;
-            // UTF-8 encode (surrogate pairs folded to the replacement char — the
-            // runner only relays log text, exact astral-plane fidelity not needed).
+            // Combine UTF-16 surrogate pairs (python json.dumps with ensure_ascii
+            // emits astral-plane chars this way); lone surrogates fold to U+FFFD.
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (p + 6 < t.size() && t[p + 1] == '\\' && t[p + 2] == 'u') {
+                unsigned long lo = std::stoul(t.substr(p + 3, 4), nullptr, 16);
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                  p += 6;
+                } else {
+                  cp = 0xFFFD;
+                }
+              } else {
+                cp = 0xFFFD;
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              cp = 0xFFFD;  // lone low surrogate
+            }
             if (cp < 0x80) {
               out += static_cast<char>(cp);
             } else if (cp < 0x800) {
               out += static_cast<char>(0xC0 | (cp >> 6));
               out += static_cast<char>(0x80 | (cp & 0x3F));
-            } else {
+            } else if (cp < 0x10000) {
               out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (cp >> 18));
+              out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
               out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
               out += static_cast<char>(0x80 | (cp & 0x3F));
             }

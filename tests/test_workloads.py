@@ -1,0 +1,163 @@
+"""Workload tests on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8; devices selected explicitly because the axon
+TPU plugin ignores JAX_PLATFORMS)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# The axon TPU plugin ignores JAX_PLATFORMS; pin computation to the CPU backend so
+# numerics are fp32 (TPU fp32 matmuls round through the bf16 MXU) and compiles are
+# local/fast.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import train as train_lib
+from dstack_tpu.workloads.attention import blockwise_attention, ring_attention
+from dstack_tpu.workloads.config import get_config
+from dstack_tpu.workloads.sharding import (
+    PARAM_SPECS,
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+)
+
+
+def cpu_devices(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices, have {len(devs)}")
+    return devs[:n]
+
+
+def naive_attention(q, k, v, causal=True):
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bthd,bshd->bths", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        t, S = q.shape[1], k.shape[1]
+        mask = jnp.arange(S)[None, :] <= jnp.arange(t)[:, None]
+        s = jnp.where(mask[None, :, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bths,bshd->bthd", p, v.astype(jnp.float32))
+
+
+class TestAttention:
+    def test_blockwise_matches_naive(self):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (2, 300, 4, 16))
+        k = jax.random.normal(kk, (2, 300, 2, 16))  # GQA 2:1
+        v = jax.random.normal(kv, (2, 300, 2, 16))
+        out_block = blockwise_attention(q, k, v, block_size=128)
+        out_naive = naive_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_block), np.asarray(out_naive), atol=2e-5)
+
+    def test_ring_matches_blockwise(self):
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=1, fsdp=2, tp=1, sp=4, devices=devs)
+        key = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (2, 256, 4, 16))
+        k = jax.random.normal(kk, (2, 256, 4, 16))
+        v = jax.random.normal(kv, (2, 256, 4, 16))
+        with mesh:
+            out_ring = ring_attention(q, k, v, mesh)
+        out_ref = blockwise_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out_ring, dtype=np.float32), np.asarray(out_ref), atol=2e-5
+        )
+
+
+class TestModel:
+    def test_param_count_llama8b(self):
+        cfg = get_config("llama3_8b")
+        assert 7.5e9 < cfg.num_params() < 8.5e9
+
+    def test_forward_shapes_and_finite(self):
+        cfg = get_config("test")
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+        logits = jax.jit(lambda p, t: model_lib.forward(p, t, cfg))(params, tokens)
+        assert logits.shape == (2, 64, cfg.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_causality(self):
+        """Changing a future token must not change past logits."""
+        cfg = get_config("test")
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, cfg.vocab_size)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+        l1 = model_lib.forward(params, t1, cfg)
+        l2 = model_lib.forward(params, t2, cfg)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]), atol=1e-5
+        )
+        assert not np.allclose(np.asarray(l1[0, -1]), np.asarray(l2[0, -1]))
+
+
+class TestShardedTraining:
+    def test_train_step_loss_decreases_on_mesh(self):
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=2, fsdp=2, tp=2, sp=1, devices=devs)
+        cfg = get_config("test")
+        optimizer = train_lib.make_optimizer(learning_rate=1e-3)
+        with mesh:
+            state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
+            # Params landed with the declared shardings.
+            shardings = param_sharding(mesh)
+            for name, arr in state.params.items():
+                assert arr.sharding == shardings[name], name
+            step = train_lib.make_train_step(cfg, optimizer, mesh)
+            bspec = batch_sharding(mesh)
+            tokens = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(1), (4, 128), 0, cfg.vocab_size), bspec
+            )
+            targets = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(2), (4, 128), 0, cfg.vocab_size), bspec
+            )
+            losses = []
+            for _ in range(4):
+                state, metrics = step(state, tokens, targets)
+                losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_sp_mesh_with_ring_attention_trains(self):
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2, devices=devs)
+        cfg = get_config("test")
+        optimizer = train_lib.make_optimizer()
+        with mesh:
+            state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
+            step = train_lib.make_train_step(cfg, optimizer, mesh)
+            bspec = batch_sharding(mesh)
+            tokens = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0, cfg.vocab_size), bspec
+            )
+            targets = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(2), (2, 256), 0, cfg.vocab_size), bspec
+            )
+            state, metrics = step(state, tokens, targets)
+            loss = float(metrics["loss"])
+        assert np.isfinite(loss) and loss > 0
+
+    def test_sharded_forward_matches_single_device(self):
+        devs = cpu_devices(8)
+        # fp32 compute so differences measure sharding correctness, not bf16 noise.
+        cfg = get_config("test", dtype="float32")
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, cfg.vocab_size)
+        ref = model_lib.forward(params, tokens, cfg)
+
+        mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2, devices=devs)
+        from dstack_tpu.workloads.sharding import shard_params
+
+        with mesh:
+            sp = shard_params(params, mesh)
+            tok_sharded = jax.device_put(tokens, batch_sharding(mesh))
+            out = jax.jit(lambda p, t: model_lib.forward(p, t, cfg, mesh))(sp, tok_sharded)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4, rtol=1e-3)
