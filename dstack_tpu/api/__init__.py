@@ -1,0 +1,3 @@
+"""Python SDK (parity: reference src/dstack/api — Client + RunCollection)."""
+
+from dstack_tpu.api.client import Client  # noqa: F401

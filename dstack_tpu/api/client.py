@@ -1,0 +1,271 @@
+"""Synchronous REST client.
+
+Parity: reference src/dstack/api (Client -> RunCollection api/_public/runs.py:391-736,
+low-level wrappers api/server/_*.py) — one flat client class per domain, returning
+parsed wire models."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import requests
+
+from dstack_tpu.core.errors import (
+    ForbiddenError,
+    NotAuthenticatedError,
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerClientError,
+)
+from dstack_tpu.core.models.fleets import Fleet, FleetPlan, FleetSpec
+from dstack_tpu.core.models.instances import Instance
+from dstack_tpu.core.models.logs import JobSubmissionLogs
+from dstack_tpu.core.models.runs import Run, RunPlan, RunSpec
+from dstack_tpu.core.models.volumes import Volume
+
+_STATUS_ERRORS = {
+    401: NotAuthenticatedError,
+    403: ForbiddenError,
+    404: ResourceNotExistsError,
+    409: ResourceExistsError,
+}
+
+
+class ApiError(ServerClientError):
+    pass
+
+
+class Client:
+    """`Client(url, token, project)`; sub-APIs: runs, fleets, volumes, secrets, repos,
+    offers, backends, logs, instances."""
+
+    def __init__(self, url: str, token: str, project: str = "main", timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.token = token
+        self.project = project
+        self.timeout = timeout
+        self._session = requests.Session()
+        self._session.headers["Authorization"] = f"Bearer {token}"
+        self.runs = RunsApi(self)
+        self.fleets = FleetsApi(self)
+        self.volumes = VolumesApi(self)
+        self.secrets = SecretsApi(self)
+        self.repos = ReposApi(self)
+        self.offers = OffersApi(self)
+        self.backends = BackendsApi(self)
+        self.logs = LogsApi(self)
+        self.instances = InstancesApi(self)
+
+    def post(self, path: str, body: Optional[dict] = None, data: Optional[bytes] = None) -> Any:
+        url = self.url + path
+        if data is not None:
+            resp = self._session.post(url, data=data, timeout=self.timeout)
+        else:
+            resp = self._session.post(url, json=body or {}, timeout=self.timeout)
+        if resp.status_code >= 400:
+            detail = ""
+            try:
+                detail = resp.json()["detail"][0]["msg"]
+            except Exception:
+                detail = resp.text[:300]
+            err_cls = _STATUS_ERRORS.get(resp.status_code, ApiError)
+            raise err_cls(detail)
+        if not resp.content:
+            return None
+        return resp.json()
+
+    def _p(self, path: str) -> str:
+        return f"/api/project/{self.project}{path}"
+
+
+class RunsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def get_plan(self, run_spec: dict) -> RunPlan:
+        data = self._c.post(self._c._p("/runs/get_plan"), {"run_spec": run_spec})
+        return RunPlan.model_validate(data)
+
+    def submit(self, run_spec: dict) -> Run:
+        data = self._c.post(self._c._p("/runs/submit"), {"run_spec": run_spec})
+        return Run.model_validate(data)
+
+    def apply_plan(self, run_spec: dict, force: bool = False) -> Run:
+        data = self._c.post(
+            self._c._p("/runs/apply_plan"), {"run_spec": run_spec, "force": force}
+        )
+        return Run.model_validate(data)
+
+    def list(self) -> List[Run]:
+        data = self._c.post(self._c._p("/runs/list"))
+        return [Run.model_validate(r) for r in data]
+
+    def get(self, run_name: str) -> Run:
+        data = self._c.post(self._c._p("/runs/get"), {"run_name": run_name})
+        return Run.model_validate(data)
+
+    def stop(self, run_names: List[str], abort: bool = False) -> None:
+        self._c.post(self._c._p("/runs/stop"), {"runs_names": run_names, "abort": abort})
+
+    def delete(self, run_names: List[str]) -> None:
+        self._c.post(self._c._p("/runs/delete"), {"runs_names": run_names})
+
+    def wait(self, run_name: str, poll: float = 2.0, timeout: Optional[float] = None) -> Run:
+        """Block until the run reaches a terminal status."""
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            run = self.get(run_name)
+            if run.status.is_finished():
+                return run
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError(f"run {run_name} still {run.status.value}")
+            time.sleep(poll)
+
+
+class FleetsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def list(self) -> List[Fleet]:
+        return [Fleet.model_validate(f) for f in self._c.post(self._c._p("/fleets/list"))]
+
+    def get(self, name: str) -> Fleet:
+        return Fleet.model_validate(self._c.post(self._c._p("/fleets/get"), {"name": name}))
+
+    def get_plan(self, spec: dict) -> FleetPlan:
+        return FleetPlan.model_validate(
+            self._c.post(self._c._p("/fleets/get_plan"), {"spec": spec})
+        )
+
+    def apply_plan(self, spec: dict, force: bool = False) -> Fleet:
+        return Fleet.model_validate(
+            self._c.post(self._c._p("/fleets/apply_plan"), {"spec": spec, "force": force})
+        )
+
+    def delete(self, names: List[str]) -> None:
+        self._c.post(self._c._p("/fleets/delete"), {"names": names})
+
+
+class VolumesApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def list(self) -> List[Volume]:
+        return [Volume.model_validate(v) for v in self._c.post(self._c._p("/volumes/list"))]
+
+    def create(self, configuration: dict) -> Volume:
+        return Volume.model_validate(
+            self._c.post(self._c._p("/volumes/create"), {"configuration": configuration})
+        )
+
+    def delete(self, names: List[str]) -> None:
+        self._c.post(self._c._p("/volumes/delete"), {"names": names})
+
+
+class SecretsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def set(self, name: str, value: str) -> None:
+        self._c.post(self._c._p("/secrets/set"), {"name": name, "value": value})
+
+    def list(self) -> List[str]:
+        return [s["name"] for s in self._c.post(self._c._p("/secrets/list"))]
+
+    def delete(self, names: List[str]) -> None:
+        self._c.post(self._c._p("/secrets/delete"), {"names": names})
+
+
+class ReposApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def init(self, repo_name: str, repo_info: Optional[dict] = None) -> dict:
+        return self._c.post(
+            self._c._p("/repos/init"), {"repo_name": repo_name, "repo_info": repo_info}
+        )
+
+    def list(self) -> List[dict]:
+        return self._c.post(self._c._p("/repos/list"))
+
+    def upload_code(self, repo_name: str, blob: bytes) -> str:
+        data = self._c.post(self._c._p(f"/repos/{repo_name}/upload_code"), data=blob)
+        return data["code_hash"]
+
+
+class OffersApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def list(
+        self,
+        resources: Optional[dict] = None,
+        spot: Optional[bool] = None,
+        max_price: Optional[float] = None,
+        limit: int = 100,
+    ) -> dict:
+        return self._c.post(
+            self._c._p("/offers/list"),
+            {"resources": resources, "spot": spot, "max_price": max_price, "limit": limit},
+        )
+
+
+class BackendsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def create(self, config: dict) -> None:
+        self._c.post(self._c._p("/backends/create"), config)
+
+    def list(self) -> List[dict]:
+        return self._c.post(self._c._p("/backends/list"))
+
+    def delete(self, types: List[str]) -> None:
+        self._c.post(self._c._p("/backends/delete"), {"types": types})
+
+
+class InstancesApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def list(self) -> List[Instance]:
+        data = self._c.post(self._c._p("/instances/list"))
+        return [Instance.model_validate(i) for i in data]
+
+
+class LogsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def poll(
+        self,
+        run_name: str,
+        job_id: Optional[str] = None,
+        start_line: int = 0,
+        limit: int = 1000,
+    ) -> JobSubmissionLogs:
+        data = self._c.post(
+            self._c._p("/logs/poll"),
+            {"run_name": run_name, "job_id": job_id, "start_line": start_line, "limit": limit},
+        )
+        return JobSubmissionLogs.model_validate(data)
+
+    def tail(self, run_name: str, poll: float = 1.0) -> Iterator[str]:
+        """Yield log lines until the run finishes."""
+        line = 0
+        while True:
+            batch = self.poll(run_name, start_line=line)
+            for ev in batch.logs:
+                yield ev.message
+            line += len(batch.logs)
+            run = self._c.runs.get(run_name)
+            if run.status.is_finished() and not batch.logs:
+                # One final poll so the tail is complete.
+                batch = self.poll(run_name, start_line=line)
+                for ev in batch.logs:
+                    yield ev.message
+                return
+            if not batch.logs:
+                time.sleep(poll)

@@ -1,0 +1,42 @@
+"""CLI-side config: ~/.dstack-tpu/config.yml (parity: reference
+core/services/configs ConfigManager — server url/token/project per profile)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+CONFIG_DIR = Path(os.getenv("DSTACK_TPU_CLI_CONFIG_DIR", os.path.expanduser("~/.dstack-tpu")))
+CONFIG_PATH = CONFIG_DIR / "config.yml"
+
+
+class CliConfig:
+    def __init__(self, url: str = "http://127.0.0.1:3000", token: str = "", project: str = "main"):
+        self.url = url
+        self.token = token
+        self.project = project
+
+    @classmethod
+    def load(cls) -> "CliConfig":
+        if not CONFIG_PATH.exists():
+            return cls(
+                url=os.getenv("DSTACK_TPU_URL", "http://127.0.0.1:3000"),
+                token=os.getenv("DSTACK_TPU_TOKEN", ""),
+                project=os.getenv("DSTACK_TPU_PROJECT", "main"),
+            )
+        data = yaml.safe_load(CONFIG_PATH.read_text()) or {}
+        return cls(
+            url=os.getenv("DSTACK_TPU_URL") or data.get("url", "http://127.0.0.1:3000"),
+            token=os.getenv("DSTACK_TPU_TOKEN") or data.get("token", ""),
+            project=os.getenv("DSTACK_TPU_PROJECT") or data.get("project", "main"),
+        )
+
+    def save(self) -> None:
+        CONFIG_DIR.mkdir(parents=True, exist_ok=True)
+        CONFIG_PATH.write_text(
+            yaml.safe_dump({"url": self.url, "token": self.token, "project": self.project})
+        )
+        os.chmod(CONFIG_PATH, 0o600)

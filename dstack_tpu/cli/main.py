@@ -1,0 +1,449 @@
+"""dstack-tpu CLI.
+
+Parity: reference src/dstack/_internal/cli (main.py + commands/*) — argparse
+subcommands: server/config/init/apply/ps/stop/logs/delete/offer/fleet/volume/secret/
+backend. `apply` dispatches on the configuration `type` (run vs fleet vs volume), like
+the reference ApplyCommand (cli/commands/apply.py:90-135)."""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+import tarfile
+import time
+from pathlib import Path
+from typing import List, Optional
+
+import yaml
+
+from dstack_tpu.api.client import Client
+from dstack_tpu.cli.config import CliConfig
+from dstack_tpu.core.errors import DstackTpuError
+from dstack_tpu.core.models.configurations import parse_configuration
+from dstack_tpu.server import settings as server_settings
+
+
+def _client() -> Client:
+    cfg = CliConfig.load()
+    if not cfg.token:
+        raise DstackTpuError(
+            "no token configured; run `dstack-tpu config --url URL --token TOKEN`"
+        )
+    return Client(cfg.url, cfg.token, cfg.project)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers)]
+    for row in rows:
+        lines.append(fmt.format(*(str(c) for c in row)))
+    return "\n".join(lines)
+
+
+def _age(iso: Optional[str]) -> str:
+    if not iso:
+        return "-"
+    from dstack_tpu.utils.common import from_iso, now_utc, pretty_resources_duration
+
+    try:
+        dt = from_iso(str(iso))
+    except ValueError:
+        return "-"
+    return pretty_resources_duration((now_utc() - dt).total_seconds())
+
+
+# ---------------------------------------------------------------------------- commands
+
+
+def cmd_server(args) -> None:
+    from dstack_tpu.server.app import main as server_main
+
+    server_main(host=args.host, port=args.port)
+
+
+def cmd_config(args) -> None:
+    cfg = CliConfig.load()
+    if args.url:
+        cfg.url = args.url
+    if args.token:
+        cfg.token = args.token
+    if args.project:
+        cfg.project = args.project
+    cfg.save()
+    print(f"configured {cfg.url} (project {cfg.project})")
+
+
+def _repo_name() -> str:
+    return Path.cwd().name or "repo"
+
+
+def cmd_init(args) -> None:
+    client = _client()
+    result = client.repos.init(_repo_name())
+    print(f"initialized repo {result['repo_id']} in project {client.project}")
+
+
+def _pack_code(root: Path, max_size: int) -> Optional[bytes]:
+    """tar.gz the working tree (skipping .git and obvious junk); None if too big."""
+    buf = io.BytesIO()
+    skip_dirs = {".git", "__pycache__", ".venv", "node_modules", ".pytest_cache"}
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for path in sorted(root.rglob("*")):
+            rel = path.relative_to(root)
+            if any(part in skip_dirs for part in rel.parts):
+                continue
+            if path.is_file() and not path.is_symlink():
+                tar.add(path, arcname=str(rel))
+            if buf.tell() > max_size:
+                return None
+    data = buf.getvalue()
+    return data if len(data) <= max_size else None
+
+
+def cmd_apply(args) -> None:
+    path = Path(args.file)
+    data = yaml.safe_load(path.read_text())
+    conf = parse_configuration(data)
+    client = _client()
+
+    if conf.type == "fleet":
+        plan = client.fleets.get_plan({"configuration": data, "configuration_path": str(path)})
+        print(f"fleet {plan.effective_name}: {plan.total_offers} offers, action={plan.action}")
+        if not args.yes and not _confirm():
+            return
+        fleet = client.fleets.apply_plan(
+            {"configuration": data, "configuration_path": str(path)}, force=args.force
+        )
+        print(f"fleet {fleet.name} {fleet.status.value}")
+        return
+    if conf.type == "volume":
+        vol = client.volumes.create(data)
+        print(f"volume {vol.name} {vol.status.value}")
+        return
+    if conf.type == "gateway":
+        raise DstackTpuError("gateway apply is handled by the gateways milestone")
+
+    # Run configurations (task/service/dev-environment).
+    run_spec: dict = {"configuration": data, "configuration_path": str(path)}
+    if args.name:
+        run_spec["run_name"] = args.name
+    plan = client.runs.get_plan(dict(run_spec))
+    name = plan.effective_run_name
+    print(f"run {name} ({conf.type}): {plan.total_offers} offers")
+    for offer in plan.offers[:3]:
+        inst = offer["instance"]
+        print(
+            f"  {offer['backend']:>8} {offer['region']:<16} {inst['name']:<14}"
+            f" ${offer['price']}/hr" + (" (spot)" if offer.get("spot") else "")
+        )
+    if plan.total_offers == 0:
+        print("  no offers match the requirements", file=sys.stderr)
+    if not args.yes and not _confirm():
+        return
+
+    run_spec["run_name"] = name
+    if not args.no_repo:
+        code = _pack_code(Path.cwd(), server_settings.MAX_CODE_SIZE)
+        if code is None:
+            print("warning: working tree exceeds the code size limit; running without code")
+        else:
+            repo = _repo_name()
+            client.repos.init(repo)
+            code_hash = client.repos.upload_code(repo, code)
+            run_spec["repo_id"] = repo
+            run_spec["repo_data"] = {"code_hash": code_hash}
+
+    run = client.runs.submit(run_spec)
+    print(f"submitted {run.run_name} ({run.status.value})")
+    if args.detach:
+        return
+    _attach(client, run.run_name)
+
+
+def _confirm() -> bool:
+    if not sys.stdin.isatty():
+        # Non-interactive without -y must not silently provision paid resources.
+        print("error: not a terminal; pass -y to confirm", file=sys.stderr)
+        return False
+    answer = input("continue? [y/N] ").strip().lower()
+    return answer in ("y", "yes")
+
+
+def _attach(client: Client, run_name: str) -> None:
+    """Stream status transitions + logs until the run finishes (parity: reference
+    Run.attach + CLI log streaming)."""
+    print(f"attached to {run_name} (Ctrl-C to detach)")
+    last_status = None
+    line = 0
+    try:
+        while True:
+            run = client.runs.get(run_name)
+            if run.status.value != last_status:
+                print(f"[{run.status.value}]", file=sys.stderr)
+                last_status = run.status.value
+            batch = client.logs.poll(run_name, start_line=line)
+            for ev in batch.logs:
+                sys.stdout.write(ev.message.replace("\r\n", "\n"))
+            sys.stdout.flush()
+            line += len(batch.logs)
+            if run.status.is_finished():
+                if not batch.logs:
+                    tail = client.logs.poll(run_name, start_line=line)
+                    for ev in tail.logs:
+                        sys.stdout.write(ev.message.replace("\r\n", "\n"))
+                    sys.stdout.flush()
+                    print(f"run {run_name} finished: {run.status.value}", file=sys.stderr)
+                    if run.status.value == "failed":
+                        sys.exit(1)
+                    return
+            else:
+                time.sleep(1.0)
+    except KeyboardInterrupt:
+        print(f"\ndetached; `dstack-tpu stop {run_name}` to stop the run", file=sys.stderr)
+
+
+def cmd_ps(args) -> None:
+    client = _client()
+    runs = client.runs.list()
+    if not args.all:
+        runs = [r for r in runs if not r.status.is_finished()] or runs[:5]
+    rows = []
+    for r in runs:
+        conf = r.run_spec.configuration
+        resources = conf.resources.pretty() if conf.resources else ""
+        rows.append(
+            [r.run_name, conf.type, resources, r.status.value, f"${r.cost:.2f}", _age(r.submitted_at)]
+        )
+    print(_table(["NAME", "TYPE", "RESOURCES", "STATUS", "COST", "AGE"], rows))
+
+
+def cmd_stop(args) -> None:
+    client = _client()
+    client.runs.stop(args.runs, abort=args.abort)
+    print(f"{'aborting' if args.abort else 'stopping'} {', '.join(args.runs)}")
+
+
+def cmd_delete(args) -> None:
+    client = _client()
+    client.runs.delete(args.runs)
+    print(f"deleted {', '.join(args.runs)}")
+
+
+def cmd_logs(args) -> None:
+    client = _client()
+    if args.follow:
+        for message in client.logs.tail(args.run_name):
+            sys.stdout.write(message.replace("\r\n", "\n"))
+            sys.stdout.flush()
+        return
+    line = 0
+    while True:
+        batch = client.logs.poll(args.run_name, start_line=line)
+        if not batch.logs:
+            break
+        for ev in batch.logs:
+            sys.stdout.write(ev.message.replace("\r\n", "\n"))
+        line += len(batch.logs)
+    sys.stdout.flush()
+
+
+def cmd_offer(args) -> None:
+    client = _client()
+    resources = {}
+    if args.tpu:
+        resources["tpu"] = args.tpu
+    result = client.offers.list(
+        resources=resources, spot=args.spot, max_price=args.max_price, limit=args.limit
+    )
+    rows = [
+        [
+            o["backend"],
+            o["region"],
+            o["instance"]["name"],
+            str(o.get("hosts_per_slice", 1)),
+            "spot" if o.get("spot") else "on-demand",
+            f"${o['price']}/hr",
+        ]
+        for o in result["offers"][: args.limit]
+    ]
+    print(_table(["BACKEND", "REGION", "INSTANCE", "HOSTS", "KIND", "PRICE"], rows))
+    print(f"{result['total']} offers total")
+
+
+def cmd_fleet(args) -> None:
+    client = _client()
+    if args.action == "list":
+        rows = []
+        for f in client.fleets.list():
+            rows.append(
+                [
+                    f.name,
+                    f.status.value,
+                    str(len(f.instances)),
+                    ", ".join(sorted({i.status.value for i in f.instances})) or "-",
+                ]
+            )
+        print(_table(["FLEET", "STATUS", "INSTANCES", "INSTANCE STATUS", ], rows))
+    elif args.action == "delete":
+        client.fleets.delete(args.names)
+        print(f"deleting {', '.join(args.names)}")
+
+
+def cmd_volume(args) -> None:
+    client = _client()
+    if args.action == "list":
+        rows = [
+            [v.name, v.configuration.backend, v.configuration.region, v.status.value,
+             str(len(v.attachments))]
+            for v in client.volumes.list()
+        ]
+        print(_table(["VOLUME", "BACKEND", "REGION", "STATUS", "ATTACHED"], rows))
+    elif args.action == "delete":
+        client.volumes.delete(args.names)
+        print(f"deleted {', '.join(args.names)}")
+
+
+def cmd_secret(args) -> None:
+    client = _client()
+    if args.action == "set":
+        if not args.name or args.value is None:
+            raise DstackTpuError("usage: dstack-tpu secret set NAME VALUE")
+        client.secrets.set(args.name, args.value)
+        print(f"secret {args.name} set")
+    elif args.action == "list":
+        for name in client.secrets.list():
+            print(name)
+    elif args.action == "delete":
+        if not args.name:
+            raise DstackTpuError("usage: dstack-tpu secret delete NAME")
+        client.secrets.delete([args.name])
+        print(f"secret {args.name} deleted")
+
+
+def cmd_backend(args) -> None:
+    client = _client()
+    if args.action in ("create", "delete") and not args.type:
+        raise DstackTpuError(f"usage: dstack-tpu backend {args.action} TYPE")
+    if args.action == "list":
+        for b in client.backends.list():
+            print(b["type"])
+    elif args.action == "create":
+        client.backends.create({"type": args.type})
+        print(f"backend {args.type} configured")
+    elif args.action == "delete":
+        client.backends.delete([args.type])
+        print(f"backend {args.type} removed")
+
+
+def cmd_instance(args) -> None:
+    client = _client()
+    rows = [
+        [
+            i.name,
+            i.fleet_name or "-",
+            i.instance_type.name if i.instance_type else "-",
+            i.status.value,
+            i.slice_name or "-",
+            f"{i.worker_num}/{i.hosts_per_slice}",
+        ]
+        for i in client.instances.list()
+    ]
+    print(_table(["INSTANCE", "FLEET", "TYPE", "STATUS", "SLICE", "WORKER"], rows))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dstack-tpu", description="TPU workload orchestrator")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("server", help="start the control-plane server")
+    s.add_argument("--host", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.set_defaults(func=cmd_server)
+
+    s = sub.add_parser("config", help="configure server url/token/project")
+    s.add_argument("--url")
+    s.add_argument("--token")
+    s.add_argument("--project")
+    s.set_defaults(func=cmd_config)
+
+    s = sub.add_parser("init", help="register the current directory as a repo")
+    s.set_defaults(func=cmd_init)
+
+    s = sub.add_parser("apply", help="apply a configuration (run/fleet/volume)")
+    s.add_argument("-f", "--file", required=True)
+    s.add_argument("-y", "--yes", action="store_true")
+    s.add_argument("-d", "--detach", action="store_true")
+    s.add_argument("--force", action="store_true")
+    s.add_argument("--name", help="override the run name")
+    s.add_argument("--no-repo", action="store_true", help="do not upload the working tree")
+    s.set_defaults(func=cmd_apply)
+
+    s = sub.add_parser("ps", help="list runs")
+    s.add_argument("-a", "--all", action="store_true")
+    s.set_defaults(func=cmd_ps)
+
+    s = sub.add_parser("stop", help="stop runs")
+    s.add_argument("runs", nargs="+")
+    s.add_argument("-x", "--abort", action="store_true")
+    s.set_defaults(func=cmd_stop)
+
+    s = sub.add_parser("delete", help="delete finished runs")
+    s.add_argument("runs", nargs="+")
+    s.set_defaults(func=cmd_delete)
+
+    s = sub.add_parser("logs", help="print run logs")
+    s.add_argument("run_name")
+    s.add_argument("-f", "--follow", action="store_true")
+    s.set_defaults(func=cmd_logs)
+
+    s = sub.add_parser("offer", help="browse TPU slice offers")
+    s.add_argument("--tpu", help="slice name, e.g. v5p-16")
+    s.add_argument("--spot", action="store_true", default=None)
+    s.add_argument("--max-price", type=float)
+    s.add_argument("--limit", type=int, default=30)
+    s.set_defaults(func=cmd_offer)
+
+    s = sub.add_parser("fleet", help="manage fleets")
+    s.add_argument("action", choices=["list", "delete"])
+    s.add_argument("names", nargs="*")
+    s.set_defaults(func=cmd_fleet)
+
+    s = sub.add_parser("volume", help="manage volumes")
+    s.add_argument("action", choices=["list", "delete"])
+    s.add_argument("names", nargs="*")
+    s.set_defaults(func=cmd_volume)
+
+    s = sub.add_parser("secret", help="manage project secrets")
+    s.add_argument("action", choices=["set", "list", "delete"])
+    s.add_argument("name", nargs="?")
+    s.add_argument("value", nargs="?")
+    s.set_defaults(func=cmd_secret)
+
+    s = sub.add_parser("backend", help="manage project backends")
+    s.add_argument("action", choices=["list", "create", "delete"])
+    s.add_argument("type", nargs="?")
+    s.set_defaults(func=cmd_backend)
+
+    s = sub.add_parser("instance", help="list instances")
+    s.set_defaults(func=cmd_instance)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    try:
+        args.func(args)
+    except DstackTpuError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

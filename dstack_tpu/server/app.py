@@ -14,9 +14,16 @@ from dstack_tpu.core.models.users import ProjectRole
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database
 from dstack_tpu.server.routers import backends as backends_router
+from dstack_tpu.server.routers import fleets as fleets_router
+from dstack_tpu.server.routers import instances as instances_router
+from dstack_tpu.server.routers import logs as logs_router
+from dstack_tpu.server.routers import offers as offers_router
 from dstack_tpu.server.routers import projects as projects_router
+from dstack_tpu.server.routers import repos as repos_router
 from dstack_tpu.server.routers import runs as runs_router
+from dstack_tpu.server.routers import secrets as secrets_router
 from dstack_tpu.server.routers import users as users_router
+from dstack_tpu.server.routers import volumes as volumes_router
 from dstack_tpu.server.routers._common import error_middleware
 from dstack_tpu.server.services import projects as projects_service
 from dstack_tpu.server.services import users as users_service
@@ -72,6 +79,13 @@ def create_app(
     app.add_routes(projects_router.routes)
     app.add_routes(runs_router.routes)
     app.add_routes(backends_router.routes)
+    app.add_routes(fleets_router.routes)
+    app.add_routes(volumes_router.routes)
+    app.add_routes(secrets_router.routes)
+    app.add_routes(repos_router.routes)
+    app.add_routes(offers_router.routes)
+    app.add_routes(logs_router.routes)
+    app.add_routes(instances_router.routes)
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
     return app

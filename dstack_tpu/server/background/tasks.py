@@ -346,7 +346,7 @@ async def _process_provisioning(db: Database, job_row) -> None:
 
     secrets = await _project_secrets(db, job_row["project_id"])
     await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
-    code = await _get_code(db, run_spec)
+    code = await _get_code(db, job_row["project_id"], run_spec)
     if code:
         await client.upload_code(code)
     await client.run_job()
@@ -503,21 +503,20 @@ async def _touch(db: Database, job_row) -> None:
 
 
 async def _project_secrets(db: Database, project_id: str) -> Dict[str, str]:
-    rows = await db.fetchall("SELECT name, value FROM secrets WHERE project_id = ?", (project_id,))
-    from dstack_tpu.server.services.encryption import decrypt
+    from dstack_tpu.server.services import secrets as secrets_service
 
-    return {r["name"]: decrypt(r["value"]) for r in rows}
+    return await secrets_service.get_secrets(db, project_id)
 
 
-async def _get_code(db: Database, run_spec: RunSpec) -> Optional[bytes]:
+async def _get_code(db: Database, project_id: str, run_spec: RunSpec) -> Optional[bytes]:
     repo_data = run_spec.repo_data or {}
     code_hash = repo_data.get("code_hash")
     if not run_spec.repo_id or not code_hash:
         return None
     row = await db.fetchone(
         "SELECT c.blob FROM codes c JOIN repos r ON r.id = c.repo_id"
-        " WHERE r.name = ? AND c.blob_hash = ?",
-        (run_spec.repo_id, code_hash),
+        " WHERE r.project_id = ? AND r.name = ? AND c.blob_hash = ?",
+        (project_id, run_spec.repo_id, code_hash),
     )
     return row["blob"] if row else None
 

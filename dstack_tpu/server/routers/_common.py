@@ -80,6 +80,14 @@ async def body_dict(request: web.Request) -> dict:
         raise ServerClientError("invalid JSON body")
 
 
+def required(body: dict, key: str) -> Any:
+    """Fetch a required body field; missing/None becomes a 400, not a KeyError 500."""
+    value = body.get(key)
+    if value is None:
+        raise ServerClientError(f"missing required field `{key}`")
+    return value
+
+
 def model_response(obj: Any, status: int = 200) -> web.Response:
     if obj is None:
         return web.json_response(None, status=status)

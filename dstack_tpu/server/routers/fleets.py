@@ -1,0 +1,59 @@
+"""/api/project/{p}/fleets/* (parity: reference server routers fleets)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.fleets import ApplyFleetPlanInput, FleetSpec
+from dstack_tpu.server.routers._common import (
+    auth_project,
+    body_dict,
+    model_response,
+    parse_body,
+    required,
+)
+from dstack_tpu.server.services import fleets as fleets_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/fleets/list")
+async def list_fleets(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    return model_response(await fleets_service.list_fleets(request.app["db"], project_row))
+
+
+@routes.post("/api/project/{project_name}/fleets/get")
+async def get_fleet(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    return model_response(
+        await fleets_service.get_fleet(request.app["db"], project_row, required(body, "name"))
+    )
+
+
+@routes.post("/api/project/{project_name}/fleets/get_plan")
+async def get_plan(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    body = await body_dict(request)
+    spec = FleetSpec.model_validate(required(body, "spec"))
+    return model_response(
+        await fleets_service.get_plan(request.app["db"], project_row, user_row, spec)
+    )
+
+
+@routes.post("/api/project/{project_name}/fleets/apply_plan")
+async def apply_plan(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    plan = await parse_body(request, ApplyFleetPlanInput)
+    return model_response(
+        await fleets_service.apply_plan(request.app["db"], project_row, user_row, plan)
+    )
+
+
+@routes.post("/api/project/{project_name}/fleets/delete")
+async def delete(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    await fleets_service.delete_fleets(request.app["db"], project_row, required(body, "names"))
+    return model_response(None)

@@ -1,0 +1,31 @@
+"""/api/project/{p}/instances/list (parity: reference instances router)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.server.routers._common import auth_project, model_response
+from dstack_tpu.server.services import instances as instances_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/instances/list")
+async def list_instances(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    db = request.app["db"]
+    rows = await instances_service.list_instances(db, project_row["id"])
+    fleet_names = {
+        r["id"]: r["name"]
+        for r in await db.fetchall(
+            "SELECT id, name FROM fleets WHERE project_id = ?", (project_row["id"],)
+        )
+    }
+    return model_response(
+        [
+            instances_service.row_to_instance(
+                r, project_row["name"], fleet_names.get(r["fleet_id"])
+            )
+            for r in rows
+        ]
+    )

@@ -1,0 +1,48 @@
+"""/api/project/{p}/logs/poll (parity: reference logs router / services/logs)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.errors import ResourceNotExistsError
+from dstack_tpu.core.models.logs import JobSubmissionLogs
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, required
+from dstack_tpu.server.services import logs as logs_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/logs/poll")
+async def poll_logs(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    db = request.app["db"]
+    run_name = required(body, "run_name")
+    job_id = body.get("job_id")
+    if job_id is None:
+        # Default to the latest submission of job (replica 0, num 0).
+        row = await db.fetchone(
+            "SELECT j.id FROM jobs j JOIN runs r ON r.id = j.run_id"
+            " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
+            " ORDER BY j.replica_num, j.job_num, j.submission_num DESC LIMIT 1",
+            (project_row["id"], run_name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"no jobs for run {run_name}")
+        job_id = row["id"]
+    start_line = int(body.get("start_line") or 0)
+    limit = min(int(body.get("limit") or 1000), 10000)
+    import asyncio
+
+    # File IO off the event loop: a large log file must not stall the scheduler.
+    events = await asyncio.to_thread(
+        logs_service.get_log_storage().poll_logs,
+        project_row["id"],
+        run_name,
+        job_id,
+        start_line,
+        limit,
+    )
+    return model_response(
+        JobSubmissionLogs(logs=events, next_token=str(start_line + len(events)))
+    )

@@ -1,0 +1,37 @@
+"""/api/project/{p}/offers/list — offer browsing for the CLI `offer` command
+(parity: reference CLI `dstack offer` backed by get_offers)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.profiles import Profile
+from dstack_tpu.core.models.runs import Requirements
+from dstack_tpu.core.models.resources import ResourcesSpec
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response
+from dstack_tpu.server.services import offers as offers_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/offers/list")
+async def list_offers(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    resources = ResourcesSpec.model_validate(body.get("resources") or {})
+    req = Requirements(
+        resources=resources,
+        max_price=body.get("max_price"),
+        spot=body.get("spot"),
+    )
+    profile = Profile.model_validate(body.get("profile") or {})
+    offers = await offers_service.get_offers_by_requirements(
+        request.app["db"], project_row, req, profile
+    )
+    limit = int(body.get("limit") or 100)
+    return model_response(
+        {
+            "offers": [o.model_dump(mode="json") for o in offers[:limit]],
+            "total": len(offers),
+        }
+    )

@@ -1,0 +1,39 @@
+"""/api/project/{p}/repos/* incl. code-blob upload (parity: reference repos router +
+code upload, services/repos.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, required
+from dstack_tpu.server.services import repos as repos_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/repos/init")
+async def init_repo(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    return model_response(
+        await repos_service.init_repo(
+            request.app["db"], project_row, required(body, "repo_name"), body.get("repo_info")
+        )
+    )
+
+
+@routes.post("/api/project/{project_name}/repos/list")
+async def list_repos(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    return model_response(await repos_service.list_repos(request.app["db"], project_row))
+
+
+@routes.post("/api/project/{project_name}/repos/{repo_name}/upload_code")
+async def upload_code(request: web.Request) -> web.Response:
+    """Body is the raw tar.gz bytes; returns {"code_hash": ...}."""
+    _, project_row = await auth_project(request)
+    blob = await request.read()
+    code_hash = await repos_service.upload_code(
+        request.app["db"], project_row, request.match_info["repo_name"], blob
+    )
+    return model_response({"code_hash": code_hash})

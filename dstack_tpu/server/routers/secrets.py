@@ -1,0 +1,34 @@
+"""/api/project/{p}/secrets/* — real handlers (the reference stubs these,
+routers/secrets.py:20-36)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, required
+from dstack_tpu.server.services import secrets as secrets_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/secrets/set")
+async def set_secret(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request, admin_only=True)
+    body = await body_dict(request)
+    await secrets_service.set_secret(request.app["db"], project_row, required(body, "name"), required(body, "value"))
+    return model_response(None)
+
+
+@routes.post("/api/project/{project_name}/secrets/list")
+async def list_secrets(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    names = await secrets_service.list_secrets(request.app["db"], project_row)
+    return model_response([{"name": n} for n in names])
+
+
+@routes.post("/api/project/{project_name}/secrets/delete")
+async def delete(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request, admin_only=True)
+    body = await body_dict(request)
+    await secrets_service.delete_secrets(request.app["db"], project_row, required(body, "names"))
+    return model_response(None)

@@ -1,0 +1,44 @@
+"""/api/project/{p}/volumes/* (parity: reference server routers volumes)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, required
+from dstack_tpu.server.services import volumes as volumes_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/volumes/list")
+async def list_volumes(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    return model_response(await volumes_service.list_volumes(request.app["db"], project_row))
+
+
+@routes.post("/api/project/{project_name}/volumes/get")
+async def get_volume(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    return model_response(
+        await volumes_service.get_volume(request.app["db"], project_row, required(body, "name"))
+    )
+
+
+@routes.post("/api/project/{project_name}/volumes/create")
+async def create(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    body = await body_dict(request)
+    conf = VolumeConfiguration.model_validate(required(body, "configuration"))
+    return model_response(
+        await volumes_service.create_volume(request.app["db"], project_row, user_row, conf)
+    )
+
+
+@routes.post("/api/project/{project_name}/volumes/delete")
+async def delete(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    await volumes_service.delete_volumes(request.app["db"], project_row, required(body, "names"))
+    return model_response(None)

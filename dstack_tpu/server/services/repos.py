@@ -1,0 +1,58 @@
+"""Repos service: repo registration + code blob upload.
+
+Parity: reference server/services/repos.py (362 LoC) + CodeModel. The client tars the
+working tree (<= MAX_CODE_SIZE, reference settings.py:92) and uploads it keyed by
+content hash; the scheduler hands the blob to the runner at submit time."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional
+
+from dstack_tpu.core.errors import ResourceNotExistsError, ServerClientError
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, dumps, loads, new_id
+
+
+async def init_repo(db: Database, project_row, repo_name: str, repo_info: Optional[dict] = None) -> dict:
+    row = await db.fetchone(
+        "SELECT * FROM repos WHERE project_id = ? AND name = ?",
+        (project_row["id"], repo_name),
+    )
+    if row is None:
+        await db.execute(
+            "INSERT INTO repos (id, project_id, name, type, info) VALUES (?, ?, ?, ?, ?)",
+            (new_id(), project_row["id"], repo_name, "local", dumps(repo_info or {})),
+        )
+        row = await db.fetchone(
+            "SELECT * FROM repos WHERE project_id = ? AND name = ?",
+            (project_row["id"], repo_name),
+        )
+    return {"repo_id": row["name"], "repo_info": loads(row["info"])}
+
+
+async def list_repos(db: Database, project_row) -> List[dict]:
+    rows = await db.fetchall(
+        "SELECT * FROM repos WHERE project_id = ? ORDER BY name", (project_row["id"],)
+    )
+    return [{"repo_id": r["name"], "repo_info": loads(r["info"])} for r in rows]
+
+
+async def upload_code(db: Database, project_row, repo_name: str, blob: bytes) -> str:
+    """Store a code tarball; returns its content hash (idempotent)."""
+    if len(blob) > settings.MAX_CODE_SIZE:
+        raise ServerClientError(
+            f"code archive is {len(blob)} bytes; max is {settings.MAX_CODE_SIZE}"
+        )
+    repo_row = await db.fetchone(
+        "SELECT * FROM repos WHERE project_id = ? AND name = ?",
+        (project_row["id"], repo_name),
+    )
+    if repo_row is None:
+        raise ResourceNotExistsError(f"repo {repo_name} not found; run init first")
+    blob_hash = hashlib.sha256(blob).hexdigest()
+    await db.execute(
+        "INSERT OR IGNORE INTO codes (id, repo_id, blob_hash, blob) VALUES (?, ?, ?, ?)",
+        (new_id(), repo_row["id"], blob_hash, blob),
+    )
+    return blob_hash

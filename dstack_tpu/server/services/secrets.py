@@ -1,0 +1,46 @@
+"""Secrets service — implemented for real.
+
+Parity-plus: the reference snapshot stubs secrets (routers/secrets.py:20-36 handlers
+`pass`, `secrets = {}  # TODO` in process_running_jobs.py:178); here they are stored
+encrypted at rest (services/encryption) and injected into job environments by
+process_running_jobs."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from dstack_tpu.core.errors import ResourceNotExistsError
+from dstack_tpu.server.db import Database, new_id
+from dstack_tpu.server.services import encryption
+
+
+async def set_secret(db: Database, project_row, name: str, value: str) -> None:
+    await db.execute(
+        "INSERT INTO secrets (id, project_id, name, value) VALUES (?, ?, ?, ?)"
+        " ON CONFLICT (project_id, name) DO UPDATE SET value = excluded.value",
+        (new_id(), project_row["id"], name, encryption.encrypt(value)),
+    )
+
+
+async def list_secrets(db: Database, project_row) -> List[str]:
+    rows = await db.fetchall(
+        "SELECT name FROM secrets WHERE project_id = ? ORDER BY name", (project_row["id"],)
+    )
+    return [r["name"] for r in rows]
+
+
+async def get_secrets(db: Database, project_id: str) -> Dict[str, str]:
+    rows = await db.fetchall(
+        "SELECT name, value FROM secrets WHERE project_id = ?", (project_id,)
+    )
+    return {r["name"]: encryption.decrypt(r["value"]) for r in rows}
+
+
+async def delete_secrets(db: Database, project_row, names: List[str]) -> None:
+    for name in names:
+        n = await db.execute(
+            "DELETE FROM secrets WHERE project_id = ? AND name = ?",
+            (project_row["id"], name),
+        )
+        if n == 0:
+            raise ResourceNotExistsError(f"secret {name} not found")

@@ -1,0 +1,134 @@
+"""Volumes service: network data-disk CRUD + attach tracking.
+
+Parity: reference server/services/volumes.py (455 LoC). TPU twist: a volume attaches to
+every host of a slice (reference gcp/compute.py:1003-1016 TPU data disks)."""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional
+
+from dstack_tpu.core.errors import (
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerClientError,
+)
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+from dstack_tpu.core.models.volumes import (
+    Volume,
+    VolumeAttachment,
+    VolumeProvisioningData,
+    VolumeStatus,
+)
+from dstack_tpu.server.db import Database, loads, new_id
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+
+async def row_to_volume(db: Database, row, project_name: str = "") -> Volume:
+    att_rows = await db.fetchall(
+        "SELECT va.*, i.name AS instance_name FROM volume_attachments va"
+        " JOIN instances i ON i.id = va.instance_id WHERE va.volume_id = ?",
+        (row["id"],),
+    )
+    user = None
+    if row["user_id"]:
+        urow = await db.fetchone("SELECT username FROM users WHERE id = ?", (row["user_id"],))
+        user = urow["username"] if urow else None
+    pd = loads(row["provisioning_data"])
+    return Volume(
+        id=uuid.UUID(row["id"]),
+        name=row["name"],
+        project_name=project_name,
+        user=user,
+        configuration=VolumeConfiguration.model_validate(loads(row["configuration"])),
+        external=bool(row["external"]),
+        created_at=from_iso(row["created_at"]),
+        last_job_processed_at=from_iso(row["last_job_processed_at"]),
+        status=VolumeStatus(row["status"]),
+        status_message=row["status_message"],
+        volume_id=row["volume_id"],
+        provisioning_data=VolumeProvisioningData.model_validate(pd) if pd else None,
+        attachments=[
+            VolumeAttachment(
+                instance_id=uuid.UUID(a["instance_id"]),
+                instance_name=a["instance_name"],
+                device_name=(loads(a["attachment_data"]) or {}).get("device_name"),
+            )
+            for a in att_rows
+        ],
+    )
+
+
+async def get_volume_row(db: Database, project_id: str, name: str):
+    return await db.fetchone(
+        "SELECT * FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, name),
+    )
+
+
+async def list_volumes(db: Database, project_row) -> List[Volume]:
+    rows = await db.fetchall(
+        "SELECT * FROM volumes WHERE project_id = ? AND deleted = 0 ORDER BY created_at",
+        (project_row["id"],),
+    )
+    return [await row_to_volume(db, r, project_row["name"]) for r in rows]
+
+
+async def get_volume(db: Database, project_row, name: str) -> Volume:
+    row = await get_volume_row(db, project_row["id"], name)
+    if row is None:
+        raise ResourceNotExistsError(f"volume {name} not found")
+    return await row_to_volume(db, row, project_row["name"])
+
+
+async def create_volume(db: Database, project_row, user_row, conf: VolumeConfiguration) -> Volume:
+    name = conf.name or f"volume-{new_id()[:8]}"
+    if await get_volume_row(db, project_row["id"], name) is not None:
+        raise ResourceExistsError(f"volume {name} already exists")
+    external = conf.volume_id is not None
+    await db.execute(
+        "INSERT INTO volumes (id, project_id, user_id, name, status, configuration,"
+        " external, created_at, volume_id) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            new_id(),
+            project_row["id"],
+            user_row["id"],
+            name,
+            VolumeStatus.SUBMITTED.value,
+            conf.model_dump_json(),
+            1 if external else 0,
+            to_iso(now_utc()),
+            conf.volume_id,
+        ),
+    )
+    row = await get_volume_row(db, project_row["id"], name)
+    return await row_to_volume(db, row, project_row["name"])
+
+
+async def delete_volumes(db: Database, project_row, names: List[str]) -> None:
+    for name in names:
+        row = await get_volume_row(db, project_row["id"], name)
+        if row is None:
+            raise ResourceNotExistsError(f"volume {name} not found")
+        attached = await db.fetchone(
+            "SELECT COUNT(*) AS n FROM volume_attachments WHERE volume_id = ?", (row["id"],)
+        )
+        if attached["n"] > 0:
+            raise ServerClientError(f"volume {name} is attached; detach it first")
+        # External (registered) disks are not destroyed in the cloud, only forgotten.
+        if not row["external"] and row["status"] == "active":
+            from dstack_tpu.server.services import backends as backends_service
+
+            conf = VolumeConfiguration.model_validate(loads(row["configuration"]))
+            try:
+                compute = await backends_service.get_compute(db, project_row, conf.backend)
+            except ResourceNotExistsError:
+                compute = None  # backend no longer configured; forget the row
+            delete_fn = getattr(compute, "delete_volume", None)
+            if delete_fn is not None:
+                volume = await row_to_volume(db, row, project_row["name"])
+                try:
+                    await delete_fn(volume)
+                except NotImplementedError:
+                    pass  # backend has no volume support; real errors propagate
+        await db.execute("UPDATE volumes SET deleted = 1 WHERE id = ?", (row["id"],))

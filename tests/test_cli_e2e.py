@@ -1,0 +1,154 @@
+"""CLI end-to-end: real server process + real CLI process + real native runner.
+
+Drives the verify-skill recipe: config -> backend -> apply -f task.dstack.yml (with
+code upload) -> attached logs -> ps/logs/fleet/offer/secret surfaces."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+from dstack_tpu.utils.runner_binary import find_runner_binary
+
+pytestmark = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+TOKEN = "test-admin-token"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def server(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "DSTACK_TPU_SERVER_ADMIN_TOKEN": TOKEN,
+            "DSTACK_TPU_SERVER_DIR": str(tmp_path / "server"),
+            "DSTACK_TPU_DB_PATH": str(tmp_path / "server" / "server.db"),
+            "DSTACK_TPU_SERVER_PORT": str(port),
+            "PYTHONPATH": str(REPO_ROOT),
+        }
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dstack_tpu.server.app"],
+        env=env,
+        cwd=str(tmp_path),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(100):
+            try:
+                if requests.get(base + "/healthcheck", timeout=1).status_code == 200:
+                    break
+            except requests.ConnectionError:
+                time.sleep(0.1)
+        else:
+            out = proc.stdout.read().decode(errors="replace") if proc.stdout else ""
+            raise RuntimeError(f"server did not start: {out[:2000]}")
+        yield base
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _cli(args, cwd, tmp_path, check=True, timeout=60):
+    env = dict(os.environ)
+    env.update(
+        {
+            "DSTACK_TPU_CLI_CONFIG_DIR": str(tmp_path / "cli-config"),
+            "PYTHONPATH": str(REPO_ROOT),
+        }
+    )
+    result = subprocess.run(
+        [sys.executable, "-m", "dstack_tpu.cli.main", *args],
+        cwd=str(cwd),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    if check and result.returncode != 0:
+        raise AssertionError(
+            f"cli {' '.join(args)} failed ({result.returncode}):\n{result.stdout}\n{result.stderr}"
+        )
+    return result
+
+
+class TestCliE2E:
+    def test_full_apply_flow(self, server, tmp_path):
+        work = tmp_path / "myproject"
+        work.mkdir()
+        (work / "hello.txt").write_text("payload-from-repo\n")
+        (work / "task.dstack.yml").write_text(
+            "type: task\n"
+            "commands:\n"
+            "  - echo cli-e2e-$((21*2))\n"
+            "  - cat hello.txt\n"
+        )
+
+        _cli(["config", "--url", server, "--token", TOKEN], work, tmp_path)
+        _cli(["init"], work, tmp_path)
+
+        result = _cli(["apply", "-f", "task.dstack.yml", "-y"], work, tmp_path, timeout=120)
+        assert "cli-e2e-42" in result.stdout, result.stdout + result.stderr
+        assert "payload-from-repo" in result.stdout  # code upload + extraction worked
+        assert "finished: done" in result.stderr
+
+        ps = _cli(["ps", "-a"], work, tmp_path)
+        assert "task" in ps.stdout and "done" in ps.stdout
+
+        logs = _cli(["logs", "task"], work, tmp_path, check=False)
+        run_name = [l for l in ps.stdout.splitlines()[1:] if l.strip()][0].split()[0]
+        logs = _cli(["logs", run_name], work, tmp_path)
+        assert "cli-e2e-42" in logs.stdout
+
+        fleets = _cli(["fleet", "list"], work, tmp_path)
+        assert run_name in fleets.stdout  # auto-created run fleet
+
+    def test_offers_and_secrets(self, server, tmp_path):
+        work = tmp_path / "w2"
+        work.mkdir()
+        _cli(["config", "--url", server, "--token", TOKEN], work, tmp_path)
+        _cli(["backend", "create", "mock"], work, tmp_path)
+
+        offers = _cli(["offer", "--tpu", "v5p-16"], work, tmp_path)
+        assert "v5p-16" in offers.stdout
+        assert "$" in offers.stdout
+
+        _cli(["secret", "set", "API_KEY", "s3cret"], work, tmp_path)
+        listed = _cli(["secret", "list"], work, tmp_path)
+        assert "API_KEY" in listed.stdout
+        _cli(["secret", "delete", "API_KEY"], work, tmp_path)
+
+    def test_failed_run_exit_code(self, server, tmp_path):
+        work = tmp_path / "w3"
+        work.mkdir()
+        (work / "bad.dstack.yml").write_text("type: task\ncommands: [\"exit 3\"]\n")
+        _cli(["config", "--url", server, "--token", TOKEN], work, tmp_path)
+        result = _cli(
+            ["apply", "-f", "bad.dstack.yml", "-y", "--no-repo"],
+            work,
+            tmp_path,
+            check=False,
+            timeout=120,
+        )
+        assert result.returncode == 1
+        assert "failed" in result.stderr
