@@ -5,6 +5,7 @@ admin -> default project -> background tasks) on aiohttp.web."""
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Optional
 
@@ -34,6 +35,12 @@ logger = logging.getLogger(__name__)
 async def _on_startup(app: web.Application) -> None:
     db: Database = app["db"]
     await db.connect()  # runs migrations
+    if settings.ENCRYPTION_KEYS:
+        from dstack_tpu.server.services import encryption
+
+        key_specs = json.loads(settings.ENCRYPTION_KEYS)
+        encryption.configure_keys(key_specs)
+        logger.info("configured %d at-rest encryption key(s)", len(key_specs))
     admin_row, created = await users_service.get_or_create_admin_user(
         db, token=settings.ADMIN_TOKEN
     )

@@ -344,7 +344,7 @@ async def _process_provisioning(db: Database, job_row) -> None:
     infos = build_cluster_info(pairs, num_slices=num_slices)
     info = infos[spec.job_num]
 
-    secrets = await _project_secrets(db, job_row["project_id"])
+    spec, secrets = await _resolve_job_secrets(db, job_row["project_id"], spec)
     await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
     code = await _get_code(db, job_row["project_id"], run_spec)
     if code:
@@ -502,10 +502,29 @@ async def _touch(db: Database, job_row) -> None:
     )
 
 
-async def _project_secrets(db: Database, project_id: str) -> Dict[str, str]:
-    from dstack_tpu.server.services import secrets as secrets_service
+async def _resolve_job_secrets(db: Database, project_id: str, spec: JobSpec):
+    """Interpolate ``${{ secrets.X }}`` references in the job env.
 
-    return await secrets_service.get_secrets(db, project_id)
+    Only secrets the run configuration explicitly references are resolved — never the
+    whole project store (any member could otherwise exfiltrate every project secret by
+    printing its environment). Mirrors the reference's VariablesInterpolator pass in
+    process_running_jobs; unreferenced placeholders are left as-is so a typo'd name is
+    visible in the job env rather than silently empty.
+    """
+    from dstack_tpu.server.services import secrets as secrets_service
+    from dstack_tpu.utils.interpolator import extract_references, interpolate_env
+
+    env = dict(spec.env or {})
+    referenced = extract_references(env.values(), "secrets")
+    if not referenced:
+        return spec, {}
+    store = await secrets_service.get_secrets(db, project_id)
+    available = {name: store[name] for name in referenced if name in store}
+    missing = referenced - set(available)
+    if missing:
+        logger.warning("job references unknown secrets: %s", ", ".join(sorted(missing)))
+    env = interpolate_env(env, {"secrets": available}, missing_ok=True)
+    return spec.model_copy(update={"env": env}), {}
 
 
 async def _get_code(db: Database, project_id: str, run_spec: RunSpec) -> Optional[bytes]:

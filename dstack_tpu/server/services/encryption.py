@@ -80,6 +80,10 @@ def configure_keys(specs: List[dict]) -> None:
             raise ValueError(f"unknown encryption key type {t!r}")
     if not keys:
         keys = [IdentityKey()]
+    # Always keep a decrypt-only identity key: rows written before AES was configured
+    # are tagged enc:identity:* and must stay readable (the head key still encrypts).
+    if not any(isinstance(k, IdentityKey) for k in keys):
+        keys.append(IdentityKey())
     global _keys
     _keys = keys
 

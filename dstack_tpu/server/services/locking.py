@@ -47,7 +47,13 @@ class _LockCtx:
 
     async def __aenter__(self) -> None:
         self._lock = self._locker._acquire_obj(self._name)
-        await self._lock.acquire()
+        try:
+            await self._lock.acquire()
+        except BaseException:
+            # Cancelled while waiting: __aexit__ won't run, so drop our waiter
+            # refcount here or the per-name entry leaks forever.
+            self._locker._release_obj(self._name)
+            raise
 
     async def __aexit__(self, *exc) -> None:
         self._lock.release()

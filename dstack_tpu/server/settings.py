@@ -20,6 +20,11 @@ LOGS_DIR = SERVER_DIR / "logs"
 DB_PATH = os.getenv("DSTACK_TPU_DB_PATH", str(DATA_DIR / "server.db"))
 
 ADMIN_TOKEN = os.getenv("DSTACK_TPU_SERVER_ADMIN_TOKEN")
+
+# At-rest encryption keys, JSON list ordered head-first, e.g.
+# '[{"type": "aes", "secret": "<base64 32 bytes>", "name": "k1"}, {"type": "identity"}]'.
+# Unset = identity codec (base64 of plaintext — NOT encrypted); see services/encryption.
+ENCRYPTION_KEYS = os.getenv("DSTACK_TPU_ENCRYPTION_KEYS")
 DEFAULT_PROJECT_NAME = os.getenv("DSTACK_TPU_DEFAULT_PROJECT", "main")
 
 # Background processing knobs (reference background/__init__.py:39-100). The reference

@@ -1,0 +1,68 @@
+"""``${{ namespace.name }}`` variable interpolation.
+
+Parity: reference _internal/utils/interpolator.py (VariablesInterpolator), used by
+process_running_jobs to resolve ``${{ secrets.X }}`` in job env values. Only values the
+run configuration explicitly references are resolved — secrets are never injected
+wholesale into a job's environment.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Mapping, Set
+
+_PATTERN = re.compile(r"\$\{\{\s*([A-Za-z_][A-Za-z0-9_]*)\.([A-Za-z_][A-Za-z0-9_-]*)\s*\}\}")
+
+
+class InterpolatorError(ValueError):
+    pass
+
+
+def extract_references(values: Iterable[str], namespace: str) -> Set[str]:
+    """Names referenced as ``${{ namespace.name }}`` across the given strings."""
+    found: Set[str] = set()
+    for value in values:
+        if not isinstance(value, str):
+            continue
+        for m in _PATTERN.finditer(value):
+            if m.group(1) == namespace:
+                found.add(m.group(2))
+    return found
+
+
+def interpolate(
+    value: str,
+    namespaces: Mapping[str, Mapping[str, str]],
+    *,
+    missing_ok: bool = False,
+) -> str:
+    """Replace every ``${{ ns.name }}`` occurrence with namespaces[ns][name].
+
+    Unknown namespaces are left untouched (they may belong to a later resolution
+    stage); unknown names in a known namespace raise unless ``missing_ok``.
+    """
+
+    def repl(m: re.Match) -> str:
+        ns, name = m.group(1), m.group(2)
+        if ns not in namespaces:
+            return m.group(0)
+        values = namespaces[ns]
+        if name not in values:
+            if missing_ok:
+                return m.group(0)
+            raise InterpolatorError(f"unknown {ns} variable {name!r}")
+        return values[name]
+
+    return _PATTERN.sub(repl, value)
+
+
+def interpolate_env(
+    env: Mapping[str, str],
+    namespaces: Mapping[str, Mapping[str, str]],
+    *,
+    missing_ok: bool = False,
+) -> Dict[str, str]:
+    return {
+        k: interpolate(v, namespaces, missing_ok=missing_ok) if isinstance(v, str) else v
+        for k, v in env.items()
+    }

@@ -47,13 +47,19 @@ def _attn_block_accum(
     q_positions: jax.Array,   # [Tq] global positions
     kv_positions: jax.Array,  # [S] global positions
     causal: bool,
+    kv_valid: Optional[jax.Array] = None,  # [S] bool; False = padded key, never attended
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Online-softmax accumulation of one KV block into the running (o, l, m) state."""
     o, l, m = state
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     s = jnp.einsum("bthd,bshd->bths", q, k, preferred_element_type=jnp.float32) * scale
+    mask = None  # [Tq, S]; padding is masked independently of causality
     if causal:
-        mask = kv_positions[None, :] <= q_positions[:, None]  # [Tq, S]
+        mask = kv_positions[None, :] <= q_positions[:, None]
+    if kv_valid is not None:
+        valid = jnp.broadcast_to(kv_valid[None, :], (q_positions.shape[0], kv_valid.shape[0]))
+        mask = valid if mask is None else (mask & valid)
+    if mask is not None:
         s = jnp.where(mask[None, :, None, :], s, NEG_INF)
     m_block = jnp.max(s, axis=-1)  # [B,Tq,H]
     m_new = jnp.maximum(m, m_block)
@@ -62,7 +68,7 @@ def _attn_block_accum(
     safe_m_new = jnp.where(m_new == NEG_INF, 0.0, m_new)
     corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m_new))
     p = jnp.exp(s - safe_m_new[..., None])  # [B,Tq,H,S]
-    if causal:
+    if mask is not None:
         p = jnp.where(mask[None, :, None, :], p, 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bths,bshd->bthd", p, v.astype(jnp.float32))
@@ -112,10 +118,13 @@ def blockwise_attention(
 
     def body(state, inputs):
         k_blk, v_blk, blk_idx = inputs
-        kv_pos = kv_offset + blk_idx * block_size + jnp.arange(block_size)
-        # Mark padded tail positions as unattendable.
-        kv_pos = jnp.where(kv_pos < kv_offset + s_len, kv_pos, jnp.iinfo(jnp.int32).max)
-        return _attn_block_accum(state, q, k_blk, v_blk, q_pos, kv_pos, True), None
+        rel_pos = blk_idx * block_size + jnp.arange(block_size)
+        kv_pos = kv_offset + rel_pos
+        kv_valid = rel_pos < s_len  # mask the padded tail regardless of causality
+        return (
+            _attn_block_accum(state, q, k_blk, v_blk, q_pos, kv_pos, causal, kv_valid),
+            None,
+        )
 
     k_scan = jnp.moveaxis(k, 1, 0)  # [n_blocks, B, block, H, D]
     v_scan = jnp.moveaxis(v, 1, 0)

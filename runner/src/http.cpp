@@ -1,5 +1,7 @@
 #include "http.hpp"
 
+#include "json.hpp"
+
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -171,7 +173,7 @@ void Server::handle_connection(int fd) {
         resp = it->second(req);
       } catch (const std::exception& e) {
         resp.status = 500;
-        resp.body = std::string("{\"error\":\"") + e.what() + "\"}";
+        resp.body = dj::Json::object().set("error", e.what()).dump();
       }
     }
 

@@ -352,3 +352,39 @@ class TestLogsFromRunner:
                 assert [e.message for e in events] == ["hello\n"]
         finally:
             logs_service.set_log_storage(None)
+
+
+class TestSecretsInjection:
+    async def test_only_referenced_secrets_injected(self):
+        # ADVICE r1 (medium): a job must receive only the secrets its configuration
+        # references via ${{ secrets.X }} — never the whole project store.
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/secrets/set", {"name": "USED", "value": "s3cret"}
+            )
+            await api.post(
+                "/api/project/main/secrets/set", {"name": "UNUSED", "value": "hidden"}
+            )
+            await api.post(
+                "/api/project/main/runs/submit",
+                {
+                    "run_spec": {
+                        "run_name": "sec-task",
+                        "configuration": {
+                            "type": "task",
+                            "commands": ["echo $TOKEN"],
+                            "env": {"TOKEN": "${{ secrets.USED }}", "PLAIN": "x"},
+                        },
+                    }
+                },
+            )
+            await drive(api.db)
+            fakes = list(FakeRunnerClient.registry.values())
+            assert len(fakes) == 1
+            env = fakes[0].submitted.env
+            assert env["TOKEN"] == "s3cret"
+            assert env["PLAIN"] == "x"
+            values = " ".join(map(str, env.values())) + " ".join(
+                map(str, (fakes[0].secrets or {}).values())
+            )
+            assert "hidden" not in values

@@ -1,0 +1,61 @@
+"""Interpolator + locking unit tests."""
+
+import asyncio
+
+import pytest
+
+from dstack_tpu.server.services.locking import Locker
+from dstack_tpu.utils.interpolator import (
+    InterpolatorError,
+    extract_references,
+    interpolate,
+    interpolate_env,
+)
+
+
+class TestInterpolator:
+    def test_extract_references(self):
+        env = {
+            "A": "${{ secrets.TOKEN }}",
+            "B": "prefix-${{secrets.DB_PASS}}-suffix",
+            "C": "${{ env.OTHER }}",
+            "D": "plain",
+        }
+        assert extract_references(env.values(), "secrets") == {"TOKEN", "DB_PASS"}
+
+    def test_interpolate_known_and_unknown_namespace(self):
+        out = interpolate(
+            "x=${{ secrets.A }} y=${{ later.B }}", {"secrets": {"A": "1"}}
+        )
+        assert out == "x=1 y=${{ later.B }}"
+
+    def test_missing_raises_unless_ok(self):
+        with pytest.raises(InterpolatorError):
+            interpolate("${{ secrets.NOPE }}", {"secrets": {}})
+        assert (
+            interpolate("${{ secrets.NOPE }}", {"secrets": {}}, missing_ok=True)
+            == "${{ secrets.NOPE }}"
+        )
+
+    def test_interpolate_env(self):
+        env = {"A": "${{ secrets.X }}", "B": "keep"}
+        out = interpolate_env(env, {"secrets": {"X": "v"}})
+        assert out == {"A": "v", "B": "keep"}
+
+
+class TestLockerCancellation:
+    def test_cancelled_waiter_does_not_leak(self):
+        # Regression (ADVICE r1): cancelling a task awaiting acquire() leaked the
+        # waiter refcount, so the per-name entry never dropped from the dict.
+        async def scenario():
+            locker = Locker()
+            async with locker.lock("res"):
+                waiter = asyncio.ensure_future(locker.lock("res").__aenter__())
+                await asyncio.sleep(0.01)
+                waiter.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await waiter
+            assert locker._locks == {}
+            assert locker._waiters == {}
+
+        asyncio.run(scenario())

@@ -161,3 +161,18 @@ class TestShardedTraining:
             tok_sharded = jax.device_put(tokens, batch_sharding(mesh))
             out = jax.jit(lambda p, t: model_lib.forward(p, t, cfg, mesh))(sp, tok_sharded)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4, rtol=1e-3)
+
+
+class TestNonCausalPadding:
+    def test_noncausal_multiblock_matches_naive(self):
+        # Regression (ADVICE r1): the multi-block scan path hardcoded causal=True and
+        # masked padding via the causal comparison; causal=False with S > block_size
+        # must not apply a causal mask, and padded tail keys must stay masked.
+        key = jax.random.PRNGKey(7)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (2, 100, 4, 16))
+        k = jax.random.normal(kk, (2, 300, 4, 16))  # 300 = 3 blocks of 128 w/ padding
+        v = jax.random.normal(kv, (2, 300, 4, 16))
+        out_block = blockwise_attention(q, k, v, causal=False, block_size=128)
+        out_naive = naive_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out_block), np.asarray(out_naive), atol=2e-5)
