@@ -1,0 +1,5 @@
+"""GCP TPU backend (queued-resource slice provisioning)."""
+
+from dstack_tpu.backends.gcp.compute import GcpTpuCompute, ProvisioningError
+
+__all__ = ["GcpTpuCompute", "ProvisioningError"]

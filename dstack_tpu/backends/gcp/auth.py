@@ -1,0 +1,153 @@
+"""GCP OAuth2 access-token providers, SDK-free.
+
+Parity: the reference authenticates via google-cloud-* client libraries
+(core/backends/gcp/auth.py); this build talks REST directly, so auth is a small
+token-provider hierarchy:
+
+- ``StaticTokenProvider`` — user-supplied OAuth token (also what tests inject).
+- ``MetadataTokenProvider`` — GCE/TPU-VM metadata server (the zero-config path when the
+  control plane itself runs on GCP).
+- ``ServiceAccountTokenProvider`` — service-account JSON key: RS256-signed JWT grant
+  against the oauth2 token endpoint (RFC 7523), using ``cryptography`` for signing.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Optional
+
+from dstack_tpu.core.errors import BackendError
+
+SCOPE = "https://www.googleapis.com/auth/cloud-platform"
+TOKEN_URL = "https://oauth2.googleapis.com/token"
+METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/service-accounts/default/token"
+)
+
+
+class AuthError(BackendError):
+    """Credential failure; a BackendError so the scheduler's per-offer handling treats
+    it as that backend failing, not as a crash of the whole scheduling pass."""
+
+
+class TokenProvider:
+    async def get_token(self) -> str:
+        raise NotImplementedError
+
+
+class StaticTokenProvider(TokenProvider):
+    def __init__(self, token: str):
+        self._token = token
+
+    async def get_token(self) -> str:
+        return self._token
+
+
+class MetadataTokenProvider(TokenProvider):
+    """Fetch tokens from the GCE metadata server (cached until near expiry)."""
+
+    def __init__(self) -> None:
+        self._token: Optional[str] = None
+        self._expires_at: float = 0.0
+
+    async def get_token(self) -> str:
+        if self._token is not None and time.time() < self._expires_at - 60:
+            return self._token
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    METADATA_TOKEN_URL,
+                    headers={"Metadata-Flavor": "Google"},
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as resp:
+                    if resp.status != 200:
+                        raise AuthError(f"metadata server returned {resp.status}")
+                    data = await resp.json()
+        except aiohttp.ClientError as e:
+            raise AuthError(f"metadata server unreachable: {e}") from e
+        self._token = data["access_token"]
+        self._expires_at = time.time() + float(data.get("expires_in", 3600))
+        return self._token
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def sign_jwt_rs256(claims: dict, private_key_pem: str) -> str:
+    """Build a compact RS256 JWT (header.claims.signature) for the OAuth JWT grant."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    header = {"alg": "RS256", "typ": "JWT"}
+    signing_input = (
+        _b64url(json.dumps(header, separators=(",", ":")).encode())
+        + "."
+        + _b64url(json.dumps(claims, separators=(",", ":")).encode())
+    )
+    key = serialization.load_pem_private_key(private_key_pem.encode(), password=None)
+    signature = key.sign(signing_input.encode(), padding.PKCS1v15(), hashes.SHA256())
+    return signing_input + "." + _b64url(signature)
+
+
+class ServiceAccountTokenProvider(TokenProvider):
+    """OAuth2 JWT-bearer grant from a service-account JSON key dict."""
+
+    def __init__(self, sa_key: dict):
+        if "client_email" not in sa_key or "private_key" not in sa_key:
+            raise AuthError("service account key must contain client_email and private_key")
+        self._key = sa_key
+        self._token: Optional[str] = None
+        self._expires_at: float = 0.0
+
+    async def get_token(self) -> str:
+        if self._token is not None and time.time() < self._expires_at - 60:
+            return self._token
+        now = int(time.time())
+        assertion = sign_jwt_rs256(
+            {
+                "iss": self._key["client_email"],
+                "scope": SCOPE,
+                "aud": self._key.get("token_uri", TOKEN_URL),
+                "iat": now,
+                "exp": now + 3600,
+            },
+            self._key["private_key"],
+        )
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    self._key.get("token_uri", TOKEN_URL),
+                    data={
+                        "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+                        "assertion": assertion,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    data = await resp.json()
+                    if resp.status != 200:
+                        raise AuthError(f"token exchange failed: {resp.status} {data}")
+        except aiohttp.ClientError as e:
+            raise AuthError(f"token endpoint unreachable: {e}") from e
+        self._token = data["access_token"]
+        self._expires_at = time.time() + float(data.get("expires_in", 3600))
+        return self._token
+
+
+def token_provider_from_creds(creds: Optional[dict]) -> TokenProvider:
+    """creds: {"token": ...} | {"type": "service_account", ...key...} | None (metadata)."""
+    if creds:
+        if "token" in creds:
+            return StaticTokenProvider(creds["token"])
+        if creds.get("type") == "service_account" or "private_key" in creds:
+            return ServiceAccountTokenProvider(creds)
+        if "data" in creds:  # inline key file content as a JSON string
+            return ServiceAccountTokenProvider(json.loads(creds["data"]))
+        raise AuthError(f"unrecognized GCP creds shape: keys={sorted(creds)}")
+    return MetadataTokenProvider()

@@ -1,0 +1,123 @@
+"""Thin async REST client for the Cloud TPU v2 API (tpu.googleapis.com).
+
+Parity: the reference drives ``google.cloud.tpu_v2.TpuClient`` (gcp/compute.py:98) but
+only ``nodes.create`` (single-host slices, ``_is_single_host_tpu`` gcp/compute.py:983-999).
+This client speaks to BOTH surfaces and is built around **queued resources**, the API
+that provisions multi-host slices atomically — the headline extension over the
+reference (SURVEY §7.5).
+
+Transport is injectable: production uses aiohttp with a TokenProvider; tests inject a
+``FakeTransport`` that scripts responses, so the full provisioning FSM is exercised
+with zero network (SURVEY §4 fake-Compute strategy, applied one level deeper).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from dstack_tpu.backends.gcp.auth import TokenProvider
+from dstack_tpu.core.errors import BackendError
+
+API_ROOT = "https://tpu.googleapis.com/v2"
+
+
+class GcpApiError(BackendError):
+    def __init__(self, status: int, message: str, reason: str = ""):
+        super().__init__(f"TPU API {status}: {message}")
+        self.status = status
+        self.message = message
+        self.reason = reason
+
+
+class Transport:
+    """request() returns the decoded JSON body or raises GcpApiError."""
+
+    async def request(
+        self, method: str, url: str, body: Optional[dict] = None, params: Optional[dict] = None
+    ) -> Any:
+        raise NotImplementedError
+
+
+class AiohttpTransport(Transport):
+    def __init__(self, token_provider: TokenProvider):
+        self._tokens = token_provider
+
+    async def request(self, method, url, body=None, params=None):
+        import aiohttp
+
+        token = await self._tokens.get_token()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.request(
+                    method,
+                    url,
+                    json=body,
+                    params=params,
+                    headers={"Authorization": f"Bearer {token}"},
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as resp:
+                    text = await resp.text()
+                    data = json.loads(text) if text else {}
+                    if resp.status >= 400:
+                        err = data.get("error", {}) if isinstance(data, dict) else {}
+                        raise GcpApiError(
+                            resp.status,
+                            err.get("message", text[:300]),
+                            err.get("status", ""),
+                        )
+                    return data
+        except aiohttp.ClientError as e:
+            raise GcpApiError(0, f"transport error: {e}") from e
+
+
+class TpuV2Client:
+    """Queued-resource and node operations scoped to one project."""
+
+    def __init__(self, project_id: str, transport: Transport):
+        self.project_id = project_id
+        self._t = transport
+
+    def _parent(self, zone: str) -> str:
+        return f"projects/{self.project_id}/locations/{zone}"
+
+    # -- queued resources (multi-host-capable provisioning; reference lacks these) ----
+
+    async def create_queued_resource(
+        self, zone: str, qr_id: str, body: Dict[str, Any]
+    ) -> dict:
+        return await self._t.request(
+            "POST",
+            f"{API_ROOT}/{self._parent(zone)}/queuedResources",
+            body=body,
+            params={"queuedResourceId": qr_id},
+        )
+
+    async def get_queued_resource(self, zone: str, qr_id: str) -> dict:
+        return await self._t.request(
+            "GET", f"{API_ROOT}/{self._parent(zone)}/queuedResources/{qr_id}"
+        )
+
+    async def delete_queued_resource(self, zone: str, qr_id: str, force: bool = True) -> dict:
+        return await self._t.request(
+            "DELETE",
+            f"{API_ROOT}/{self._parent(zone)}/queuedResources/{qr_id}",
+            params={"force": "true"} if force else None,
+        )
+
+    # -- nodes ------------------------------------------------------------------------
+
+    async def get_node(self, zone: str, node_id: str) -> dict:
+        return await self._t.request(
+            "GET", f"{API_ROOT}/{self._parent(zone)}/nodes/{node_id}"
+        )
+
+    async def delete_node(self, zone: str, node_id: str) -> dict:
+        return await self._t.request(
+            "DELETE", f"{API_ROOT}/{self._parent(zone)}/nodes/{node_id}"
+        )
+
+    async def list_accelerator_types(self, zone: str) -> dict:
+        return await self._t.request(
+            "GET", f"{API_ROOT}/{self._parent(zone)}/acceleratorTypes"
+        )

@@ -1,0 +1,74 @@
+"""Startup script for freshly provisioned TPU VM workers.
+
+Parity: reference ``_get_tpu_startup_script`` (gcp/compute.py:952-958) + shim install
+commands (base/compute.py:508-581): cloud-init installs the host agent as a systemd
+unit with ``PJRT_DEVICE=TPU``. TPU-native differences: the agent is the C++
+dstack-tpu-runner (no docker shim yet — TPU VMs run jobs directly on the host runtime
+image), and the script probes TPU devices (/dev/accel*, /dev/vfio) + libtpu so the
+control plane can verify accelerator health from the first heartbeat.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+RUNNER_PORT = 10999
+
+
+def build_startup_script(
+    runner_url: str,
+    authorized_keys: Optional[List[str]] = None,
+    runner_port: int = RUNNER_PORT,
+    extra_env: Optional[dict] = None,
+) -> str:
+    """A bash cloud-init script: SSH keys -> runner install -> systemd unit -> start."""
+    env_lines = {"PJRT_DEVICE": "TPU", "TPU_RUNTIME": "pjrt"}
+    if extra_env:
+        env_lines.update({str(k): str(v) for k, v in extra_env.items()})
+    env_block = "\n".join(f"Environment={k}={v}" for k, v in sorted(env_lines.items()))
+
+    keys_block = ""
+    if authorized_keys:
+        joined = "\n".join(k.strip() for k in authorized_keys if k.strip())
+        keys_block = f"""
+mkdir -p /root/.ssh && chmod 700 /root/.ssh
+cat >> /root/.ssh/authorized_keys <<'DSTACK_KEYS'
+{joined}
+DSTACK_KEYS
+chmod 600 /root/.ssh/authorized_keys
+"""
+
+    return f"""#!/bin/bash
+set -x
+{keys_block}
+# TPU device + libtpu discovery, recorded for the control plane (host-info contract;
+# replaces the reference's nvidia-smi probe, shim/host/gpu.go:44-58).
+mkdir -p /var/lib/dstack-tpu
+{{
+  echo "accel_devices=$(ls /dev/accel* 2>/dev/null | wc -l)"
+  echo "vfio_devices=$(ls /dev/vfio/* 2>/dev/null | wc -l)"
+  echo "libtpu=$(ls /usr/lib/libtpu.so /lib/libtpu.so 2>/dev/null | head -1)"
+  echo "worker_id=$(curl -s -H 'Metadata-Flavor: Google' 'http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-number' 2>/dev/null)"
+}} > /var/lib/dstack-tpu/host-info
+
+# Install the runner agent.
+mkdir -p /usr/local/bin
+curl -fsSL -o /usr/local/bin/dstack-tpu-runner '{runner_url}'
+chmod +x /usr/local/bin/dstack-tpu-runner
+
+cat > /etc/systemd/system/dstack-tpu-runner.service <<'DSTACK_UNIT'
+[Unit]
+Description=dstack-tpu runner agent
+After=network-online.target
+[Service]
+{env_block}
+ExecStart=/usr/local/bin/dstack-tpu-runner --port {runner_port} --base-dir /var/lib/dstack-tpu
+Restart=always
+RestartSec=2
+[Install]
+WantedBy=multi-user.target
+DSTACK_UNIT
+
+systemctl daemon-reload
+systemctl enable --now dstack-tpu-runner.service
+"""

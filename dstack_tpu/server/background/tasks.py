@@ -332,6 +332,25 @@ async def _process_provisioning(db: Database, job_row) -> None:
 
     jpd = job_jpd(job_row)
     jrd = job_jrd(job_row) or JobRuntimeData()
+
+    # Cloud slices provision asynchronously (GCP queued resources): hostname is unknown
+    # until the node is READY. Poll the backend and persist the resolved endpoint
+    # (reference update_provisioning_data, gcp/compute.py:350-407).
+    if jpd.hostname is None:
+        jpd = await _update_jpd_from_backend(db, job_row, jpd)
+        if jpd is None or jpd.hostname is None:
+            if jpd is not None:
+                await _check_provisioning_deadline(db, job_row)
+            return
+
+    # The cluster contract carries every worker's endpoint: re-read the gang after
+    # resolution and hold submission until all peers' hostnames are known too
+    # (each peer resolves its own endpoint on its own pass).
+    replica = await _replica_rows(db, job_row)
+    if any((p := job_jpd(r)) is None or p.hostname is None for r in replica):
+        await _touch(db, job_row)
+        return
+
     client = get_runner_client(jpd, jrd)
     health = await client.healthcheck()
     if health is None:
@@ -493,6 +512,46 @@ async def _check_provisioning_deadline(db: Database, job_row) -> None:
         )
     else:
         await _touch(db, job_row)
+
+
+async def _update_jpd_from_backend(db: Database, job_row, jpd) -> Optional[JobProvisioningData]:
+    """Poll the backend for a still-unresolved worker endpoint; persist when known.
+
+    Returns the (possibly updated) jpd, or None when the slice failed to provision —
+    in which case the whole gang is pushed to TERMINATING with a retryable
+    no-capacity reason (spot stockouts/preemptions requeue via the run retry policy).
+    """
+    project_row = await db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (job_row["project_id"],)
+    )
+    try:
+        compute = await backends_service.get_compute(db, project_row, jpd.backend)
+    except Exception:
+        await _touch(db, job_row)
+        return jpd
+    try:
+        updated = await compute.update_provisioning_data(jpd)
+    except (NoCapacityError, BackendError) as e:
+        logger.info("slice %s failed to provision: %s", jpd.slice_id, e)
+        for r in await _replica_rows(db, job_row):
+            await terminate_job(
+                db, r, JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY, str(e)
+            )
+        return None
+    if updated.hostname is not None:
+        jpd_json = updated.model_dump_json()
+        await db.execute(
+            "UPDATE jobs SET job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
+            (jpd_json, to_iso(now_utc()), job_row["id"]),
+        )
+        if job_row["instance_id"]:
+            await db.execute(
+                "UPDATE instances SET job_provisioning_data = ? WHERE id = ?",
+                (jpd_json, job_row["instance_id"]),
+            )
+        return updated
+    await _touch(db, job_row)
+    return updated
 
 
 async def _touch(db: Database, job_row) -> None:
@@ -810,8 +869,14 @@ async def _process_instance(db: Database, row) -> None:
         if jpd:
             from dstack_tpu.core.models.runs import JobProvisioningData
 
-            client = get_runner_client(JobProvisioningData.model_validate(jpd), None)
-            healthy = await client.healthcheck()
+            jpd_obj = JobProvisioningData.model_validate(jpd)
+            if jpd_obj.hostname is None:
+                # Cloud slice still resolving (GCP queued resource): poll the backend
+                # here too — unassigned slices otherwise never become reachable.
+                jpd_obj = await _resolve_instance_endpoint(db, row, jpd_obj)
+            if jpd_obj is not None and jpd_obj.hostname is not None:
+                client = get_runner_client(jpd_obj, None)
+                healthy = await client.healthcheck()
         if healthy is not None:
             await db.execute(
                 "UPDATE instances SET status = 'idle', idle_since = ? WHERE id = ?",
@@ -899,6 +964,42 @@ async def _provision_pending_instance(db: Database, row) -> None:
         )
         return
     logger.info("fleet %s: no capacity for pending instance %s", fleet_row["name"], row["name"])
+
+
+async def _resolve_instance_endpoint(db: Database, row, jpd):
+    """Instance-row analog of _update_jpd_from_backend: poll the backend for an
+    unassigned slice's endpoint; persist when known, terminate the slice on failure."""
+    project_row = await db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    try:
+        compute = await backends_service.get_compute(db, project_row, jpd.backend)
+    except Exception:
+        return jpd
+    try:
+        updated = await compute.update_provisioning_data(jpd)
+    except BackendError as e:
+        logger.info("instance %s failed to provision: %s", row["name"], e)
+        slice_id = row["slice_id"]
+        if slice_id:
+            await db.execute(
+                "UPDATE instances SET status = 'terminating', termination_reason = ?"
+                " WHERE slice_id = ? AND deleted = 0",
+                (str(e), slice_id),
+            )
+        else:
+            await db.execute(
+                "UPDATE instances SET status = 'terminating', termination_reason = ?"
+                " WHERE id = ?",
+                (str(e), row["id"]),
+            )
+        return None
+    if updated.hostname is not None:
+        await db.execute(
+            "UPDATE instances SET job_provisioning_data = ? WHERE id = ?",
+            (updated.model_dump_json(), row["id"]),
+        )
+    return updated
 
 
 async def _check_idle_expiry(db: Database, row) -> None:
