@@ -1,0 +1,166 @@
+"""SSH fleets: provision user-supplied hosts into the slice pool over SSH.
+
+Parity: reference remote/provisioning.py (paramiko: arch detect :40, shim upload +
+systemd :116, host_info -> InstanceType :246). TPU-native differences: host probing
+counts TPU accelerator devices (/dev/accel*, /dev/vfio) and libtpu presence instead of
+running nvidia-smi, the agent uploaded is the C++ runner, and upload rides stdin over
+the OpenSSH client (``cat > bin``) — no paramiko/SFTP dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import shlex
+from typing import Optional, Tuple
+
+from dstack_tpu.backends.gcp.startup import RUNNER_PORT
+from dstack_tpu.core.errors import SSHError
+from dstack_tpu.core.models.configurations import SSHHostParams
+from dstack_tpu.core.models.instances import (
+    HostResources,
+    InstanceType,
+    SSHConnectionParams,
+    TpuResources,
+)
+from dstack_tpu.core.models.runs import JobProvisioningData
+from dstack_tpu.core.services.ssh import tunnel as ssh_tunnel
+
+logger = logging.getLogger(__name__)
+
+# Overridable seam for tests (fake SSH executor).
+ssh_exec = ssh_tunnel.ssh_exec
+
+_HOST_INFO_CMD = (
+    "echo cpus=$(nproc);"
+    " echo mem_mb=$(awk '/MemTotal/{print int($2/1024)}' /proc/meminfo);"
+    " echo disk_gb=$(df -BG --output=avail / 2>/dev/null | tail -1 | tr -dc 0-9);"
+    " echo accel=$(ls /dev/accel* 2>/dev/null | wc -l);"
+    " echo vfio=$(ls /dev/vfio/* 2>/dev/null | grep -cv vfio$ || true);"
+    " echo libtpu=$(ls /usr/lib/libtpu.so /lib/libtpu.so /usr/local/lib/libtpu.so 2>/dev/null | head -1);"
+    " echo arch=$(uname -m)"
+)
+
+_INSTALL_RUNNER_CMD = (
+    "mkdir -p /usr/local/bin /var/lib/dstack-tpu"
+    " && cat > /usr/local/bin/dstack-tpu-runner"
+    " && chmod +x /usr/local/bin/dstack-tpu-runner"
+)
+
+
+def _start_runner_cmd(port: int) -> str:
+    unit = f"""[Unit]
+Description=dstack-tpu runner agent
+After=network-online.target
+[Service]
+Environment=PJRT_DEVICE=TPU
+ExecStart=/usr/local/bin/dstack-tpu-runner --port {port} --base-dir /var/lib/dstack-tpu
+Restart=always
+RestartSec=2
+[Install]
+WantedBy=multi-user.target
+"""
+    # systemd when available; nohup fallback for containers/minimal hosts.
+    return (
+        "if command -v systemctl >/dev/null 2>&1 && [ -d /run/systemd/system ]; then"
+        f" printf %s {shlex.quote(unit)} > /etc/systemd/system/dstack-tpu-runner.service"
+        " && systemctl daemon-reload && systemctl enable --now dstack-tpu-runner.service;"
+        " else"
+        " pkill -f 'dstack-tpu-runner --port' 2>/dev/null;"
+        f" nohup /usr/local/bin/dstack-tpu-runner --port {port}"
+        " --base-dir /var/lib/dstack-tpu >/var/lib/dstack-tpu/runner.log 2>&1 &"
+        " fi"
+    )
+
+
+def parse_host_info(output: str) -> dict:
+    info = {}
+    for line in output.splitlines():
+        if "=" in line:
+            k, _, v = line.strip().partition("=")
+            info[k] = v
+    return info
+
+
+def host_info_to_instance_type(info: dict) -> InstanceType:
+    """Reference :246 host_info_to_instance_type, with a TPU branch instead of GPUs.
+
+    Accelerator count comes from /dev/accel* (PJRT device nodes); the generation is
+    unknown from the device node alone, so it stays None — requirements matching for
+    SSH fleets is by chip count.
+    """
+    chips = int(info.get("accel") or 0) or int(info.get("vfio") or 0)
+    tpu = None
+    if chips > 0:
+        tpu = TpuResources(chips=chips, hosts=1)
+    return InstanceType(
+        name=info.get("arch", "ssh-host"),
+        resources=HostResources(
+            cpus=int(info.get("cpus") or 0),
+            memory_gb=float(info.get("mem_mb") or 0) / 1024.0,
+            disk_gb=float(info.get("disk_gb") or 0),
+            tpu=tpu,
+        ),
+    )
+
+
+def _proxy_params(host: SSHHostParams) -> Optional[SSHConnectionParams]:
+    if not host.proxy_jump:
+        return None
+    user, _, hostport = host.proxy_jump.rpartition("@")
+    hostname, _, port = hostport.partition(":")
+    return SSHConnectionParams(
+        hostname=hostname, username=user or "root", port=int(port or 22)
+    )
+
+
+async def provision_ssh_host(
+    host: SSHHostParams,
+    runner_binary: bytes,
+    *,
+    default_user: Optional[str] = None,
+    default_identity_file: Optional[str] = None,
+    runner_port: int = RUNNER_PORT,
+) -> Tuple[JobProvisioningData, dict]:
+    """Probe, install the runner, start it. Returns (jpd, host_info).
+
+    Raises SSHError when the host is unreachable or any step fails.
+    """
+    user = host.user or default_user or "root"
+    identity = host.identity_file or default_identity_file
+    proxy = _proxy_params(host)
+    kwargs = dict(
+        username=user, port=host.port, identity_file=identity, proxy=proxy
+    )
+
+    rc, out, err = await ssh_exec(host.hostname, _HOST_INFO_CMD, **kwargs)
+    if rc != 0:
+        raise SSHError(f"host probe failed on {host.hostname}: {err.decode(errors='replace')[:300]}")
+    info = parse_host_info(out.decode(errors="replace"))
+
+    rc, _, err = await ssh_exec(
+        host.hostname, _INSTALL_RUNNER_CMD, input_data=runner_binary, timeout=180, **kwargs
+    )
+    if rc != 0:
+        raise SSHError(f"runner upload failed on {host.hostname}: {err.decode(errors='replace')[:300]}")
+
+    rc, _, err = await ssh_exec(host.hostname, _start_runner_cmd(runner_port), **kwargs)
+    if rc != 0:
+        raise SSHError(f"runner start failed on {host.hostname}: {err.decode(errors='replace')[:300]}")
+
+    instance_type = host_info_to_instance_type(info)
+    jpd = JobProvisioningData(
+        backend="ssh",
+        instance_type=instance_type,
+        instance_id=f"ssh-{host.hostname}",
+        hostname=host.hostname,
+        internal_ip=host.hostname,
+        region="remote",
+        price=0.0,
+        username=user,
+        ssh_port=host.port,
+        ssh_proxy=proxy,
+        dockerized=False,
+        backend_data=json.dumps({"runner_port": runner_port, "host_info": info}),
+    )
+    return jpd, info

@@ -191,9 +191,11 @@ async def _provision_slice(
         except Exception:
             continue
         name = f"{run_row['run_name']}-{slice_jobs[0]['replica_num']}-{new_id()[:8]}"
+        # Authorized keys: the user's run key plus the server's tunnel identity.
+        keys = [k for k in (run_spec.ssh_key_pub, _server_public_key()) if k]
         try:
             jpds = await compute.create_slice(
-                offer, name, ssh_public_key=run_spec.ssh_key_pub or ""
+                offer, name, ssh_public_key="\n".join(keys)
             )
         except NoCapacityError as e:
             logger.debug("offer %s/%s no capacity: %s", offer.backend, offer.instance.name, e)
@@ -221,6 +223,16 @@ async def _provision_slice(
             await _assign_job(db, j_row, iid, json.loads(jpd.model_dump_json()))
         return True
     return False
+
+
+def _server_public_key() -> str:
+    try:
+        from dstack_tpu.utils.ssh_keys import get_server_ssh_keypair
+
+        _, public = get_server_ssh_keypair(settings.SERVER_DIR)
+        return public
+    except Exception:
+        return ""
 
 
 async def _run_fleet(db: Database, run_row, run_spec: RunSpec) -> str:
@@ -896,11 +908,69 @@ async def _process_instance(db: Database, row) -> None:
         await _terminate_slice_when_drained(db, row)
 
 
+async def _provision_ssh_instance(db: Database, row) -> None:
+    """SSH-fleet host: probe + install + start the runner over SSH, then hand the row
+    to the PROVISIONING branch (healthcheck via tunnel -> idle). Reference
+    process_instances.py:222 _add_remote + remote/provisioning.py:116."""
+    from dstack_tpu.backends.remote import provisioning
+    from dstack_tpu.core.errors import SSHError
+    from dstack_tpu.core.models.configurations import SSHHostParams, SSHParams
+    from dstack_tpu.core.models.fleets import FleetSpec
+    from dstack_tpu.utils.runner_binary import find_runner_binary
+
+    host = SSHHostParams.model_validate(loads(row["remote_connection_info"]))
+    ssh_defaults = SSHParams()
+    if row["fleet_id"]:
+        fleet_row = await db.fetchone("SELECT * FROM fleets WHERE id = ?", (row["fleet_id"],))
+        if fleet_row is not None:
+            conf = FleetSpec.model_validate(loads(fleet_row["spec"])).configuration
+            if conf.ssh_config is not None:
+                ssh_defaults = conf.ssh_config
+    binary_path = find_runner_binary()
+    if binary_path is None:
+        logger.error("ssh fleet %s: no runner binary available", row["name"])
+        return
+    with open(binary_path, "rb") as f:
+        runner_binary = f.read()
+    try:
+        jpd, info = await provisioning.provision_ssh_host(
+            host,
+            runner_binary,
+            default_user=ssh_defaults.user,
+            default_identity_file=ssh_defaults.identity_file,
+        )
+    except SSHError as e:
+        logger.info("ssh host %s not provisionable yet: %s", host.hostname, e)
+        if (now_utc() - from_iso(row["created_at"])).total_seconds() > settings.PROVISIONING_TIMEOUT:
+            await db.execute(
+                "UPDATE instances SET status = 'terminating', termination_reason = ?"
+                " WHERE id = ?",
+                (f"ssh provisioning failed: {e}", row["id"]),
+            )
+        return
+    await db.execute(
+        "UPDATE instances SET status = 'provisioning', backend = 'ssh', region = ?,"
+        " price = 0, instance_type = ?, job_provisioning_data = ?, worker_num = 0,"
+        " hosts_per_slice = 1 WHERE id = ?",
+        (
+            jpd.region,
+            jpd.instance_type.model_dump_json(),
+            jpd.model_dump_json(),
+            row["id"],
+        ),
+    )
+    await db.execute(
+        "UPDATE fleets SET status = 'active' WHERE id = ? AND status = 'submitted'",
+        (row["fleet_id"],),
+    )
+
+
 async def _provision_pending_instance(db: Database, row) -> None:
     """Provision a cloud fleet's pending slice marker: one marker row becomes the
     slice's worker rows (reference process_instances.py:457 _create_instance)."""
     if row["remote_connection_info"]:
-        return  # SSH-fleet host; provisioned by the SSH provisioner (separate milestone)
+        await _provision_ssh_instance(db, row)
+        return
     if row["fleet_id"] is None:
         return
     fleet_row = await db.fetchone("SELECT * FROM fleets WHERE id = ?", (row["fleet_id"],))
@@ -1063,6 +1133,17 @@ async def _terminate_slice_when_drained(db: Database, row) -> None:
                 return
             if (now_utc() - from_iso(deadline)).total_seconds() < settings.TERMINATION_RETRY_WINDOW:
                 return  # retry next pass; give up after the window to avoid a stuck row
+    # Tear down any live SSH tunnels to the slice's workers.
+    from dstack_tpu.core.models.runs import JobProvisioningData
+    from dstack_tpu.server.services.runner import ssh as runner_ssh
+
+    for w in workers:
+        w_jpd = loads(w["job_provisioning_data"])
+        if w_jpd:
+            try:
+                await runner_ssh.close_tunnel(JobProvisioningData.model_validate(w_jpd))
+            except Exception:
+                pass
     now = to_iso(now_utc())
     ids = [w["id"] for w in workers]
     await db.execute(

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 
 import aiohttp
 
+from dstack_tpu.core.errors import SSHError
 from dstack_tpu.core.models.runs import ClusterInfo, JobRuntimeData, JobSpec
 
 REQUEST_TIMEOUT = aiohttp.ClientTimeout(total=10)
@@ -27,10 +28,26 @@ class RunnerError(Exception):
 
 
 class RunnerClient:
-    """Async HTTP client; one instance per (host, port) conversation."""
+    """Async HTTP client; one instance per (host, port) conversation.
 
-    def __init__(self, hostname: str, port: int):
-        self.base = f"http://{hostname}:{port}"
+    ``endpoint_resolver`` defers endpoint resolution to first use: cloud instances
+    resolve to the local end of an SSH tunnel (services/runner/ssh.py), which can only
+    be established from an async context."""
+
+    def __init__(
+        self,
+        hostname: Optional[str] = None,
+        port: Optional[int] = None,
+        endpoint_resolver=None,
+    ):
+        self.base = f"http://{hostname}:{port}" if hostname is not None else None
+        self._resolver = endpoint_resolver
+
+    async def _ensure_base(self) -> str:
+        if self.base is None:
+            host, port = await self._resolver()
+            self.base = f"http://{host}:{port}"
+        return self.base
 
     async def _request(
         self,
@@ -41,6 +58,7 @@ class RunnerClient:
         params: Optional[dict] = None,
     ) -> Any:
         try:
+            base = await self._ensure_base()
             async with aiohttp.ClientSession(timeout=REQUEST_TIMEOUT) as session:
                 kwargs: dict = {}
                 if payload is not None:
@@ -49,14 +67,14 @@ class RunnerClient:
                     kwargs["data"] = data
                 if params is not None:
                     kwargs["params"] = params
-                async with session.request(method, self.base + path, **kwargs) as resp:
+                async with session.request(method, base + path, **kwargs) as resp:
                     body = await resp.read()
                     if resp.status >= 400:
                         raise RunnerError(f"{path} -> {resp.status}: {body[:200]!r}")
                     if not body:
                         return None
                     return json.loads(body)
-        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError, SSHError) as e:
             raise RunnerError(f"{path}: {e}") from e
 
     async def healthcheck(self) -> Optional[dict]:
@@ -107,9 +125,15 @@ class RunnerClient:
 def get_runner_client(jpd, jrd: Optional[JobRuntimeData]) -> RunnerClient:
     """Resolve how to reach a job's runner.
 
-    Local/dockerized=False instances expose the runner directly on a host port recorded
-    in JobRuntimeData; cloud instances are reached via an SSH local-forward established
-    by services/runner/ssh.py (the tunnel rewrites host/port before this call)."""
+    Local/mock instances expose the runner directly on a host port recorded in
+    JobRuntimeData; cloud instances are reached via a pooled SSH local-forward
+    (services/runner/ssh.py), resolved lazily on the client's first request."""
+    from dstack_tpu.server.services.runner import ssh as runner_ssh
+
+    if jpd is not None and runner_ssh.tunnel_required(jpd):
+        return RunnerClient(
+            endpoint_resolver=lambda: runner_ssh.tunneled_endpoint(jpd, jrd)
+        )
     port = None
     if jrd is not None and jrd.runner_port:
         port = jrd.runner_port

@@ -1,0 +1,123 @@
+"""Runner reachability: SSH local-forward tunnels for cloud instances.
+
+Parity: reference server/services/runner/ssh.py:24-114 (``runner_ssh_tunnel``
+decorator). Shape differs: instead of wrapping every client call, this module keeps a
+per-worker tunnel pool and hands the RunnerClient a lazily-resolved base endpoint —
+one persistent ``ssh -N -L`` child per slice worker, reused across scheduler passes
+(the reference re-establishes tunnels per call batch).
+
+Local/mock instances bypass SSH entirely; with no ssh client on the host the layer
+degrades to direct HTTP (dev containers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from dstack_tpu.backends.gcp.startup import RUNNER_PORT
+from dstack_tpu.core.errors import SSHError
+from dstack_tpu.core.models.runs import JobProvisioningData, JobRuntimeData
+from dstack_tpu.core.services.ssh.tunnel import (
+    Forward,
+    SSHTunnel,
+    allocate_local_port,
+    ssh_binary,
+)
+from dstack_tpu.server import settings
+
+logger = logging.getLogger(__name__)
+
+_DIRECT_BACKENDS = {"local", "mock"}
+
+_pool: Dict[str, SSHTunnel] = {}
+_pool_lock: Optional[asyncio.Lock] = None
+
+
+def _lock() -> asyncio.Lock:
+    global _pool_lock
+    if _pool_lock is None:
+        _pool_lock = asyncio.Lock()
+    return _pool_lock
+
+
+def tunnel_required(jpd: JobProvisioningData) -> bool:
+    if jpd.backend in _DIRECT_BACKENDS:
+        return False
+    if not settings.SSH_TUNNELS_ENABLED:
+        return False
+    return ssh_binary() is not None
+
+
+def _runner_port(jpd: JobProvisioningData, jrd: Optional[JobRuntimeData]) -> int:
+    if jrd is not None and jrd.runner_port:
+        return jrd.runner_port
+    if jpd.backend_data:
+        try:
+            import json
+
+            port = json.loads(jpd.backend_data).get("runner_port")
+            if port:
+                return int(port)
+        except (ValueError, TypeError):
+            pass
+    return RUNNER_PORT
+
+
+def _key(jpd: JobProvisioningData) -> str:
+    return f"{jpd.instance_id}:{jpd.worker_num}"
+
+
+async def tunneled_endpoint(
+    jpd: JobProvisioningData, jrd: Optional[JobRuntimeData]
+) -> Tuple[str, int]:
+    """(host, port) the RunnerClient should hit: the local end of a live tunnel."""
+    remote_port = _runner_port(jpd, jrd)
+    key = _key(jpd)
+    async with _lock():
+        tunnel = _pool.get(key)
+        if tunnel is not None and tunnel.is_open:
+            return "127.0.0.1", tunnel.forwards[0].local_port
+        if tunnel is not None:
+            await tunnel.close()
+            _pool.pop(key, None)
+        local_port = allocate_local_port()
+        tunnel = SSHTunnel(
+            hostname=jpd.hostname or "",
+            username=jpd.username or "root",
+            port=jpd.ssh_port or 22,
+            identity_file=settings.SSH_IDENTITY_FILE or _server_identity(),
+            proxy=jpd.ssh_proxy,
+            forwards=[Forward(local_port, "127.0.0.1", remote_port)],
+        )
+        await tunnel.open()
+        _pool[key] = tunnel
+        logger.debug("tunnel up: %s -> %s:%s (local %s)", key, jpd.hostname, remote_port, local_port)
+        return "127.0.0.1", local_port
+
+
+async def close_tunnel(jpd: JobProvisioningData) -> None:
+    async with _lock():
+        tunnel = _pool.pop(_key(jpd), None)
+    if tunnel is not None:
+        await tunnel.close()
+
+
+async def close_all_tunnels() -> None:
+    async with _lock():
+        tunnels = list(_pool.values())
+        _pool.clear()
+    for t in tunnels:
+        await t.close()
+
+
+def _server_identity() -> Optional[str]:
+    try:
+        from dstack_tpu.utils.ssh_keys import get_server_ssh_keypair
+
+        identity, _ = get_server_ssh_keypair(settings.SERVER_DIR)
+        return identity
+    except Exception:  # keygen failure must not take down the scheduler
+        logger.exception("failed to materialize server ssh identity")
+        return None

@@ -51,6 +51,11 @@ RETRY_BACKOFF_MAX = float(os.getenv("DSTACK_TPU_RETRY_BACKOFF_MAX", "600"))
 TERMINATION_RETRY_WINDOW = float(os.getenv("DSTACK_TPU_TERMINATION_RETRY_WINDOW", "600"))
 
 LOCAL_BACKEND_ENABLED = _env_bool("DSTACK_TPU_LOCAL_BACKEND_ENABLED", True)
+
+# SSH transport: cloud runner traffic rides ssh -L tunnels (reference tunnel.py).
+# Disabled -> direct HTTP (dev). Identity defaults to a server-generated ed25519 key.
+SSH_TUNNELS_ENABLED = _env_bool("DSTACK_TPU_SSH_TUNNELS_ENABLED", True)
+SSH_IDENTITY_FILE = os.getenv("DSTACK_TPU_SSH_IDENTITY_FILE")
 ENABLE_PROMETHEUS_METRICS = _env_bool("DSTACK_TPU_ENABLE_PROMETHEUS_METRICS", True)
 
 MAX_CODE_SIZE = int(os.getenv("DSTACK_TPU_MAX_CODE_SIZE", str(2 * 1024 * 1024)))  # 2 MiB, ref settings.py:92

@@ -1,0 +1,45 @@
+"""Server SSH identity: ed25519 keypair generated on first use.
+
+Parity: reference utils/crypto.py (RSA keygen for project keys) — ed25519 here
+(smaller, modern default), serialized in OpenSSH format via ``cryptography``.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Tuple
+
+
+def generate_ed25519_keypair() -> Tuple[str, str]:
+    """Returns (private_key_openssh, public_key_line)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    key = ed25519.Ed25519PrivateKey.generate()
+    private = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.OpenSSH,
+        serialization.NoEncryption(),
+    ).decode()
+    public = (
+        key.public_key()
+        .public_bytes(serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+        .decode()
+        + " dstack-tpu-server"
+    )
+    return private, public
+
+
+def get_server_ssh_keypair(server_dir: Path) -> Tuple[str, str]:
+    """(identity_file_path, public_key_line); generated under server_dir/ssh once."""
+    ssh_dir = server_dir / "ssh"
+    private_path = ssh_dir / "id_ed25519"
+    public_path = ssh_dir / "id_ed25519.pub"
+    if not private_path.exists():
+        ssh_dir.mkdir(parents=True, exist_ok=True)
+        private, public = generate_ed25519_keypair()
+        private_path.write_text(private)
+        os.chmod(private_path, 0o600)
+        public_path.write_text(public + "\n")
+    return str(private_path), public_path.read_text().strip()

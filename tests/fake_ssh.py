@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Fake OpenSSH client for tests: a real TCP forwarder behind the ssh CLI surface.
+
+Supports the subset SSHTunnel/ssh_exec emit:
+  ssh [-o k=v]... [-p port] [-i file] [-J jump] -N -L 127.0.0.1:L:H:P... user@host
+  ssh [options] user@host <command>
+
+Tunnel mode (-N -L): listens on each local port and forwards byte streams to the
+target given by FAKE_SSH_FORWARD_TARGET (host:port) — standing in for "the runner
+port on the SSH destination". This proves control-plane traffic actually rides the
+tunnel: tests give the destination an unresolvable hostname, so only the tunnel path
+can reach the runner.
+
+Exec mode prints FAKE_SSH_EXEC_OUTPUT and exits 0 (provisioning tests patch
+ssh_exec at the Python level instead; this keeps the binary surface honest).
+"""
+
+import asyncio
+import os
+import sys
+
+
+def parse(argv):
+    forwards, dest, command, n_flag = [], None, None, False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-o", "-p", "-i", "-J"):
+            i += 2
+            continue
+        if a == "-N":
+            n_flag = True
+            i += 1
+            continue
+        if a == "-L":
+            spec = argv[i + 1]
+            parts = spec.split(":")
+            # [bind:]L:H:P
+            local = int(parts[1] if len(parts) == 4 else parts[0])
+            forwards.append(local)
+            i += 2
+            continue
+        if dest is None:
+            dest = a
+        else:
+            command = " ".join(argv[i:])
+            break
+        i += 1
+    return forwards, dest, command, n_flag
+
+
+async def pump(reader, writer):
+    try:
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                break
+            writer.write(data)
+            await writer.drain()
+    except (ConnectionError, asyncio.CancelledError):
+        pass
+    finally:
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+
+async def serve_forward(local_port, target_host, target_port):
+    async def handle(reader, writer):
+        try:
+            r2, w2 = await asyncio.open_connection(target_host, target_port)
+        except OSError:
+            writer.close()
+            return
+        await asyncio.gather(pump(reader, w2), pump(r2, writer))
+
+    server = await asyncio.start_server(handle, "127.0.0.1", local_port)
+    async with server:
+        await server.serve_forever()
+
+
+def main():
+    forwards, dest, command, n_flag = parse(sys.argv[1:])
+    if command is not None:
+        sys.stdout.write(os.environ.get("FAKE_SSH_EXEC_OUTPUT", ""))
+        return 0
+    if n_flag and forwards:
+        target = os.environ.get("FAKE_SSH_FORWARD_TARGET", "")
+        if not target:
+            sys.stderr.write("fake_ssh: FAKE_SSH_FORWARD_TARGET not set\n")
+            return 255
+        host, _, port = target.rpartition(":")
+
+        async def run_all():
+            await asyncio.gather(*(serve_forward(lp, host, int(port)) for lp in forwards))
+
+        try:
+            asyncio.run(run_all())
+        except KeyboardInterrupt:
+            pass
+        return 0
+    sys.stderr.write("fake_ssh: unsupported invocation\n")
+    return 255
+
+
+if __name__ == "__main__":
+    sys.exit(main())
