@@ -23,6 +23,14 @@ class LlamaConfig:
     # "full" recomputes everything; "dots" saves MXU outputs and recomputes only
     # elementwise ops (less recompute, more HBM).
     remat_policy: str = "full"
+    # Attention core: "blockwise" (online-softmax scan; O(block) memory, long-seq),
+    # "plain" (materialize [T,S] scores; fastest via XLA fusion when T is moderate).
+    # Ring attention over `sp` always uses the blockwise accumulator.
+    attn_impl: str = "blockwise"
+    # Cross-entropy: chunk the vocab projection over the sequence so [B,T,V] fp32
+    # logits are never fully materialized (0 = off). Trades ~2*d*V flops/token of
+    # recompute for ~2 * B*T*V*4 bytes of HBM.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -37,10 +45,16 @@ class LlamaConfig:
         per_layer = attn + mlp + 2 * d  # + norms
         return v * d + self.n_layers * per_layer + d + d * v
 
-    def flops_per_token(self, seq_len: int) -> float:
-        """Approximate training FLOPs per token: 6*N plus attention score FLOPs
-        (12*L*T*d per token for fwd+bwd QK^T and AV)."""
-        return 6.0 * self.num_params() + 12.0 * self.n_layers * seq_len * self.d_model
+    def flops_per_token(self, seq_len: int, causal: bool = True) -> float:
+        """Training FLOPs per token: 6*N plus attention score FLOPs. The
+        full-window QK^T+AV term is 12*L*T*d per token (fwd+bwd); with causal
+        masking only half the score matrix is computed, so the honest count —
+        matching what a flash kernel actually executes — is 6*L*T*d. MFU
+        numbers in bench.py use the causal (conservative) count."""
+        attn = 12.0 * self.n_layers * seq_len * self.d_model
+        if causal:
+            attn /= 2.0
+        return 6.0 * self.num_params() + attn
 
 
 # Presets. llama3_8b mirrors the reference north-star workload (BASELINE.json:
@@ -57,6 +71,15 @@ PRESETS = {
     "llama3_8b": LlamaConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
         max_seq_len=8192,
+    ),
+    # Single-v5e-chip bench geometry (~670M params): wide-not-deep so the MLP
+    # matmuls hit the MXU's efficient K,N>=2048 regime (measured 191 vs 178
+    # TFLOP/s for d=1536/ff=4096 shapes — BASELINE.md round-3 sweep). Flash
+    # attention + chunked CE keep HBM under the 16 GB chip limit at batch 24.
+    "v5e_bench": LlamaConfig(
+        vocab_size=32000, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=16,
+        d_ff=8192, max_seq_len=2048, remat=True, remat_policy="full",
+        attn_impl="flash", loss_chunk=256,
     ),
 }
 

@@ -15,13 +15,20 @@ Parity: the MaxText-analog workload for the reference's distributed-training exa
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dstack_tpu.workloads.attention import blockwise_attention, ring_attention
+from dstack_tpu.workloads.attention import (
+    blockwise_attention,
+    flash_attention_tpu,
+    flash_available,
+    plain_attention,
+    ring_attention,
+)
 from dstack_tpu.workloads.config import LlamaConfig
 
 Params = Dict[str, jax.Array]
@@ -81,9 +88,13 @@ def forward(
     tokens: jax.Array,  # [B, T] int32
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """Returns logits [B, T, V] (float32). When `mesh` is given, activation sharding
-    constraints are inserted and attention runs ring-parallel over `sp`."""
+    """Returns logits [B, T, V] (float32), or the final hidden state [B, T, D]
+    (post final_norm, pre lm_head) when `return_hidden` — used by the chunked
+    cross-entropy so [B,T,V] fp32 logits are never fully materialized. When
+    `mesh` is given, activation sharding constraints are inserted and attention
+    runs ring-parallel over `sp`."""
     adt = jnp.dtype(cfg.dtype)
     b, t = tokens.shape
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
@@ -97,14 +108,16 @@ def forward(
     x = act_constraint(x, P(("dp", "fsdp"), "sp", None))
     positions = jnp.arange(t)
 
+    name = checkpoint_name
+
     def block(x, layer):
         h_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
-                       preferred_element_type=jnp.float32).astype(adt)
-        k = jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
-                       preferred_element_type=jnp.float32).astype(adt)
-        v = jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
-                       preferred_element_type=jnp.float32).astype(adt)
+        q = name(jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
+                            preferred_element_type=jnp.float32).astype(adt), "proj")
+        k = name(jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
+                            preferred_element_type=jnp.float32).astype(adt), "proj")
+        v = name(jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
+                            preferred_element_type=jnp.float32).astype(adt), "proj")
         q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
@@ -115,19 +128,26 @@ def forward(
         k = _rope(k, positions, cfg.rope_theta)
         if use_sp:
             o = ring_attention(q, k, v, mesh)
+        elif cfg.attn_impl == "flash" and mesh is None and flash_available():
+            # Flash only without a mesh: a Pallas tpu_custom_call has no SPMD
+            # partitioning rule, so under a sharded jit it would force operand
+            # replication. Sharded runs use blockwise/ring (shard_map) instead.
+            o = flash_attention_tpu(q, k, v)
+        elif cfg.attn_impl == "plain":
+            o = plain_attention(q, k, v)
         else:
             o = blockwise_attention(q, k, v)
-        o = o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim)
+        o = name(o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim), "proj")
         attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
                               preferred_element_type=jnp.float32).astype(adt)
         x = x + act_constraint(attn_out, P(("dp", "fsdp"), "sp", None))
 
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
-                          preferred_element_type=jnp.float32)
-        up = jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
-                        preferred_element_type=jnp.float32)
-        hidden = (jax.nn.silu(gate) * up).astype(adt)
+        gate = name(jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
+                               preferred_element_type=jnp.float32).astype(adt), "proj")
+        up = name(jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
+                             preferred_element_type=jnp.float32).astype(adt), "proj")
+        hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
         hidden = act_constraint(hidden, P(("dp", "fsdp"), "sp", "tp"))
         mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
                              preferred_element_type=jnp.float32).astype(adt)
@@ -143,6 +163,12 @@ def forward(
         policy = None
         if cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "save_proj":
+            # Save the six projection-matmul outputs (named below); recompute
+            # everything else — attention scores/softmax and elementwise ops.
+            # Backward then re-runs only cheap ops + the score matmuls, for
+            # ~B*T*d*2 bytes/layer of HBM instead of the full residual set.
+            policy = jax.checkpoint_policies.save_only_these_names("proj")
         block_fn = jax.checkpoint(block, prevent_cse=True, policy=policy)
 
     def scan_body(x, layer):
@@ -151,9 +177,46 @@ def forward(
     x, _ = jax.lax.scan(scan_body, x, layer_params)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if return_hidden:
+        return x
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(adt),
                         preferred_element_type=jnp.float32)
     return act_constraint(logits, P(("dp", "fsdp"), "sp", None))
+
+
+def _chunked_nll(
+    x: jax.Array,        # [B, T, D] final hidden (post final_norm)
+    lm_head: jax.Array,  # [D, V]
+    targets: jax.Array,  # [B, T]; -1 = ignore
+    chunk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Cross-entropy without materializing [B,T,V] fp32 logits: scan the sequence
+    in chunks; each chunk's logits+log_softmax live only inside its scan step and
+    are rematerialized on the backward pass (jax.checkpoint)."""
+    b, t, d = x.shape
+    n_chunks = t // chunk
+
+    xc = x.reshape(b, n_chunks, chunk, d).swapaxes(0, 1)        # [n,B,c,D]
+    tc = targets.reshape(b, n_chunks, chunk).swapaxes(0, 1)     # [n,B,c]
+
+    @jax.checkpoint
+    def chunk_nll(x_blk, t_blk):
+        logits = jnp.einsum("bcd,dv->bcv", x_blk, lm_head,
+                            preferred_element_type=jnp.float32)
+        mask = t_blk >= 0
+        safe = jnp.where(mask, t_blk, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask), jnp.sum(mask)
+
+    def body(carry, inputs):
+        s_nll, s_cnt = carry
+        x_blk, t_blk = inputs
+        nll, cnt = chunk_nll(x_blk, t_blk)
+        return (s_nll + nll, s_cnt + cnt), None
+
+    (total_nll, total_cnt), _ = jax.lax.scan(body, (jnp.zeros(()), jnp.zeros((), jnp.int32)), (xc, tc))
+    return total_nll, total_cnt
 
 
 def loss_fn(
@@ -163,6 +226,28 @@ def loss_fn(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
+    if cfg.loss_chunk:
+        # Largest divisor of T that is <= loss_chunk keeps the chunked path (and
+        # its HBM saving) for any length instead of silently materializing
+        # [B,T,V] fp32 logits when T % loss_chunk != 0.
+        chunk = next(
+            (c for c in range(min(cfg.loss_chunk, tokens.shape[1]), 0, -1)
+             if tokens.shape[1] % c == 0),
+            1,
+        )
+        if chunk < max(1, cfg.loss_chunk // 8):
+            import warnings
+
+            warnings.warn(
+                f"loss_chunk={cfg.loss_chunk} has no usable divisor of seq_len="
+                f"{tokens.shape[1]} (best {chunk}); falling back to full logits",
+                stacklevel=2,
+            )
+        else:
+            hidden = forward(params, tokens, cfg, mesh, return_hidden=True)
+            lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
+            total_nll, total_cnt = _chunked_nll(hidden, lm_head, targets, chunk)
+            return total_nll / jnp.maximum(total_cnt, 1)
     logits = forward(params, tokens, cfg, mesh)
     mask = targets >= 0
     safe_targets = jnp.where(mask, targets, 0)

@@ -32,10 +32,15 @@ def make_optimizer(
     b1: float = 0.9,
     b2: float = 0.95,
     grad_clip: float = 1.0,
+    mu_dtype: Optional[str] = None,
 ) -> optax.GradientTransformation:
+    """AdamW with global-norm clipping. `mu_dtype="bfloat16"` stores the first
+    moment in bf16 — halves its HBM (the variance and master params stay fp32),
+    which is what buys the larger per-chip batch in bench.py."""
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 

@@ -1,0 +1,119 @@
+"""MFU sweep harness (round 3): times train-step variants on the real chip.
+
+Usage: python experiments/mfu_sweep.py [variant ...]
+
+Each variant is timed over `STEPS` individually-dispatched steps with a final
+device sync per step (float(loss) — block_until_ready is unreliable through the
+PJRT relay). Reports per-step median and best, and counted MFU
+(flops_per_token * tokens / time / peak).
+
+Findings land in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dstack_tpu.workloads import train as train_lib
+from dstack_tpu.workloads.config import LlamaConfig
+
+PEAK = 197e12  # v5e bf16
+
+
+def time_variant(name: str, cfg: LlamaConfig, batch: int, steps: int = 10) -> dict:
+    seq = cfg.max_seq_len
+    optimizer = train_lib.make_optimizer()
+    state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer)
+    step_fn = train_lib.make_train_step(cfg, optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0, cfg.vocab_size)
+
+    t_compile = time.perf_counter()
+    state, m = step_fn(state, tokens, targets)
+    loss0 = float(m["loss"])
+    compile_s = time.perf_counter() - t_compile
+
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, tokens, targets)
+        _ = float(m["loss"])
+        times.append(time.perf_counter() - t0)
+
+    med = statistics.median(times)
+    best = min(times)
+    n_tok = batch * seq
+    fpt = cfg.flops_per_token(seq)
+    out = {
+        "variant": name,
+        "compile_s": round(compile_s, 1),
+        "med_ms": round(med * 1e3, 1),
+        "best_ms": round(best * 1e3, 1),
+        "mfu_med": round(fpt * n_tok / med / PEAK * 100, 2),
+        "mfu_best": round(fpt * n_tok / best / PEAK * 100, 2),
+        "tok_s_med": round(n_tok / med),
+        "loss0": round(loss0, 3),
+    }
+    print(out, flush=True)
+    return out
+
+
+BASE = dict(
+    vocab_size=32000, d_model=1536, n_layers=12, n_heads=12, n_kv_heads=12,
+    d_ff=4096, max_seq_len=2048,
+)
+
+VARIANTS = {
+    # round-2 baseline: full remat, blockwise attention
+    "r2_baseline": (LlamaConfig(**BASE, remat=True, remat_policy="full"), 8),
+    "plain_attn_b8": (LlamaConfig(**BASE, remat=True, remat_policy="full",
+                                  attn_impl="plain"), 8),
+    "plain_chunkce_b8": (LlamaConfig(**BASE, remat=True, remat_policy="full",
+                                     attn_impl="plain", loss_chunk=512), 8),
+    "plain_dots_chunkce_b8": (LlamaConfig(**BASE, remat=True, remat_policy="dots",
+                                          attn_impl="plain", loss_chunk=512), 8),
+    "plain_noremat_chunkce_b8": (LlamaConfig(**BASE, remat=False,
+                                             attn_impl="plain", loss_chunk=512), 8),
+    "plain_noremat_chunkce_b4": (LlamaConfig(**BASE, remat=False,
+                                             attn_impl="plain", loss_chunk=512), 4),
+    "saveproj_b8": (LlamaConfig(**BASE, remat=True, remat_policy="save_proj",
+                                attn_impl="plain", loss_chunk=512), 8),
+    "saveproj_b4": (LlamaConfig(**BASE, remat=True, remat_policy="save_proj",
+                                attn_impl="plain", loss_chunk=512), 4),
+    "saveproj_block_b8": (LlamaConfig(**BASE, remat=True, remat_policy="save_proj",
+                                      attn_impl="blockwise", loss_chunk=512), 8),
+    "flash_full_b8": (LlamaConfig(**BASE, remat=True, remat_policy="full",
+                                  attn_impl="flash", loss_chunk=512), 8),
+    "flash_saveproj_b8": (LlamaConfig(**BASE, remat=True, remat_policy="save_proj",
+                                      attn_impl="flash", loss_chunk=512), 8),
+    "flash_saveproj_b4": (LlamaConfig(**BASE, remat=True, remat_policy="save_proj",
+                                      attn_impl="flash", loss_chunk=512), 4),
+    "flash_full_b16": (LlamaConfig(**BASE, remat=True, remat_policy="full",
+                                   attn_impl="flash", loss_chunk=512), 16),
+    "flash_full_b32_lc256": (LlamaConfig(**BASE, remat=True, remat_policy="full",
+                                         attn_impl="flash", loss_chunk=256), 32),
+    # wider geometry: MXU prefers K,N >= 2048 (mm sweep: 191 vs 178 TF/s)
+    "wide_d2048_b8": (LlamaConfig(vocab_size=32000, d_model=2048, n_layers=8,
+                                  n_heads=16, n_kv_heads=16, d_ff=8192, max_seq_len=2048,
+                                  remat=True, remat_policy="full", attn_impl="flash",
+                                  loss_chunk=512), 8),
+    "wide_d2048_b16": (LlamaConfig(vocab_size=32000, d_model=2048, n_layers=8,
+                                   n_heads=16, n_kv_heads=16, d_ff=8192, max_seq_len=2048,
+                                   remat=True, remat_policy="full", attn_impl="flash",
+                                   loss_chunk=512), 16),
+}
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(VARIANTS)
+    for n in names:
+        cfg, b = VARIANTS[n]
+        try:
+            time_variant(n, cfg, b)
+        except Exception as e:  # HBM OOM arrives as opaque compile failure via relay
+            print({"variant": n, "error": str(e)[:200]}, flush=True)

@@ -14,7 +14,11 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 from dstack_tpu.workloads import model as model_lib
 from dstack_tpu.workloads import train as train_lib
-from dstack_tpu.workloads.attention import blockwise_attention, ring_attention
+from dstack_tpu.workloads.attention import (
+    blockwise_attention,
+    plain_attention,
+    ring_attention,
+)
 from dstack_tpu.workloads.config import get_config
 from dstack_tpu.workloads.sharding import (
     PARAM_SPECS,
@@ -57,6 +61,16 @@ class TestAttention:
         out_naive = naive_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out_block), np.asarray(out_naive), atol=2e-5)
 
+    def test_plain_matches_naive(self):
+        key = jax.random.PRNGKey(3)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (2, 128, 4, 16))
+        k = jax.random.normal(kk, (2, 128, 2, 16))  # GQA 2:1
+        v = jax.random.normal(kv, (2, 128, 2, 16))
+        out_plain = plain_attention(q, k, v)
+        out_naive = naive_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_naive), atol=2e-5)
+
     def test_ring_matches_blockwise(self):
         devs = cpu_devices(8)
         mesh = make_mesh(dp=1, fsdp=2, tp=1, sp=4, devices=devs)
@@ -83,6 +97,41 @@ class TestModel:
         params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
         logits = jax.jit(lambda p, t: model_lib.forward(p, t, cfg))(params, tokens)
+        assert logits.shape == (2, 64, cfg.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_chunked_ce_matches_full(self):
+        # loss_chunk must not change the loss value (only HBM footprint).
+        cfg = get_config("test", dtype="float32")
+        cfg_chunk = get_config("test", dtype="float32", loss_chunk=16)
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0, cfg.vocab_size)
+        targets = targets.at[0, :5].set(-1)  # exercise the ignore mask
+        full = float(model_lib.loss_fn(params, tokens, targets, cfg))
+        chunked = float(model_lib.loss_fn(params, tokens, targets, cfg_chunk))
+        np.testing.assert_allclose(chunked, full, rtol=1e-5)
+
+    def test_chunked_ce_grads_match_full(self):
+        cfg = get_config("test", dtype="float32")
+        cfg_chunk = get_config("test", dtype="float32", loss_chunk=16)
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0, cfg.vocab_size)
+        g_full = jax.grad(model_lib.loss_fn)(params, tokens, targets, cfg)
+        g_chunk = jax.grad(model_lib.loss_fn)(params, tokens, targets, cfg_chunk)
+        for name in ("lm_head", "embed", "final_norm"):
+            np.testing.assert_allclose(
+                np.asarray(g_chunk[name]), np.asarray(g_full[name]), atol=1e-5, rtol=1e-4
+            )
+
+    def test_flash_impl_falls_back_off_tpu(self):
+        # attn_impl="flash" must still work where Mosaic can't run (CPU tests,
+        # multichip dryrun) by falling back to the blockwise core.
+        cfg = get_config("test", attn_impl="flash")
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+        logits = model_lib.forward(params, tokens, cfg)
         assert logits.shape == (2, 64, cfg.vocab_size)
         assert bool(jnp.isfinite(logits).all())
 
