@@ -84,6 +84,9 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
             "runner_url",
             "https://storage.googleapis.com/dstack-tpu-artifacts/dstack-tpu-runner",
         )
+        # TPU VM images ship sshd with root login disabled; "ubuntu" is the
+        # stock login user (reference gcp/compute.py:278,342).
+        self.vm_username = config.get("vm_username", "ubuntu")
         if transport is None:
             transport = AiohttpTransport(token_provider_from_creds(config.get("creds")))
         self.client = TpuV2Client(self.project_id, transport)
@@ -134,6 +137,7 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
             startup_script = build_startup_script(
                 self.runner_url,
                 authorized_keys=[ssh_public_key] if ssh_public_key else None,
+                login_user=self.vm_username,
             )
         node = {
             "acceleratorType": spec.accelerator_type,
@@ -170,8 +174,14 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
             try:
                 await self.client.create_queued_resource(zone, instance_name, body)
             except GcpApiError as e:
-                if e.status in (403, 429) or e.reason in _CAPACITY_API_REASONS:
-                    logger.debug("gcp: zone %s rejected %s: %s", zone, instance_name, e)
+                # 403 is a capacity signal only when the API names a quota/rate
+                # reason; a bare 403 is an IAM misconfiguration and must surface
+                # as a hard error, not dissolve into "all zones rejected".
+                quota_403 = e.status == 403 and e.reason in _CAPACITY_API_REASONS
+                if e.status == 429 or quota_403 or (
+                    e.status != 403 and e.reason in _CAPACITY_API_REASONS
+                ):
+                    logger.warning("gcp: zone %s rejected %s: %s", zone, instance_name, e)
                     continue
                 raise ComputeError(str(e)) from e
             backend_data = json.dumps({"zone": zone, "qr_id": instance_name, "is_tpu": True})
@@ -185,7 +195,7 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
                     region=offer.region,
                     availability_zone=zone,
                     price=offer.price,
-                    username="root",
+                    username=self.vm_username,
                     ssh_port=22,
                     dockerized=False,
                     backend_data=backend_data,
@@ -240,21 +250,36 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
         self, slice_id: str, region: str, backend_data: Optional[str] = None
     ) -> None:
         data = json.loads(backend_data or "{}")
+        qr_id = data.get("qr_id", slice_id)
         zone = data.get("zone")
-        if not zone:
-            gens = [g for g, regions in TPU_ZONES.items() if region in regions]
-            zone = TPU_ZONES[gens[0]][region][0] if gens else None
-        if not zone:
+        if zone:
+            zones = [zone]
+        else:
+            # backend_data lost: sweep every zone of the region across all
+            # generations — guessing one zone and treating its 404 as "already
+            # gone" would leak a billed slice sitting in another zone forever.
+            zones = sorted(
+                {
+                    z
+                    for regions in TPU_ZONES.values()
+                    for z in regions.get(region, [])
+                }
+            )
+        if not zones:
             logger.warning("gcp: cannot resolve zone to terminate %s in %s", slice_id, region)
             return
-        qr_id = data.get("qr_id", slice_id)
-        try:
-            # force=True tears the node down with the queued resource in one call.
-            await self.client.delete_queued_resource(zone, qr_id, force=True)
-        except GcpApiError as e:
-            if e.status == 404:
-                return  # already gone
-            raise ComputeError(str(e)) from e
+        not_found = 0
+        for z in zones:
+            try:
+                # force=True tears the node down with the queued resource in one call.
+                await self.client.delete_queued_resource(z, qr_id, force=True)
+            except GcpApiError as e:
+                if e.status == 404:
+                    not_found += 1
+                    continue
+                raise ComputeError(str(e)) from e
+        if not_found == len(zones) and len(zones) > 1:
+            logger.info("gcp: %s not found in any zone of %s (already gone)", qr_id, region)
 
     # -- volumes (TPU data disks; reference gcp/compute.py:1003-1016) -----------------
 

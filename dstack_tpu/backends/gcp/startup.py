@@ -20,8 +20,14 @@ def build_startup_script(
     authorized_keys: Optional[List[str]] = None,
     runner_port: int = RUNNER_PORT,
     extra_env: Optional[dict] = None,
+    login_user: str = "ubuntu",
 ) -> str:
-    """A bash cloud-init script: SSH keys -> runner install -> systemd unit -> start."""
+    """A bash cloud-init script: SSH keys -> runner install -> systemd unit -> start.
+
+    Keys are installed for `login_user` (GCP TPU VM images ship sshd with root
+    login disabled — the reference connects as "ubuntu", gcp/compute.py:278,342)
+    and for root as a fallback for images that do allow it.
+    """
     env_lines = {"PJRT_DEVICE": "TPU", "TPU_RUNTIME": "pjrt"}
     if extra_env:
         env_lines.update({str(k): str(v) for k, v in extra_env.items()})
@@ -31,11 +37,19 @@ def build_startup_script(
     if authorized_keys:
         joined = "\n".join(k.strip() for k in authorized_keys if k.strip())
         keys_block = f"""
-mkdir -p /root/.ssh && chmod 700 /root/.ssh
-cat >> /root/.ssh/authorized_keys <<'DSTACK_KEYS'
+install_keys() {{
+  local home_dir="$1" owner="$2"
+  mkdir -p "$home_dir/.ssh" && chmod 700 "$home_dir/.ssh"
+  cat >> "$home_dir/.ssh/authorized_keys" <<'DSTACK_KEYS'
 {joined}
 DSTACK_KEYS
-chmod 600 /root/.ssh/authorized_keys
+  chmod 600 "$home_dir/.ssh/authorized_keys"
+  chown -R "$owner:" "$home_dir/.ssh" 2>/dev/null || true
+}}
+install_keys /root root
+if id -u {login_user} >/dev/null 2>&1; then
+  install_keys "$(getent passwd {login_user} | cut -d: -f6)" {login_user}
+fi
 """
 
     return f"""#!/bin/bash

@@ -47,6 +47,19 @@ _INSTALL_RUNNER_CMD = (
     " && chmod +x /usr/local/bin/dstack-tpu-runner"
 )
 
+# Appends keys arriving on stdin (one per line) to ~/.ssh/authorized_keys,
+# idempotently. The server's tunnel identity differs from the fleet's
+# provisioning identity (reference installs the project key the same way,
+# remote/provisioning.py:266-267); without this the healthcheck tunnels can
+# never authenticate and the host is torn down at PROVISIONING_TIMEOUT.
+_AUTHORIZE_KEYS_CMD = (
+    'mkdir -p "$HOME/.ssh" && chmod 700 "$HOME/.ssh"'
+    ' && touch "$HOME/.ssh/authorized_keys" && chmod 600 "$HOME/.ssh/authorized_keys"'
+    ' && while IFS= read -r k; do'
+    ' if [ -n "$k" ] && ! grep -qxF "$k" "$HOME/.ssh/authorized_keys"; then'
+    ' echo "$k" >> "$HOME/.ssh/authorized_keys"; fi; done'
+)
+
 
 def _start_runner_cmd(port: int) -> str:
     unit = f"""[Unit]
@@ -121,8 +134,11 @@ async def provision_ssh_host(
     default_user: Optional[str] = None,
     default_identity_file: Optional[str] = None,
     runner_port: int = RUNNER_PORT,
+    authorize_keys: Optional[list] = None,
 ) -> Tuple[JobProvisioningData, dict]:
-    """Probe, install the runner, start it. Returns (jpd, host_info).
+    """Probe, install the runner, start it, and install `authorize_keys` (the
+    server's tunnel public key) into the host's authorized_keys. Returns
+    (jpd, host_info).
 
     Raises SSHError when the host is unreachable or any step fails.
     """
@@ -137,6 +153,17 @@ async def provision_ssh_host(
     if rc != 0:
         raise SSHError(f"host probe failed on {host.hostname}: {err.decode(errors='replace')[:300]}")
     info = parse_host_info(out.decode(errors="replace"))
+
+    keys = "\n".join(k.strip() for k in (authorize_keys or []) if k and k.strip())
+    if keys:
+        rc, _, err = await ssh_exec(
+            host.hostname, _AUTHORIZE_KEYS_CMD, input_data=(keys + "\n").encode(), **kwargs
+        )
+        if rc != 0:
+            raise SSHError(
+                f"installing server key on {host.hostname} failed: "
+                f"{err.decode(errors='replace')[:300]}"
+            )
 
     rc, _, err = await ssh_exec(
         host.hostname, _INSTALL_RUNNER_CMD, input_data=runner_binary, timeout=180, **kwargs

@@ -938,6 +938,9 @@ async def _provision_ssh_instance(db: Database, row) -> None:
             runner_binary,
             default_user=ssh_defaults.user,
             default_identity_file=ssh_defaults.identity_file,
+            # The healthcheck/runner tunnels authenticate with the server
+            # identity, not the fleet's provisioning identity (ADVICE r2).
+            authorize_keys=[_server_public_key()],
         )
     except SSHError as e:
         logger.info("ssh host %s not provisionable yet: %s", host.hostname, e)
@@ -994,7 +997,12 @@ async def _provision_pending_instance(db: Database, row) -> None:
         except Exception:
             continue
         try:
-            jpds = await compute.create_slice(offer, row["name"])
+            # Same key set as the job path (_provision_slice): without the server
+            # public key the startup script installs no authorized_keys and the
+            # healthcheck tunnel can never authenticate (ADVICE r2).
+            jpds = await compute.create_slice(
+                offer, row["name"], ssh_public_key=_server_public_key()
+            )
         except BackendError as e:
             logger.debug("fleet %s offer failed: %s", fleet_row["name"], e)
             continue

@@ -33,6 +33,10 @@ _DIRECT_BACKENDS = {"local", "mock"}
 
 _pool: Dict[str, SSHTunnel] = {}
 _pool_lock: Optional[asyncio.Lock] = None
+# Per-key locks so tunnel establishment (up to CONNECT_TIMEOUT against a dead
+# host) to one worker never serializes runner traffic to every other instance
+# (ADVICE r2). The global lock only guards the dicts, never an open().
+_key_locks: Dict[str, asyncio.Lock] = {}
 
 
 def _lock() -> asyncio.Lock:
@@ -40,6 +44,14 @@ def _lock() -> asyncio.Lock:
     if _pool_lock is None:
         _pool_lock = asyncio.Lock()
     return _pool_lock
+
+
+async def _key_lock(key: str) -> asyncio.Lock:
+    async with _lock():
+        lock = _key_locks.get(key)
+        if lock is None:
+            lock = _key_locks[key] = asyncio.Lock()
+        return lock
 
 
 def tunnel_required(jpd: JobProvisioningData) -> bool:
@@ -75,13 +87,15 @@ async def tunneled_endpoint(
     """(host, port) the RunnerClient should hit: the local end of a live tunnel."""
     remote_port = _runner_port(jpd, jrd)
     key = _key(jpd)
-    async with _lock():
-        tunnel = _pool.get(key)
+    async with await _key_lock(key):
+        async with _lock():
+            tunnel = _pool.get(key)
         if tunnel is not None and tunnel.is_open:
             return "127.0.0.1", tunnel.forwards[0].local_port
         if tunnel is not None:
             await tunnel.close()
-            _pool.pop(key, None)
+            async with _lock():
+                _pool.pop(key, None)
         local_port = allocate_local_port()
         tunnel = SSHTunnel(
             hostname=jpd.hostname or "",
@@ -91,8 +105,9 @@ async def tunneled_endpoint(
             proxy=jpd.ssh_proxy,
             forwards=[Forward(local_port, "127.0.0.1", remote_port)],
         )
-        await tunnel.open()
-        _pool[key] = tunnel
+        await tunnel.open()  # slow path: only this key's callers wait
+        async with _lock():
+            _pool[key] = tunnel
         logger.debug("tunnel up: %s -> %s:%s (local %s)", key, jpd.hostname, remote_port, local_port)
         return "127.0.0.1", local_port
 
@@ -100,6 +115,7 @@ async def tunneled_endpoint(
 async def close_tunnel(jpd: JobProvisioningData) -> None:
     async with _lock():
         tunnel = _pool.pop(_key(jpd), None)
+        _key_locks.pop(_key(jpd), None)
     if tunnel is not None:
         await tunnel.close()
 
@@ -108,6 +124,7 @@ async def close_all_tunnels() -> None:
     async with _lock():
         tunnels = list(_pool.values())
         _pool.clear()
+        _key_locks.clear()
     for t in tunnels:
         await t.close()
 

@@ -155,11 +155,15 @@ class FakeSSHHost:
         self.tmp = tmp
         self.with_tpu = with_tpu
         self.commands = []
+        self.authorized_keys = b""
         self.proc = None
         self.port = None
 
     async def ssh_exec(self, hostname, command, *, input_data=None, **kwargs):
         self.commands.append((hostname, command))
+        if "authorized_keys" in command:
+            self.authorized_keys += input_data or b""
+            return 0, b"", b""
         if "echo cpus=" in command:
             tpu_lines = "accel=4\nlibtpu=/usr/lib/libtpu.so" if self.with_tpu else "accel=0\nlibtpu="
             out = f"cpus=8\nmem_mb=16384\ndisk_gb=100\n{tpu_lines}\nvfio=0\narch=x86_64\n"
@@ -233,6 +237,10 @@ class TestSSHFleetProvisioning:
                 cmds = " || ".join(c for _, c in host.commands)
                 assert "echo cpus=" in cmds
                 assert "cat > /usr/local/bin/dstack-tpu-runner" in cmds
+                # The server tunnel identity was authorized on the host
+                # (ADVICE r2: tunnels authenticate with the server key, not the
+                # fleet's provisioning identity).
+                assert host.authorized_keys.strip(), "server public key not installed"
                 fleet_row = await api.db.fetchone("SELECT * FROM fleets WHERE name = 'onprem'")
                 assert fleet_row["status"] == "active"
         finally:
