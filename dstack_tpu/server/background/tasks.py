@@ -148,12 +148,18 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
         if not slice_jobs or slice_jobs[0]["status"] != "submitted":
             continue
         # Phase 1: reuse an idle slice from the pool (reference
-        # process_submitted_jobs.py:344 _assign_job_to_pool_instance).
+        # process_submitted_jobs.py:344 _assign_job_to_pool_instance). Mark-busy and
+        # the gang's assignments commit in one transaction: a crash mid-pass must not
+        # leave a busy slice with unassigned jobs (or vice versa).
         if idle_slices:
             workers = idle_slices.pop(0)
-            await instances_service.mark_slice_busy(db, [w["id"] for w in workers])
-            for w_row, j_row in zip(workers, slice_jobs):
-                await _assign_job(db, j_row, w_row["id"], loads(w_row["job_provisioning_data"]))
+
+            def _assign_pool(conn, workers=workers, slice_jobs=slice_jobs):
+                instances_service.mark_slice_busy_tx(conn, [w["id"] for w in workers])
+                for w_row, j_row in zip(workers, slice_jobs):
+                    _assign_job_tx(conn, j_row, w_row["id"], loads(w_row["job_provisioning_data"]))
+
+            await db.run(_assign_pool)
             continue
         # Phase 2: provision a new slice (reference :415 _run_job_on_new_instance).
         if profile.creation_policy == CreationPolicy.REUSE:
@@ -172,8 +178,8 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
         await _handle_no_capacity(db, run_row, job_rows, profile)
 
 
-async def _assign_job(db: Database, job_row, instance_id: str, jpd_dict: dict) -> None:
-    await db.execute(
+def _assign_job_tx(conn, job_row, instance_id: str, jpd_dict: dict) -> None:
+    conn.execute(
         "UPDATE jobs SET status = 'provisioning', instance_id = ?,"
         " job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
         (instance_id, json.dumps(jpd_dict), to_iso(now_utc()), job_row["id"]),
@@ -184,7 +190,14 @@ async def _provision_slice(
     db: Database, project_row, run_row, run_spec: RunSpec, offers: List[InstanceOffer], slice_jobs: List
 ) -> bool:
     """Try offers in price order until a slice provisions; create instance rows and
-    assign the gang. Returns False when every offer fails with no capacity."""
+    assign the gang. Returns False when every offer fails with no capacity.
+
+    The cloud create happens first (it cannot be inside a DB transaction), but ALL the
+    bookkeeping it implies — fleet resolution, slice rows, busy marks, the gang's job
+    assignments — commits as one transaction (reference wraps the pass in one session,
+    process_submitted_jobs.py:193-241). A crash after create_slice but before commit
+    leaves zero rows: the orphaned cloud slice is visible (billed) but the scheduler
+    state is consistent and the next pass re-provisions cleanly."""
     for offer in offers[: settings.MAX_OFFERS_TRIED]:
         try:
             compute = await backends_service.get_compute(db, project_row, offer.backend)
@@ -203,24 +216,30 @@ async def _provision_slice(
         except BackendError as e:
             logger.warning("offer %s/%s provisioning failed: %s", offer.backend, offer.instance.name, e)
             continue
-        fleet_id = await _run_fleet(db, run_row, run_spec)
-        ids = await instances_service.create_slice_instances(
-            db,
-            project_row["id"],
-            fleet_id,
-            name,
-            jpds,
-            offer,
-            status=InstanceStatus.PROVISIONING,
-        )
-        await db.execute(
-            f"UPDATE instances SET busy_blocks = 1 WHERE id IN ({','.join('?' for _ in ids)})",
-            ids,
-        )
-        if run_row["fleet_id"] is None:
-            await db.execute("UPDATE runs SET fleet_id = ? WHERE id = ?", (fleet_id, run_row["id"]))
-        for jpd, iid, j_row in zip(jpds, ids, slice_jobs):
-            await _assign_job(db, j_row, iid, json.loads(jpd.model_dump_json()))
+
+        def _commit_placement(conn, offer=offer, name=name, jpds=jpds):
+            fleet_id = _run_fleet_tx(conn, run_row, run_spec)
+            ids = instances_service.create_slice_instances_tx(
+                conn,
+                project_row["id"],
+                fleet_id,
+                name,
+                jpds,
+                offer,
+                status=InstanceStatus.PROVISIONING,
+            )
+            conn.execute(
+                f"UPDATE instances SET busy_blocks = 1 WHERE id IN ({','.join('?' for _ in ids)})",
+                ids,
+            )
+            if run_row["fleet_id"] is None:
+                conn.execute(
+                    "UPDATE runs SET fleet_id = ? WHERE id = ?", (fleet_id, run_row["id"])
+                )
+            for jpd, iid, j_row in zip(jpds, ids, slice_jobs):
+                _assign_job_tx(conn, j_row, iid, json.loads(jpd.model_dump_json()))
+
+        await db.run(_commit_placement)
         return True
     return False
 
@@ -235,19 +254,19 @@ def _server_public_key() -> str:
         return ""
 
 
-async def _run_fleet(db: Database, run_row, run_spec: RunSpec) -> str:
+def _run_fleet_tx(conn, run_row, run_spec: RunSpec) -> str:
     profile = run_spec.merged_profile()
     if profile.fleets:
-        row = await db.fetchone(
+        row = conn.execute(
             "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
             (run_row["project_id"], profile.fleets[0]),
-        )
+        ).fetchone()
         if row is not None:
             return row["id"]
     if run_row["fleet_id"] is not None:
         return run_row["fleet_id"]
-    return await fleets_service.get_or_create_auto_fleet(
-        db, run_row["project_id"], run_row["run_name"]
+    return fleets_service.get_or_create_auto_fleet_tx(
+        conn, run_row["project_id"], run_row["run_name"]
     )
 
 
@@ -812,11 +831,13 @@ async def _maybe_retry_replica(
         return True  # backoff window
 
     now = to_iso(now_utc())
-    for r in replica_rows:
-        await db.execute(
-            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
-            " submission_num, job_spec, status, submitted_at)"
-            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
+    # One executemany = one transaction: the resubmitted gang appears whole or not at
+    # all (a partial gang would deadlock the slice-atomic placement forever).
+    await db.executemany(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " submission_num, job_spec, status, submitted_at)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
+        [
             (
                 new_id(),
                 r["project_id"],
@@ -827,8 +848,10 @@ async def _maybe_retry_replica(
                 submission_num + 1,
                 r["job_spec"],
                 now,
-            ),
-        )
+            )
+            for r in replica_rows
+        ],
+    )
     logger.info(
         "run %s: retrying replica %s (submission %s)",
         run_row["run_name"], replica_rows[0]["replica_num"], submission_num + 1,

@@ -218,19 +218,27 @@ async def _soft_delete_fleet(db: Database, row) -> None:
     await db.execute("UPDATE fleets SET deleted = 1 WHERE id = ?", (row["id"],))
 
 
-async def get_or_create_auto_fleet(db: Database, project_id: str, run_name: str) -> str:
-    """Run-scoped fleet for instances provisioned on demand (no fleet targeted)."""
-    row = await db.fetchone(
+def get_or_create_auto_fleet_tx(conn, project_id: str, run_name: str) -> str:
+    """Synchronous core of get_or_create_auto_fleet, composable inside one db.run()
+    transaction with the slice-row inserts it precedes."""
+    row = conn.execute(
         "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
         (project_id, run_name),
-    )
+    ).fetchone()
     if row is not None:
         return row["id"]
     fleet_id = new_id()
     spec = FleetSpec.model_validate({"configuration": {"type": "fleet", "name": run_name}})
-    await db.execute(
+    conn.execute(
         "INSERT INTO fleets (id, project_id, name, status, spec, created_at, auto_created)"
         " VALUES (?, ?, ?, 'active', ?, ?, 1)",
         (fleet_id, project_id, run_name, spec.model_dump_json(), to_iso(now_utc())),
     )
     return fleet_id
+
+
+async def get_or_create_auto_fleet(db: Database, project_id: str, run_name: str) -> str:
+    """Run-scoped fleet for instances provisioned on demand (no fleet targeted)."""
+    return await db.run(
+        lambda conn: get_or_create_auto_fleet_tx(conn, project_id, run_name)
+    )

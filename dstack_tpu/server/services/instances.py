@@ -57,8 +57,8 @@ def _jpd_hostname(row) -> Optional[str]:
     return None
 
 
-async def create_slice_instances(
-    db: Database,
+def create_slice_instances_tx(
+    conn,
     project_id: str,
     fleet_id: Optional[str],
     name_base: str,
@@ -67,8 +67,9 @@ async def create_slice_instances(
     status: InstanceStatus = InstanceStatus.PROVISIONING,
     instance_num_start: int = 0,
 ) -> List[str]:
-    """Insert one instance row per slice worker; all rows share slice_id. Returns ids in
-    worker order."""
+    """Synchronous core of create_slice_instances, composable inside one db.run()
+    transaction so slice rows and their job assignments commit atomically (reference
+    wraps each scheduler pass in a session transaction, process_submitted_jobs.py:193)."""
     now = to_iso(now_utc())
     ids: List[str] = []
     rows = []
@@ -98,7 +99,7 @@ async def create_slice_instances(
                 jpd.hosts_per_slice,
             )
         )
-    await db.executemany(
+    conn.executemany(
         "INSERT INTO instances (id, project_id, fleet_id, name, instance_num, status,"
         " created_at, last_processed_at, backend, region, availability_zone, price,"
         " instance_type, offer, job_provisioning_data, slice_id, slice_name, worker_num,"
@@ -106,6 +107,25 @@ async def create_slice_instances(
         rows,
     )
     return ids
+
+
+async def create_slice_instances(
+    db: Database,
+    project_id: str,
+    fleet_id: Optional[str],
+    name_base: str,
+    jpds: List[JobProvisioningData],
+    offer: InstanceOffer,
+    status: InstanceStatus = InstanceStatus.PROVISIONING,
+    instance_num_start: int = 0,
+) -> List[str]:
+    """Insert one instance row per slice worker; all rows share slice_id. Returns ids in
+    worker order."""
+    return await db.run(
+        lambda conn: create_slice_instances_tx(
+            conn, project_id, fleet_id, name_base, jpds, offer, status, instance_num_start
+        )
+    )
 
 
 async def find_idle_slices(
@@ -180,13 +200,17 @@ def _slice_matches(worker_row, requirements: Requirements, profile) -> bool:
     return True
 
 
-async def mark_slice_busy(db: Database, instance_ids: List[str]) -> None:
+def mark_slice_busy_tx(conn, instance_ids: List[str]) -> None:
     q = ",".join("?" for _ in instance_ids)
-    await db.execute(
+    conn.execute(
         f"UPDATE instances SET status = 'busy', busy_blocks = 1, idle_since = NULL"
         f" WHERE id IN ({q})",
         instance_ids,
     )
+
+
+async def mark_slice_busy(db: Database, instance_ids: List[str]) -> None:
+    await db.run(lambda conn: mark_slice_busy_tx(conn, instance_ids))
 
 
 async def release_instance(db: Database, instance_id: str) -> None:
