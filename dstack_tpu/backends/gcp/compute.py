@@ -197,7 +197,9 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
                     price=offer.price,
                     username=self.vm_username,
                     ssh_port=22,
-                    dockerized=False,
+                    # Startup script boots the engine and starts the agent with
+                    # --docker auto: image-based jobs run in containers.
+                    dockerized=True,
                     backend_data=backend_data,
                     slice_id=instance_name,
                     slice_name=offer.slice_name,

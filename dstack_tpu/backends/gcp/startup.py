@@ -3,9 +3,10 @@
 Parity: reference ``_get_tpu_startup_script`` (gcp/compute.py:952-958) + shim install
 commands (base/compute.py:508-581): cloud-init installs the host agent as a systemd
 unit with ``PJRT_DEVICE=TPU``. TPU-native differences: the agent is the C++
-dstack-tpu-runner (no docker shim yet — TPU VMs run jobs directly on the host runtime
-image), and the script probes TPU devices (/dev/accel*, /dev/vfio) + libtpu so the
-control plane can verify accelerator health from the first heartbeat.
+dstack-tpu-runner acting as both runner and shim — it drives job containers through
+the docker engine socket (``--docker auto``: container when the job names an image,
+host exec otherwise), and the script probes TPU devices (/dev/accel*, /dev/vfio) +
+libtpu so the control plane can verify accelerator health from the first heartbeat.
 """
 
 from __future__ import annotations
@@ -21,6 +22,7 @@ def build_startup_script(
     runner_port: int = RUNNER_PORT,
     extra_env: Optional[dict] = None,
     login_user: str = "ubuntu",
+    docker_mode: str = "auto",
 ) -> str:
     """A bash cloud-init script: SSH keys -> runner install -> systemd unit -> start.
 
@@ -65,6 +67,13 @@ mkdir -p /var/lib/dstack-tpu
   echo "worker_id=$(curl -s -H 'Metadata-Flavor: Google' 'http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-number' 2>/dev/null)"
 }} > /var/lib/dstack-tpu/host-info
 
+# Container runtime for image-based jobs (TPU VM images usually ship docker;
+# install it when absent — the docker/tpu base image is the default job image).
+if ! command -v docker >/dev/null 2>&1; then
+  apt-get update -qq && apt-get install -y -qq docker.io || true
+fi
+systemctl enable --now docker 2>/dev/null || true
+
 # Install the runner agent.
 mkdir -p /usr/local/bin
 curl -fsSL -o /usr/local/bin/dstack-tpu-runner '{runner_url}'
@@ -73,10 +82,10 @@ chmod +x /usr/local/bin/dstack-tpu-runner
 cat > /etc/systemd/system/dstack-tpu-runner.service <<'DSTACK_UNIT'
 [Unit]
 Description=dstack-tpu runner agent
-After=network-online.target
+After=network-online.target docker.service
 [Service]
 {env_block}
-ExecStart=/usr/local/bin/dstack-tpu-runner --port {runner_port} --base-dir /var/lib/dstack-tpu
+ExecStart=/usr/local/bin/dstack-tpu-runner --port {runner_port} --base-dir /var/lib/dstack-tpu --docker {docker_mode}
 Restart=always
 RestartSec=2
 [Install]

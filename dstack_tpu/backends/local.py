@@ -82,6 +82,10 @@ class LocalCompute(Compute):
     ) -> List[JobProvisioningData]:
         loop = asyncio.get_running_loop()
 
+        from dstack_tpu.server import settings
+
+        docker_mode = settings.LOCAL_DOCKER_MODE
+
         def _spawn():
             # Off the event loop: find_runner_binary may compile the agent (slow) and
             # Popen/mkdtemp do blocking IO.
@@ -90,7 +94,13 @@ class LocalCompute(Compute):
                 raise ComputeError("dstack-tpu-runner binary not found and could not be built")
             base_dir = tempfile.mkdtemp(prefix=f"dstack-tpu-{instance_name}-")
             return base_dir, subprocess.Popen(
-                [binary, "--host", "127.0.0.1", "--port", "0", "--base-dir", base_dir],
+                [
+                    binary,
+                    "--host", "127.0.0.1",
+                    "--port", "0",
+                    "--base-dir", base_dir,
+                    "--docker", docker_mode,
+                ],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
@@ -111,7 +121,7 @@ class LocalCompute(Compute):
                 price=0.0,
                 username="root",
                 ssh_port=0,  # direct HTTP, no tunnel
-                dockerized=False,
+                dockerized=docker_mode != "never",
                 backend_data=json.dumps({"runner_port": port, "runner_pid": proc.pid, "base_dir": base_dir}),
                 slice_id=f"local-{instance_name}",
                 slice_name=offer.slice_name,

@@ -62,12 +62,14 @@ _AUTHORIZE_KEYS_CMD = (
 
 
 def _start_runner_cmd(port: int) -> str:
+    # --docker auto: image-based jobs go to the engine when one is installed on the
+    # fleet host; bare hosts keep the pty-exec path.
     unit = f"""[Unit]
 Description=dstack-tpu runner agent
 After=network-online.target
 [Service]
 Environment=PJRT_DEVICE=TPU
-ExecStart=/usr/local/bin/dstack-tpu-runner --port {port} --base-dir /var/lib/dstack-tpu
+ExecStart=/usr/local/bin/dstack-tpu-runner --port {port} --base-dir /var/lib/dstack-tpu --docker auto
 Restart=always
 RestartSec=2
 [Install]
@@ -81,7 +83,7 @@ WantedBy=multi-user.target
         " else"
         " pkill -f 'dstack-tpu-runner --port' 2>/dev/null;"
         f" nohup /usr/local/bin/dstack-tpu-runner --port {port}"
-        " --base-dir /var/lib/dstack-tpu >/var/lib/dstack-tpu/runner.log 2>&1 &"
+        " --base-dir /var/lib/dstack-tpu --docker auto >/var/lib/dstack-tpu/runner.log 2>&1 &"
         " fi"
     )
 

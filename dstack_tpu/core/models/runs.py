@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 from pydantic import Field
 
-from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.common import CoreModel, RegistryAuth
 from dstack_tpu.core.models.configurations import AnyRunConfiguration
 from dstack_tpu.core.models.instances import InstanceType, SSHConnectionParams
 from dstack_tpu.core.models.profiles import Profile, RetryPolicy, UtilizationPolicy
@@ -163,10 +163,15 @@ class JobSpec(CoreModel):
     replica_num: int = 0
     job_num: int = 0
     job_name: str
+    # Set by the server at submit time: the job row id, unique per submission.
+    # The agent labels containers with it so restart recovery never re-attaches to
+    # a previous (retried) submission's leftover container.
+    job_submission_id: Optional[str] = None
     jobs_per_replica: int = 1
     commands: List[str] = Field(default_factory=list)
     env: Dict[str, str] = Field(default_factory=dict)
     image_name: str
+    registry_auth: Optional[RegistryAuth] = None
     privileged: bool = False
     user: Optional[str] = None
     home_dir: Optional[str] = None

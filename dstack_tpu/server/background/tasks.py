@@ -395,6 +395,9 @@ async def _process_provisioning(db: Database, job_row) -> None:
     info = infos[spec.job_num]
 
     spec, secrets = await _resolve_job_secrets(db, job_row["project_id"], spec)
+    # Unique per submission: a retried gang gets fresh container labels, so the
+    # agent's restart recovery can't resurrect a previous attempt's container.
+    spec.job_submission_id = job_row["id"]
     await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
     code = await _get_code(db, job_row["project_id"], run_spec)
     if code:

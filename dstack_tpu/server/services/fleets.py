@@ -235,10 +235,3 @@ def get_or_create_auto_fleet_tx(conn, project_id: str, run_name: str) -> str:
         (fleet_id, project_id, run_name, spec.model_dump_json(), to_iso(now_utc())),
     )
     return fleet_id
-
-
-async def get_or_create_auto_fleet(db: Database, project_id: str, run_name: str) -> str:
-    """Run-scoped fleet for instances provisioned on demand (no fleet targeted)."""
-    return await db.run(
-        lambda conn: get_or_create_auto_fleet_tx(conn, project_id, run_name)
-    )

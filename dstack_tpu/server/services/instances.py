@@ -209,10 +209,6 @@ def mark_slice_busy_tx(conn, instance_ids: List[str]) -> None:
     )
 
 
-async def mark_slice_busy(db: Database, instance_ids: List[str]) -> None:
-    await db.run(lambda conn: mark_slice_busy_tx(conn, instance_ids))
-
-
 async def release_instance(db: Database, instance_id: str) -> None:
     await db.execute(
         "UPDATE instances SET busy_blocks = 0, idle_since = ?,"

@@ -89,6 +89,7 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
                 commands=commands,
                 env=_env(run_spec),
                 image_name=conf.image or DEFAULT_TPU_IMAGE,
+                registry_auth=conf.registry_auth,
                 privileged=conf.privileged,
                 home_dir=conf.home_dir,
                 working_dir=conf.working_dir,

@@ -142,8 +142,14 @@ def _run_error(run: Run) -> Optional[str]:
     return None
 
 
+def _configured_name(run_spec: RunSpec):
+    """`name:` inside the configuration names the run when run_name isn't set
+    explicitly (reference configurations/__init__.py BaseRunConfiguration.name)."""
+    return getattr(run_spec.configuration, "name", None)
+
+
 async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -> RunPlan:
-    effective_name = run_spec.run_name or generate_name()
+    effective_name = run_spec.run_name or _configured_name(run_spec) or generate_name()
     plan_spec = run_spec.model_copy(deep=True)
     plan_spec.run_name = effective_name
     job_specs = get_job_specs(plan_spec)
@@ -183,7 +189,7 @@ async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -
 async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> Run:
     if not run_spec.run_name:
         run_spec = run_spec.model_copy(deep=True)
-        run_spec.run_name = generate_name()
+        run_spec.run_name = _configured_name(run_spec) or generate_name()
     _validate_run_name(run_spec.run_name)
 
     existing = await db.fetchone(

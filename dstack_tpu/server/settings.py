@@ -51,6 +51,9 @@ RETRY_BACKOFF_MAX = float(os.getenv("DSTACK_TPU_RETRY_BACKOFF_MAX", "600"))
 TERMINATION_RETRY_WINDOW = float(os.getenv("DSTACK_TPU_TERMINATION_RETRY_WINDOW", "600"))
 
 LOCAL_BACKEND_ENABLED = _env_bool("DSTACK_TPU_LOCAL_BACKEND_ENABLED", True)
+# Container mode the local backend passes to its runner agents (--docker):
+# never = host exec (default, no engine dependency), auto/always = container path.
+LOCAL_DOCKER_MODE = os.getenv("DSTACK_TPU_LOCAL_DOCKER", "never")
 
 # SSH transport: cloud runner traffic rides ssh -L tunnels (reference tunnel.py).
 # Disabled -> direct HTTP (dev). Identity defaults to a server-generated ed25519 key.

@@ -1,0 +1,406 @@
+#include "docker.hpp"
+
+#include <dirent.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+
+namespace ddocker {
+
+DockerClient::DockerClient(std::string socket_path) : socket_path_(std::move(socket_path)) {}
+
+std::string DockerClient::default_socket() {
+  const char* host = getenv("DOCKER_HOST");
+  if (host && strncmp(host, "unix://", 7) == 0) return host + 7;
+  return "/var/run/docker.sock";
+}
+
+std::string url_escape(const std::string& s) {
+  std::string out;
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out += static_cast<char>(c);
+    } else {
+      char buf[4];
+      snprintf(buf, sizeof(buf), "%%%02X", c);
+      out += buf;
+    }
+  }
+  return out;
+}
+
+static const char kB64[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+static std::string b64encode(const std::string& in) {
+  std::string out;
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8) |
+                 static_cast<unsigned char>(in[i + 2]);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63];
+    out += kB64[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = static_cast<unsigned char>(in[i]) << 16;
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+std::string encode_registry_auth(const std::string& username, const std::string& password) {
+  if (username.empty() && password.empty()) return "";
+  dj::Json auth = dj::Json::object();
+  auth.set("username", username);
+  auth.set("password", password);
+  return b64encode(auth.dump());
+}
+
+std::vector<std::string> host_tpu_devices() {
+  std::vector<std::string> devices;
+  DIR* dev = opendir("/dev");
+  if (dev) {
+    while (dirent* e = readdir(dev)) {
+      if (strncmp(e->d_name, "accel", 5) == 0) {
+        devices.push_back(std::string("/dev/") + e->d_name);
+      }
+    }
+    closedir(dev);
+  }
+  DIR* vfio = opendir("/dev/vfio");
+  if (vfio) {
+    while (dirent* e = readdir(vfio)) {
+      if (e->d_name[0] == '.') continue;
+      devices.push_back(std::string("/dev/vfio/") + e->d_name);
+    }
+    closedir(vfio);
+  }
+  return devices;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP/1.1 over AF_UNIX
+
+namespace {
+
+// Reads exactly up to n bytes with a poll-based deadline; returns bytes read
+// (0 on orderly EOF), -1 on error/timeout.
+ssize_t read_some(int fd, char* buf, size_t n, int timeout_sec) {
+  pollfd pfd{fd, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_sec * 1000);
+  if (pr <= 0) return -1;
+  return read(fd, buf, n);
+}
+
+struct Conn {
+  int fd = -1;
+  std::string buffered;  // bytes read past what the caller consumed
+
+  ~Conn() {
+    if (fd >= 0) close(fd);
+  }
+
+  // Reads until `delim` appears; returns content before delim, consumes it.
+  bool read_until(const std::string& delim, std::string* out, int timeout_sec) {
+    size_t pos;
+    while ((pos = buffered.find(delim)) == std::string::npos) {
+      char buf[8192];
+      ssize_t n = read_some(fd, buf, sizeof(buf), timeout_sec);
+      if (n <= 0) return false;
+      buffered.append(buf, static_cast<size_t>(n));
+    }
+    *out = buffered.substr(0, pos);
+    buffered.erase(0, pos + delim.size());
+    return true;
+  }
+
+  // Reads exactly n bytes (from buffer + socket) into sink/out.
+  bool read_n(size_t n, std::string* out, const StreamSink* sink, int timeout_sec) {
+    while (n > 0) {
+      if (!buffered.empty()) {
+        size_t take = std::min(n, buffered.size());
+        if (sink) (*sink)(buffered.data(), take);
+        if (out) out->append(buffered, 0, take);
+        buffered.erase(0, take);
+        n -= take;
+        continue;
+      }
+      char buf[8192];
+      ssize_t r = read_some(fd, buf, std::min(n, sizeof(buf)), timeout_sec);
+      if (r <= 0) return false;
+      if (sink) (*sink)(buf, static_cast<size_t>(r));
+      if (out) out->append(buf, static_cast<size_t>(r));
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  // Reads to EOF.
+  void read_all(std::string* out, const StreamSink* sink, int timeout_sec) {
+    if (!buffered.empty()) {
+      if (sink) (*sink)(buffered.data(), buffered.size());
+      if (out) out->append(buffered);
+      buffered.clear();
+    }
+    char buf[8192];
+    ssize_t n;
+    while ((n = read_some(fd, buf, sizeof(buf), timeout_sec)) > 0) {
+      if (sink) (*sink)(buf, static_cast<size_t>(n));
+      if (out) out->append(buf, static_cast<size_t>(n));
+    }
+  }
+};
+
+std::string lower(std::string s) {
+  for (auto& c : s) c = static_cast<char>(tolower(c));
+  return s;
+}
+
+}  // namespace
+
+HttpResult DockerClient::request(const std::string& method, const std::string& path,
+                                 const std::string& body,
+                                 const std::vector<std::string>& extra_headers,
+                                 const StreamSink* sink, int timeout_sec) {
+  Conn conn;
+  conn.fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (conn.fd < 0) throw DockerError("socket() failed");
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (socket_path_.size() >= sizeof(addr.sun_path)) throw DockerError("socket path too long");
+  strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(conn.fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    throw DockerError("cannot connect to docker daemon at " + socket_path_ + ": " +
+                      strerror(errno));
+  }
+
+  std::ostringstream req;
+  req << method << " " << path << " HTTP/1.1\r\n"
+      << "Host: docker\r\nConnection: close\r\n";
+  for (const auto& h : extra_headers) req << h << "\r\n";
+  if (!body.empty() || method == "POST" || method == "DELETE") {
+    req << "Content-Type: application/json\r\nContent-Length: " << body.size() << "\r\n";
+  }
+  req << "\r\n" << body;
+  std::string payload = req.str();
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t n = write(conn.fd, payload.data() + off, payload.size() - off);
+    if (n <= 0) throw DockerError("write to docker daemon failed");
+    off += static_cast<size_t>(n);
+  }
+
+  std::string status_line;
+  if (!conn.read_until("\r\n", &status_line, timeout_sec)) {
+    throw DockerError("no response from docker daemon");
+  }
+  int status = 0;
+  {
+    auto sp = status_line.find(' ');
+    if (sp != std::string::npos) status = atoi(status_line.c_str() + sp + 1);
+  }
+  std::string header_block;
+  if (!conn.read_until("\r\n\r\n", &header_block, timeout_sec)) {
+    throw DockerError("truncated response headers from docker daemon");
+  }
+  bool chunked = false;
+  long content_length = -1;
+  std::istringstream hs(header_block);
+  std::string line;
+  while (std::getline(hs, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = lower(line.substr(0, colon));
+    std::string val = line.substr(colon + 1);
+    while (!val.empty() && val.front() == ' ') val.erase(0, 1);
+    if (key == "transfer-encoding" && lower(val).find("chunked") != std::string::npos) {
+      chunked = true;
+    } else if (key == "content-length") {
+      content_length = atol(val.c_str());
+    }
+  }
+
+  HttpResult out;
+  out.status = status;
+  // Error statuses carry a small JSON body we want intact, not streamed.
+  const StreamSink* body_sink = (status >= 300) ? nullptr : sink;
+  std::string* capture = (body_sink != nullptr) ? nullptr : &out.body;
+  if (chunked) {
+    while (true) {
+      std::string size_line;
+      if (!conn.read_until("\r\n", &size_line, timeout_sec)) break;
+      long chunk = strtol(size_line.c_str(), nullptr, 16);
+      if (chunk <= 0) break;
+      if (!conn.read_n(static_cast<size_t>(chunk), capture, body_sink, timeout_sec)) break;
+      std::string crlf;
+      conn.read_until("\r\n", &crlf, timeout_sec);
+    }
+  } else if (content_length >= 0) {
+    conn.read_n(static_cast<size_t>(content_length), capture, body_sink, timeout_sec);
+  } else if (status != 204) {
+    conn.read_all(capture, body_sink, timeout_sec);
+  }
+  return out;
+}
+
+static std::string api_error(const HttpResult& r, const std::string& what) {
+  std::string msg = what + " failed (HTTP " + std::to_string(r.status) + ")";
+  try {
+    dj::Json err = dj::Json::parse(r.body);
+    if (err["message"].is_string()) msg += ": " + err["message"].as_string();
+  } catch (...) {
+    if (!r.body.empty() && r.body.size() < 300) msg += ": " + r.body;
+  }
+  return msg;
+}
+
+bool DockerClient::ping() {
+  try {
+    return request("GET", "/_ping", "", {}, nullptr, 5).status == 200;
+  } catch (const DockerError&) {
+    return false;
+  }
+}
+
+bool DockerClient::image_exists(const std::string& image) {
+  HttpResult r = request("GET", "/images/" + url_escape(image) + "/json", "", {}, nullptr, 30);
+  return r.status == 200;
+}
+
+void DockerClient::pull_image(const std::string& image, const std::string& registry_auth_b64,
+                              const std::function<void(const std::string&)>& progress,
+                              const std::function<bool()>& abort_check) {
+  // Digest-pinned refs (repo@sha256:...) go out whole; tagged refs split on the
+  // last colon after the last slash.
+  std::string query;
+  if (image.find('@') != std::string::npos) {
+    query = "/images/create?fromImage=" + url_escape(image);
+  } else {
+    std::string name = image, tag = "latest";
+    auto colon = image.rfind(':');
+    auto slash = image.rfind('/');
+    if (colon != std::string::npos && (slash == std::string::npos || colon > slash)) {
+      name = image.substr(0, colon);
+      tag = image.substr(colon + 1);
+    }
+    query = "/images/create?fromImage=" + url_escape(name) + "&tag=" + url_escape(tag);
+  }
+  std::vector<std::string> headers;
+  if (!registry_auth_b64.empty()) headers.push_back("X-Registry-Auth: " + registry_auth_b64);
+
+  // The engine streams NDJSON progress rows; surface statuses + collect errors
+  // (reference parses the same rows, docker.go:700-733).
+  std::string partial;
+  std::string pull_error;
+  StreamSink sink = [&](const char* data, size_t n) {
+    if (abort_check && abort_check()) throw DockerError("image pull aborted by stop request");
+    partial.append(data, n);
+    size_t nl;
+    while ((nl = partial.find('\n')) != std::string::npos) {
+      std::string line = partial.substr(0, nl);
+      partial.erase(0, nl + 1);
+      if (line.empty()) continue;
+      try {
+        dj::Json row = dj::Json::parse(line);
+        if (row["error"].is_string()) {
+          pull_error = row["error"].as_string();
+        } else if (row["status"].is_string()) {
+          const std::string& st = row["status"].as_string();
+          // Only the coarse phases, not per-layer byte counts.
+          if (st.rfind("Status:", 0) == 0 || st.rfind("Pulling from", 0) == 0) {
+            if (progress) progress(st);
+          }
+        }
+      } catch (...) {
+      }
+    }
+  };
+  HttpResult r = request("POST", query, "", headers, &sink, 1800);
+  if (!pull_error.empty()) throw DockerError("pulling " + image + ": " + pull_error);
+  if (r.status != 200) throw DockerError(api_error(r, "pulling " + image));
+}
+
+std::string DockerClient::create_container(const dj::Json& config, const std::string& name) {
+  HttpResult r = request("POST", "/containers/create?name=" + url_escape(name), config.dump());
+  if (r.status != 201) throw DockerError(api_error(r, "creating container " + name));
+  return dj::Json::parse(r.body)["Id"].as_string();
+}
+
+void DockerClient::start_container(const std::string& id) {
+  HttpResult r = request("POST", "/containers/" + id + "/start", "");
+  // 304 = already started (restart recovery re-attach).
+  if (r.status != 204 && r.status != 304) throw DockerError(api_error(r, "starting container"));
+}
+
+int DockerClient::wait_container(const std::string& id) {
+  // No practical deadline: jobs run for hours. 7 days as an absurd upper bound.
+  HttpResult r = request("POST", "/containers/" + id + "/wait", "", {}, nullptr, 7 * 24 * 3600);
+  if (r.status != 200) throw DockerError(api_error(r, "waiting for container"));
+  return static_cast<int>(dj::Json::parse(r.body)["StatusCode"].as_int());
+}
+
+void DockerClient::kill_container(const std::string& id, const std::string& sig) {
+  HttpResult r = request("POST", "/containers/" + id + "/kill?signal=" + url_escape(sig), "");
+  // 409 = not running; both fine for a stop path.
+  if (r.status != 204 && r.status != 404 && r.status != 409) {
+    throw DockerError(api_error(r, "killing container"));
+  }
+}
+
+void DockerClient::remove_container(const std::string& id, bool force) {
+  HttpResult r =
+      request("DELETE", "/containers/" + id + (force ? "?force=1" : ""), "");
+  if (r.status != 204 && r.status != 404) throw DockerError(api_error(r, "removing container"));
+}
+
+void DockerClient::stream_logs(const std::string& id, bool follow, const StreamSink& sink) {
+  std::string path = "/containers/" + id + "/logs?stdout=1&stderr=1";
+  if (follow) path += "&follow=1";
+  HttpResult r = request("GET", path, "", {}, &sink, 7 * 24 * 3600);
+  if (r.status != 200) throw DockerError(api_error(r, "streaming logs"));
+}
+
+dj::Json DockerClient::list_containers(const std::string& label) {
+  dj::Json filters = dj::Json::object();
+  dj::Json labels = dj::Json::array();
+  labels.push_back(label);
+  filters.set("label", std::move(labels));
+  HttpResult r = request(
+      "GET", "/containers/json?all=1&filters=" + url_escape(filters.dump()), "");
+  if (r.status != 200) throw DockerError(api_error(r, "listing containers"));
+  return dj::Json::parse(r.body);
+}
+
+dj::Json DockerClient::inspect_container(const std::string& id) {
+  HttpResult r = request("GET", "/containers/" + id + "/json", "");
+  if (r.status != 200) throw DockerError(api_error(r, "inspecting container"));
+  return dj::Json::parse(r.body);
+}
+
+dj::Json DockerClient::container_stats(const std::string& id) {
+  HttpResult r = request("GET", "/containers/" + id + "/stats?stream=false", "", {}, nullptr, 30);
+  if (r.status != 200) throw DockerError(api_error(r, "reading container stats"));
+  return dj::Json::parse(r.body);
+}
+
+}  // namespace ddocker

@@ -1,0 +1,96 @@
+// Docker Engine API client over the local unix socket (no external deps).
+//
+// Parity: the reference shim drives containers through the Docker Go SDK
+// (runner/internal/shim/docker.go:63-875 — pull with registry auth, create with
+// device mapping, start/wait, label-based state restore). Here the same engine
+// REST API is spoken directly over /var/run/docker.sock with a small HTTP/1.1
+// client: the runner is the host agent, so the container lifecycle lives next to
+// the executor instead of in a separate shim process.
+#pragma once
+
+#include <functional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+
+namespace ddocker {
+
+struct DockerError : std::runtime_error {
+  explicit DockerError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+struct HttpResult {
+  int status = 0;
+  std::string body;
+};
+
+// Streaming sink for chunk-decoded response bodies (image pull progress, logs).
+using StreamSink = std::function<void(const char*, size_t)>;
+
+class DockerClient {
+ public:
+  // socket_path: AF_UNIX path of the engine API. default_socket() honors
+  // DOCKER_HOST=unix:///... and falls back to /var/run/docker.sock.
+  explicit DockerClient(std::string socket_path);
+  static std::string default_socket();
+
+  // GET /_ping — true when an engine is reachable on the socket.
+  bool ping();
+
+  bool image_exists(const std::string& image);
+
+  // POST /images/create — streams progress JSON lines; `registry_auth_b64` (may be
+  // empty) goes out as X-Registry-Auth (docker.go:877-893 encodeRegistryAuth).
+  // `progress` receives human-readable status lines. `abort_check` (optional) is
+  // polled per received chunk; returning true aborts the transfer mid-stream.
+  // Throws on engine errors, error lines in the progress stream, or abort.
+  void pull_image(const std::string& image, const std::string& registry_auth_b64,
+                  const std::function<void(const std::string&)>& progress,
+                  const std::function<bool()>& abort_check = nullptr);
+
+  // POST /containers/create?name=... — returns the container id.
+  std::string create_container(const dj::Json& config, const std::string& name);
+
+  void start_container(const std::string& id);
+
+  // POST /containers/{id}/wait — blocks until exit, returns StatusCode.
+  int wait_container(const std::string& id);
+
+  void kill_container(const std::string& id, const std::string& sig);
+  void remove_container(const std::string& id, bool force = true);
+
+  // GET /containers/{id}/logs — raw byte stream for Tty containers. With
+  // follow=true the call blocks until the container stops.
+  void stream_logs(const std::string& id, bool follow, const StreamSink& sink);
+
+  // GET /containers/json?all=1 filtered by label ("key=value").
+  dj::Json list_containers(const std::string& label);
+
+  dj::Json inspect_container(const std::string& id);
+
+  // GET /containers/{id}/stats?stream=false — one-shot resource usage sample.
+  dj::Json container_stats(const std::string& id);
+
+ private:
+  HttpResult request(const std::string& method, const std::string& path,
+                     const std::string& body,
+                     const std::vector<std::string>& extra_headers = {},
+                     const StreamSink* sink = nullptr, int timeout_sec = 600);
+
+  std::string socket_path_;
+};
+
+// Percent-encode one path segment (image names contain '/' and ':').
+std::string url_escape(const std::string& s);
+
+// base64 of {"username":...,"password":...} for X-Registry-Auth.
+std::string encode_registry_auth(const std::string& username, const std::string& password);
+
+// Host TPU device files to map into containers: /dev/accel* plus /dev/vfio/*
+// (the PCI-attached v5e/v6e path), mirroring the reference's GPU device wiring
+// (shim/docker.go:1008-1019, shim/host/gpu.go:44-58) for TPU hardware.
+std::vector<std::string> host_tpu_devices();
+
+}  // namespace ddocker

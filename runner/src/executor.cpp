@@ -1,5 +1,7 @@
 #include "executor.hpp"
 
+#include "docker.hpp"
+
 #include <cerrno>
 #include <fcntl.h>
 #include <poll.h>
@@ -31,7 +33,11 @@ static std::string iso_now() {
   return buf;
 }
 
-Executor::Executor(std::string base_dir) : base_dir_(std::move(base_dir)) {
+Executor::Executor(std::string base_dir, std::string docker_mode, std::string docker_socket)
+    : base_dir_(std::move(base_dir)),
+      docker_mode_(std::move(docker_mode)),
+      docker_socket_(docker_socket.empty() ? ddocker::DockerClient::default_socket()
+                                           : std::move(docker_socket)) {
   mkdir(base_dir_.c_str(), 0755);
 }
 
@@ -156,8 +162,19 @@ dj::Json Executor::pull(int64_t offset) {
 dj::Json Executor::stop(bool abort) {
   stop_requested_ = true;
   abort_requested_ = abort;
+  std::string cid;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    cid = container_id_;
+  }
   pid_t pid = child_pid_.load();
-  if (pid > 0) {
+  if (!cid.empty()) {
+    try {
+      ddocker::DockerClient(docker_socket_).kill_container(cid, abort ? "SIGKILL" : "SIGTERM");
+    } catch (const ddocker::DockerError&) {
+      // exec_container's wait/stream path will surface the outcome either way.
+    }
+  } else if (pid > 0) {
     kill(-pid, abort ? SIGKILL : SIGTERM);
   } else {
     std::lock_guard<std::mutex> lk(mu_);
@@ -172,7 +189,21 @@ dj::Json Executor::metrics() const {
   pid_t pid = child_pid_.load();
   dj::Json out = dj::Json::object();
   int64_t cpu_micro = 0, rss_bytes = 0;
-  if (pid > 0) {
+  std::string cid;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    cid = container_id_;
+  }
+  if (!cid.empty()) {
+    // Container job: sample the engine's stats endpoint (ref relays DCGM/cAdvisor
+    // equivalents; CPU total is reported in ns).
+    try {
+      dj::Json st = ddocker::DockerClient(docker_socket_).container_stats(cid);
+      cpu_micro = st["cpu_stats"]["cpu_usage"]["total_usage"].as_int() / 1000;
+      rss_bytes = st["memory_stats"]["usage"].as_int();
+    } catch (const ddocker::DockerError&) {
+    }
+  } else if (pid > 0) {
     // utime+stime from /proc/<pid>/stat (fields 14,15, in clock ticks).
     std::ifstream stat("/proc/" + std::to_string(pid) + "/stat");
     std::string line;
@@ -271,15 +302,7 @@ static std::vector<std::string> cluster_env(const dj::Json& ci) {
   return env;
 }
 
-void Executor::exec_thread() {
-  uint64_t generation = job_generation_.load();
-  if (stop_requested_) {  // stopped before we ever started
-    add_state(abort_requested_ ? "aborted" : "terminated", -1, "stopped before start");
-    return;
-  }
-  add_state("running");
-  std::string repo_dir = extract_code();
-
+std::string Executor::build_script() const {
   // Join commands into one shell script (reference joins with && semantics via sh -c;
   // we use strict mode so any failing command fails the job).
   std::string script = "set -e\n";
@@ -287,6 +310,227 @@ void Executor::exec_thread() {
     script += cmd.as_string();
     script += "\n";
   }
+  return script;
+}
+
+std::vector<std::string> Executor::job_env(const std::string& repo_dir) const {
+  std::vector<std::string> env_strings;
+  for (const auto& kv : job_spec_["env"].as_object()) {
+    env_strings.push_back(kv.first + "=" + kv.second.as_string());
+  }
+  for (const auto& kv : secrets_.as_object()) {
+    env_strings.push_back(kv.first + "=" + kv.second.as_string());
+  }
+  for (auto& kv : cluster_env(cluster_info_)) env_strings.push_back(kv);
+  env_strings.push_back("DSTACK_REPO_DIR=" + repo_dir);
+  return env_strings;
+}
+
+void Executor::finish(int code, const std::string& how) {
+  if (stop_requested_) {
+    add_state(abort_requested_ ? "aborted" : "terminated", code, "stopped by request");
+  } else if (code == 0) {
+    add_state("done", 0);
+  } else {
+    add_state("failed", code, how);
+  }
+}
+
+void Executor::exec_thread() {
+  uint64_t generation = job_generation_.load();
+  if (stop_requested_) {  // stopped before we ever started
+    add_state(abort_requested_ ? "aborted" : "terminated", -1, "stopped before start");
+    return;
+  }
+  bool container = false;
+  if (docker_mode_ == "always") {
+    container = true;
+  } else if (docker_mode_ == "auto" && !job_spec_["image_name"].as_string().empty()) {
+    container = ddocker::DockerClient(docker_socket_).ping();
+    if (!container) add_log("docker engine unreachable; running the job on the host\n");
+  }
+  if (container) {
+    exec_container(generation);
+  } else {
+    exec_host(generation);
+  }
+}
+
+void Executor::exec_container(uint64_t generation) {
+  ddocker::DockerClient dc(docker_socket_);
+  const std::string image = job_spec_["image_name"].as_string();
+  const std::string job_name = job_spec_["job_name"].as_string();
+  // The label value is the server's submission id when present (unique per retry,
+  // so recovery can't resurrect a previous attempt's container); the container
+  // NAME stays per-job so a retry's create replaces the old attempt via the 409
+  // path below.
+  std::string job_key = job_spec_["job_submission_id"].as_string();
+  if (job_key.empty()) job_key = job_name;
+  const std::string cname = "dstack-tpu-" + job_name;
+  std::string cid;
+  try {
+    // Restart recovery: a previous agent life may have left this job's container
+    // behind (running or exited); re-attach instead of double-running it. Queried
+    // by label at exec time, not cached at startup — the engine may come up after
+    // the agent (ref shim/docker.go:104 restoreStateFromContainers).
+    bool recovered = false;
+    dj::Json leftovers = dc.list_containers("dstack-tpu.job=" + job_key);
+    if (!leftovers.as_array().empty()) {
+      cid = leftovers.as_array()[0]["Id"].as_string();
+      recovered = true;
+    }
+    if (recovered) {
+      add_log("re-attaching to container " + cid.substr(0, 12) + " after agent restart\n");
+    } else {
+      if (!dc.image_exists(image)) {
+        add_state("pulling", 0, image);
+        std::string auth = ddocker::encode_registry_auth(
+            job_spec_["registry_auth"]["username"].as_string(),
+            job_spec_["registry_auth"]["password"].as_string());
+        dc.pull_image(
+            image, auth, [this](const std::string& s) { add_log(s + "\n"); },
+            [this] { return stop_requested_.load(); });
+      }
+      if (stop_requested_) {
+        add_state(abort_requested_ ? "aborted" : "terminated", -1, "stopped by request");
+        return;
+      }
+
+      std::string repo_dir = extract_code();
+      dj::Json cfg = dj::Json::object();
+      cfg.set("Image", image);
+      dj::Json entry = dj::Json::array();
+      entry.push_back("/bin/sh");
+      entry.push_back("-c");
+      cfg.set("Entrypoint", std::move(entry));
+      dj::Json cmd = dj::Json::array();
+      cmd.push_back(build_script());
+      cfg.set("Cmd", std::move(cmd));
+      dj::Json env = dj::Json::array();
+      for (auto& kv : job_env("/workflow")) env.push_back(kv);
+      env.push_back("PJRT_DEVICE=TPU");
+      cfg.set("Env", std::move(env));
+      std::string workdir = "/workflow";
+      if (!job_spec_["working_dir"].as_string().empty()) {
+        workdir = job_spec_["working_dir"].as_string();
+        if (workdir[0] != '/') workdir = "/workflow/" + workdir;
+      }
+      cfg.set("WorkingDir", workdir);
+      // Raw (unframed) log stream, exactly like the host pty path.
+      cfg.set("Tty", true);
+      dj::Json labels = dj::Json::object();
+      labels.set("dstack-tpu.task", "true");
+      labels.set("dstack-tpu.job", job_key);
+      cfg.set("Labels", std::move(labels));
+      if (!job_spec_["user"].as_string().empty()) cfg.set("User", job_spec_["user"].as_string());
+
+      dj::Json host = dj::Json::object();
+      // Host networking: the JAX coordinator / MegaScale ports and ICI transport
+      // assume host identity on TPU pods (ref uses host network mode for clusters).
+      host.set("NetworkMode", "host");
+      host.set("Privileged", job_spec_["privileged"].as_bool());
+      dj::Json binds = dj::Json::array();
+      binds.push_back(repo_dir + ":/workflow");
+      host.set("Binds", std::move(binds));
+      // TPU chips reach the container as device files, the TPU analog of the
+      // reference's GPU device requests (shim/docker.go:1008-1102).
+      dj::Json devices = dj::Json::array();
+      for (const auto& dev : ddocker::host_tpu_devices()) {
+        dj::Json d = dj::Json::object();
+        d.set("PathOnHost", dev);
+        d.set("PathInContainer", dev);
+        d.set("CgroupPermissions", "rwm");
+        devices.push_back(std::move(d));
+      }
+      host.set("Devices", std::move(devices));
+      host.set("ShmSize", static_cast<int64_t>(1) << 30);
+      cfg.set("HostConfig", std::move(host));
+
+      try {
+        cid = dc.create_container(cfg, cname);
+      } catch (const ddocker::DockerError& e) {
+        if (std::string(e.what()).find("HTTP 409") == std::string::npos) throw;
+        // Stale same-name container from a crashed run that predates the label
+        // scan: replace it.
+        dc.remove_container(cname, true);
+        cid = dc.create_container(cfg, cname);
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      container_id_ = cid;
+    }
+    add_state("running");
+    bool already_exited = false;
+    int recovered_code = 0;
+    if (recovered) {
+      // NEVER start a recovered container: starting an exited one would re-run
+      // the job. Running -> attach; exited -> collect logs + exit code.
+      dj::Json info = dc.inspect_container(cid);
+      already_exited = !info["State"]["Running"].as_bool();
+      recovered_code = static_cast<int>(info["State"]["ExitCode"].as_int());
+    } else {
+      dc.start_container(cid);
+    }
+    // Close the stop() race: a stop that arrived before container_id_ was set
+    // found nothing to signal — honor it now (wait_container sees the exit).
+    if (stop_requested_ && !already_exited) {
+      dc.kill_container(cid, abort_requested_ ? "SIGKILL" : "SIGTERM");
+    }
+
+    // Stream logs line-buffered; with follow the call returns when the container
+    // stops, then wait() yields the exit code.
+    std::string partial;
+    dc.stream_logs(cid, !already_exited, [&](const char* data, size_t n) {
+      partial.append(data, n);
+      size_t nl;
+      while ((nl = partial.find('\n')) != std::string::npos) {
+        add_log(partial.substr(0, nl + 1));
+        partial.erase(0, nl + 1);
+      }
+    });
+    if (!partial.empty()) add_log(partial);
+    int code = already_exited ? recovered_code : dc.wait_container(cid);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      container_id_.clear();
+    }
+    try {
+      dc.remove_container(cid, true);
+    } catch (const ddocker::DockerError&) {
+    }
+    if (job_generation_.load() != generation) return;  // superseded
+    finish(code, "exit status " + std::to_string(code));
+  } catch (const std::exception& e) {
+    // std::exception, not just DockerError: a malformed engine response makes
+    // Json::parse throw runtime_error, and an escape here would std::terminate
+    // the whole agent.
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      container_id_.clear();
+    }
+    if (!cid.empty()) {
+      // Don't leak a running workload holding the TPU devices: the job is being
+      // marked failed and the slice will return to the pool.
+      try {
+        ddocker::DockerClient(docker_socket_).remove_container(cid, true);
+      } catch (const std::exception&) {
+      }
+    }
+    if (job_generation_.load() != generation) return;
+    if (stop_requested_) {
+      add_state(abort_requested_ ? "aborted" : "terminated", -1, "stopped by request");
+    } else {
+      add_state("failed", -1, e.what());
+    }
+  }
+}
+
+void Executor::exec_host(uint64_t generation) {
+  add_state("running");
+  std::string repo_dir = extract_code();
+
+  std::string script = build_script();
 
   std::string workdir = repo_dir;
   if (!job_spec_["working_dir"].is_null() && !job_spec_["working_dir"].as_string().empty()) {
@@ -296,14 +540,7 @@ void Executor::exec_thread() {
 
   std::vector<std::string> env_strings;
   for (char** e = environ; *e; ++e) env_strings.push_back(*e);
-  for (const auto& kv : job_spec_["env"].as_object()) {
-    env_strings.push_back(kv.first + "=" + kv.second.as_string());
-  }
-  for (const auto& kv : secrets_.as_object()) {
-    env_strings.push_back(kv.first + "=" + kv.second.as_string());
-  }
-  for (auto& kv : cluster_env(cluster_info_)) env_strings.push_back(kv);
-  env_strings.push_back("DSTACK_REPO_DIR=" + repo_dir);
+  for (auto& kv : job_env(repo_dir)) env_strings.push_back(kv);
 
   // Manual openpty+fork instead of forkpty: glibc's forkpty child _exit(1)s when
   // TIOCSCTTY fails, which happens when the kernel recycles a pty index that is still
@@ -374,15 +611,8 @@ void Executor::exec_thread() {
       close(master_fd);
       child_pid_ = 0;
       if (job_generation_.load() != generation) return;  // superseded
-      if (stop_requested_) {
-        add_state(abort_requested_ ? "aborted" : "terminated",
-                  WIFEXITED(status) ? WEXITSTATUS(status) : -1, "stopped by request");
-      } else if (WIFEXITED(status) && WEXITSTATUS(status) == 0) {
-        add_state("done", 0);
-      } else {
-        int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
-        add_state("failed", code, "exit status " + std::to_string(code));
-      }
+      int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+      finish(code, "exit status " + std::to_string(code));
       return;
     }
   }
@@ -392,15 +622,9 @@ void Executor::exec_thread() {
   if (!partial.empty()) add_log(partial);
   close(master_fd);
   child_pid_ = 0;
-  if (stop_requested_) {
-    add_state(abort_requested_ ? "aborted" : "terminated",
-              WIFEXITED(status) ? WEXITSTATUS(status) : -1, "stopped by request");
-  } else if (WIFEXITED(status) && WEXITSTATUS(status) == 0) {
-    add_state("done", 0);
-  } else {
-    int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
-    add_state("failed", code, "exit status " + std::to_string(code));
-  }
+  if (job_generation_.load() != generation) return;  // superseded
+  int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+  finish(code, "exit status " + std::to_string(code));
 }
 
 }  // namespace drunner

@@ -1,10 +1,14 @@
-// Job executor: spawn the job's shell in a pty, collect logs + state events.
+// Job executor: run the job in a pty (host mode) or a docker container
+// (container mode), collect logs + state events.
 //
 // Parity: reference runner/internal/executor/executor.go (execJob:254-418,
-// startCommand:614 — pty fork, env contract injection executor.go:262-274). TPU
-// re-design: instead of writing an MPI hostfile + SSH mesh, the executor injects the
-// JAX coordinator / TPU worker identity / MegaScale env from the cluster_info the
-// control plane submits (SURVEY §2.6).
+// startCommand:614 — pty fork, env contract injection executor.go:262-274) plus the
+// shim's container lifecycle (shim/docker.go:240-875 — pull with registry auth,
+// create with device mapping, start/wait, label-based restart recovery;
+// shim/task.go:31-145). TPU re-design: one agent owns both roles, the JAX
+// coordinator / TPU worker identity / MegaScale env comes from the cluster_info the
+// control plane submits (SURVEY §2.6), and TPU chips reach containers as
+// /dev/accel* + /dev/vfio/* device mappings with PJRT_DEVICE=TPU.
 #pragma once
 
 #include <atomic>
@@ -13,6 +17,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "json.hpp"
 
@@ -29,7 +34,11 @@ struct Event {
 
 class Executor {
  public:
-  explicit Executor(std::string base_dir);
+  // docker_mode: "never" (host pty exec only), "auto" (container when the job has
+  // an image and an engine answers on the socket), "always" (container or fail).
+  // docker_socket empty = DockerClient::default_socket().
+  explicit Executor(std::string base_dir, std::string docker_mode = "never",
+                    std::string docker_socket = "");
   ~Executor();
 
   // HTTP API surface (all JSON in/out, thread-safe).
@@ -43,12 +52,19 @@ class Executor {
 
  private:
   void exec_thread();
+  void exec_host(uint64_t generation);
+  void exec_container(uint64_t generation);
+  void finish(int code, const std::string& how);
   void add_state(const std::string& state, int exit_status = 0, const std::string& msg = "");
   void add_log(const std::string& line);
   void trim_events_locked();
   std::string extract_code();
+  std::string build_script() const;
+  std::vector<std::string> job_env(const std::string& repo_dir) const;
 
   std::string base_dir_;
+  std::string docker_mode_;
+  std::string docker_socket_;
   dj::Json job_spec_;
   dj::Json cluster_info_;
   dj::Json secrets_;
@@ -60,6 +76,8 @@ class Executor {
   std::deque<Event> events_;
   int64_t next_seq_ = 1;
   std::string current_state_ = "idle";
+
+  std::string container_id_;  // guarded by mu_; non-empty while a container runs
 
   std::thread worker_;
   std::atomic<pid_t> child_pid_{0};

@@ -18,20 +18,33 @@ int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
   int port = 10999;
   std::string base_dir = "/tmp/dstack-tpu-runner";
+  // Container execution (the reference shim's role, shim/docker.go): never = host
+  // pty exec only; auto = container when the job names an image and an engine
+  // answers; always = container or fail the job.
+  std::string docker_mode = "never";
+  std::string docker_host;  // unix socket path; empty = DOCKER_HOST or the default
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
     if (a == "--host") host = next();
     else if (a == "--port") port = atoi(next().c_str());
     else if (a == "--base-dir") base_dir = next();
+    else if (a == "--docker") docker_mode = next();
+    else if (a == "--docker-host") docker_host = next();
     else if (a == "--help") {
-      printf("usage: dstack-tpu-runner [--host H] [--port P] [--base-dir DIR]\n");
+      printf(
+          "usage: dstack-tpu-runner [--host H] [--port P] [--base-dir DIR]\n"
+          "                         [--docker never|auto|always] [--docker-host SOCK]\n");
       return 0;
     }
   }
+  if (docker_mode != "never" && docker_mode != "auto" && docker_mode != "always") {
+    fprintf(stderr, "invalid --docker mode: %s\n", docker_mode.c_str());
+    return 2;
+  }
   signal(SIGPIPE, SIG_IGN);
 
-  drunner::Executor executor(base_dir);
+  drunner::Executor executor(base_dir, docker_mode, docker_host);
   dhttp::Server server(host, port);
 
   server.handle("GET", "/api/healthcheck", [&](const dhttp::Request&) {

@@ -1,0 +1,299 @@
+"""A fake Docker Engine API daemon on a unix socket.
+
+Speaks enough of the engine REST API for the runner's container path (ping, image
+inspect + pull with X-Registry-Auth capture, container create / start / logs / wait /
+kill / delete / list / stats). Containers actually execute their Entrypoint+Cmd via
+subprocess, so the log stream and exit codes flowing back through the C++ agent are
+real — the same fidelity bar as fake_ssh.py (which really forwards TCP).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import signal
+import uuid
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+
+class FakeContainer:
+    def __init__(self, cid: str, name: str, config: dict) -> None:
+        self.id = cid
+        self.name = name
+        self.config = config
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.exit_code: Optional[int] = None
+        self.log_buf = bytearray()
+        self.exited = asyncio.Event()
+
+    @property
+    def labels(self) -> dict:
+        return self.config.get("Labels") or {}
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.exit_code is None
+
+
+class FakeDockerDaemon:
+    def __init__(self, socket_path: str, images: Optional[List[str]] = None) -> None:
+        self.socket_path = socket_path
+        self.images = set(images or [])
+        self.pulls: List[dict] = []  # {"image", "tag", "auth": decoded-or-None}
+        self.pull_error: Optional[str] = None  # set to make pulls fail
+        self.creates: List[dict] = []  # every container config passed to create
+        self.containers: Dict[str, FakeContainer] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._tasks: List[asyncio.Task] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/_ping", self._ping)
+        app.router.add_get("/images/{name}/json", self._image_inspect)
+        app.router.add_post("/images/create", self._image_create)
+        app.router.add_post("/containers/create", self._create)
+        app.router.add_post("/containers/{id}/start", self._start)
+        app.router.add_get("/containers/{id}/logs", self._logs)
+        app.router.add_post("/containers/{id}/wait", self._wait)
+        app.router.add_post("/containers/{id}/kill", self._kill)
+        app.router.add_delete("/containers/{id}", self._delete)
+        app.router.add_get("/containers/json", self._list)
+        app.router.add_get("/containers/{id}/json", self._inspect)
+        app.router.add_get("/containers/{id}/stats", self._stats)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.UnixSite(self._runner, self.socket_path)
+        await site.start()
+
+    async def stop(self) -> None:
+        for c in self.containers.values():
+            if c.running and c.proc is not None:
+                try:
+                    c.proc.kill()
+                except ProcessLookupError:
+                    pass
+        for t in self._tasks:
+            t.cancel()
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+    def find(self, ref: str) -> Optional[FakeContainer]:
+        """Resolve an id or a name, like the engine does."""
+        c = self.containers.get(ref)
+        if c is not None:
+            return c
+        for c in self.containers.values():
+            if c.name == ref:
+                return c
+        return None
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _ping(self, request: web.Request) -> web.Response:
+        return web.Response(text="OK")
+
+    async def _image_inspect(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        if name in self.images or f"{name}:latest" in self.images:
+            return web.json_response({"Id": "sha256:" + name})
+        return web.json_response({"message": f"no such image: {name}"}, status=404)
+
+    async def _image_create(self, request: web.Request) -> web.StreamResponse:
+        image = request.query.get("fromImage", "")
+        tag = request.query.get("tag", "latest")
+        auth = None
+        hdr = request.headers.get("X-Registry-Auth")
+        if hdr:
+            auth = json.loads(base64.b64decode(hdr + "=" * (-len(hdr) % 4)))
+        self.pulls.append({"image": image, "tag": tag, "auth": auth})
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        await resp.write(json.dumps({"status": f"Pulling from {image}"}).encode() + b"\n")
+        if self.pull_error:
+            await resp.write(json.dumps({"error": self.pull_error}).encode() + b"\n")
+        else:
+            await resp.write(
+                json.dumps({"status": "Downloading", "progressDetail": {"current": 10, "total": 10}}).encode()
+                + b"\n"
+            )
+            await resp.write(
+                json.dumps({"status": f"Status: Downloaded newer image for {image}:{tag}"}).encode() + b"\n"
+            )
+            self.images.add(f"{image}:{tag}")
+            self.images.add(image)
+        await resp.write_eof()
+        return resp
+
+    async def _create(self, request: web.Request) -> web.Response:
+        name = request.query.get("name") or ("c-" + uuid.uuid4().hex[:8])
+        if any(c.name == name for c in self.containers.values()):
+            return web.json_response(
+                {"message": f"Conflict. The container name {name} is already in use"}, status=409
+            )
+        config = await request.json()
+        self.creates.append(config)
+        image = config.get("Image", "")
+        if image not in self.images and f"{image}:latest" not in self.images:
+            return web.json_response({"message": f"No such image: {image}"}, status=404)
+        cid = uuid.uuid4().hex
+        self.containers[cid] = FakeContainer(cid, name, config)
+        return web.json_response({"Id": cid}, status=201)
+
+    async def _start(self, request: web.Request) -> web.Response:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        if c.proc is not None:
+            return web.Response(status=304)
+        argv = list(c.config.get("Entrypoint") or []) + list(c.config.get("Cmd") or [])
+        env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+        for kv in c.config.get("Env") or []:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        # Map the /workflow bind back to its host source so relative file access works.
+        cwd = None
+        host_config = c.config.get("HostConfig") or {}
+        for bind in host_config.get("Binds") or []:
+            src, _, dst = bind.partition(":")
+            workdir = c.config.get("WorkingDir") or ""
+            if dst and workdir.startswith(dst) and os.path.isdir(src):
+                cwd = src + workdir[len(dst):]
+                break
+        c.proc = await asyncio.create_subprocess_exec(
+            *argv,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+            env=env,
+            cwd=cwd,
+            start_new_session=True,
+        )
+        self._tasks.append(asyncio.ensure_future(self._pump(c)))
+        return web.Response(status=204)
+
+    async def _pump(self, c: FakeContainer) -> None:
+        assert c.proc is not None and c.proc.stdout is not None
+        while True:
+            chunk = await c.proc.stdout.read(4096)
+            if not chunk:
+                break
+            c.log_buf.extend(chunk)
+        c.exit_code = await c.proc.wait()
+        c.exited.set()
+
+    async def _logs(self, request: web.Request) -> web.StreamResponse:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        follow = request.query.get("follow") in ("1", "true")
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        sent = 0
+        while True:
+            if len(c.log_buf) > sent:
+                await resp.write(bytes(c.log_buf[sent:]))
+                sent = len(c.log_buf)
+            if not follow or c.exited.is_set():
+                if len(c.log_buf) > sent:
+                    continue
+                break
+            await asyncio.sleep(0.02)
+        await resp.write_eof()
+        return resp
+
+    async def _wait(self, request: web.Request) -> web.Response:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        if c.proc is None:
+            # Created but never started: the engine would block; report error.
+            return web.json_response({"message": "container not started"}, status=409)
+        await c.exited.wait()
+        return web.json_response({"StatusCode": c.exit_code})
+
+    async def _kill(self, request: web.Request) -> web.Response:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        if not c.running:
+            return web.json_response({"message": "container is not running"}, status=409)
+        sig = request.query.get("signal", "SIGKILL")
+        signum = getattr(signal, sig, signal.SIGKILL) if isinstance(sig, str) else int(sig)
+        assert c.proc is not None
+        try:
+            os.killpg(c.proc.pid, signum)
+        except ProcessLookupError:
+            pass
+        return web.Response(status=204)
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        if c.running and c.proc is not None:
+            try:
+                os.killpg(c.proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        self.containers.pop(c.id, None)
+        return web.Response(status=204)
+
+    async def _list(self, request: web.Request) -> web.Response:
+        label_filters: List[str] = []
+        raw = request.query.get("filters")
+        if raw:
+            label_filters = json.loads(raw).get("label") or []
+        out = []
+        for c in self.containers.values():
+            ok = True
+            for f in label_filters:
+                k, _, v = f.partition("=")
+                if c.labels.get(k) != v:
+                    ok = False
+                    break
+            if ok:
+                out.append(
+                    {
+                        "Id": c.id,
+                        "Names": ["/" + c.name],
+                        "Labels": c.labels,
+                        "State": "running" if c.running else "exited",
+                    }
+                )
+        return web.json_response(out)
+
+    async def _inspect(self, request: web.Request) -> web.Response:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        return web.json_response(
+            {
+                "Id": c.id,
+                "Name": "/" + c.name,
+                "Config": c.config,
+                "State": {
+                    "Running": c.running,
+                    "ExitCode": c.exit_code if c.exit_code is not None else 0,
+                },
+            }
+        )
+
+    async def _stats(self, request: web.Request) -> web.Response:
+        c = self.find(request.match_info["id"])
+        if c is None:
+            return web.json_response({"message": "no such container"}, status=404)
+        return web.json_response(
+            {
+                "cpu_stats": {"cpu_usage": {"total_usage": 123_000_000}},
+                "memory_stats": {"usage": 42 * 1024 * 1024},
+            }
+        )

@@ -1,0 +1,356 @@
+"""Container execution layer: the C++ agent driving the Docker Engine API.
+
+The real runner binary runs with --docker always/auto against tests.fake_docker — a
+unix-socket engine that executes container commands via subprocess — so image pull
+(with registry auth), create (device mapping / env / binds), log streaming, exit
+codes, stop, and restart recovery are all exercised over the actual engine REST
+protocol. Parity: reference shim/docker.go:240-875 (Submit/Run/Terminate lifecycle),
+restore-from-labels docker.go:104.
+"""
+
+import asyncio
+import os
+import re
+import signal
+import subprocess
+import tarfile
+import tempfile
+
+import pytest
+
+from dstack_tpu.core.models.resources import ResourcesSpec
+from dstack_tpu.core.models.runs import ClusterInfo, JobSpec, Requirements
+from dstack_tpu.server.services.runner.client import RunnerClient
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.fake_docker import FakeDockerDaemon
+
+pytestmark = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+_LISTEN_RE = re.compile(r"listening on [\d.]+:(\d+)")
+
+
+def _job_spec(commands, image="test/app:1.0", **kwargs) -> JobSpec:
+    return JobSpec(
+        job_name="cjob-0-0",
+        commands=commands,
+        image_name=image,
+        requirements=Requirements(resources=ResourcesSpec()),
+        **kwargs,
+    )
+
+
+class Runner:
+    """A real runner process plus its client."""
+
+    def __init__(self, proc: subprocess.Popen, port: int, base_dir: str) -> None:
+        self.proc = proc
+        self.port = port
+        self.base_dir = base_dir
+        self.client = RunnerClient("127.0.0.1", port)
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=5)
+        except ProcessLookupError:
+            pass
+
+
+def spawn_runner(docker_mode: str, docker_sock: str, base_dir=None) -> Runner:
+    binary = find_runner_binary()
+    base_dir = base_dir or tempfile.mkdtemp(prefix="dstack-tpu-ctest-")
+    proc = subprocess.Popen(
+        [
+            binary,
+            "--host", "127.0.0.1",
+            "--port", "0",
+            "--base-dir", base_dir,
+            "--docker", docker_mode,
+            "--docker-host", docker_sock,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+    assert proc.stdout is not None
+    for _ in range(20):
+        line = proc.stdout.readline().decode()
+        m = _LISTEN_RE.search(line)
+        if m:
+            return Runner(proc, int(m.group(1)), base_dir)
+    raise AssertionError("runner did not start")
+
+
+async def _pull_until_terminal(client: RunnerClient, timeout=20.0) -> dict:
+    """Drains pull until a terminal state event appears; returns it with all logs."""
+    offset = 0
+    logs = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        res = await client.pull(offset)
+        offset = res["offset"]
+        logs.extend(l["message"] for l in res["logs"])
+        for ev in res["job_states"]:
+            if ev["state"] in ("done", "failed", "terminated", "aborted"):
+                ev = dict(ev)
+                ev["all_logs"] = "".join(logs)
+                return ev
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"no terminal state; logs so far: {''.join(logs)!r}")
+
+
+class TestContainerPath:
+    async def test_pull_create_run_collects_logs_and_exit(self, tmp_path):
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock)
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            spec = _job_spec(
+                ["echo container-marker-$((40+2))", "echo PJRT=$PJRT_DEVICE"],
+                registry_auth={"username": "bot", "password": "hunter2"},
+            )
+            await runner.client.submit(spec, ClusterInfo(node_ips=["127.0.0.1"]))
+            await runner.client.run_job()
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "done", final
+            assert "container-marker-42" in final["all_logs"]
+            # PJRT_DEVICE=TPU is injected into every container (shim parity).
+            assert "PJRT=TPU" in final["all_logs"]
+            # The pull carried the registry credentials as X-Registry-Auth.
+            assert daemon.pulls == [
+                {"image": "test/app", "tag": "1.0", "auth": {"username": "bot", "password": "hunter2"}}
+            ]
+            # Terminal cleanup removed the container.
+            assert daemon.containers == {}
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_container_config_devices_and_labels(self, tmp_path):
+        """The create request maps TPU devices, uses host networking, and labels the
+        container for restart recovery."""
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["test/app:1.0"])
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            await runner.client.submit(_job_spec(["true"]), ClusterInfo())
+            await runner.client.run_job()
+            await _pull_until_terminal(runner.client)
+            [seen_config] = daemon.creates
+            host = seen_config["HostConfig"]
+            assert host["NetworkMode"] == "host"
+            assert seen_config["Labels"] == {"dstack-tpu.task": "true", "dstack-tpu.job": "cjob-0-0"}
+            assert "PJRT_DEVICE=TPU" in seen_config["Env"]
+            # Device list mirrors the host's /dev/accel* (none on CI hosts, but the
+            # key must exist with cgroup rwm entries when present).
+            assert isinstance(host["Devices"], list)
+            for d in host["Devices"]:
+                assert d["CgroupPermissions"] == "rwm"
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_code_archive_mounted_into_workdir(self, tmp_path):
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["test/app:1.0"])
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            payload = tmp_path / "payload"
+            payload.mkdir()
+            (payload / "hello.txt").write_text("from-the-repo\n")
+            tar_path = tmp_path / "code.tar.gz"
+            with tarfile.open(tar_path, "w:gz") as tf:
+                tf.add(payload / "hello.txt", arcname="hello.txt")
+            await runner.client.submit(_job_spec(["cat hello.txt"]), ClusterInfo())
+            await runner.client.upload_code(tar_path.read_bytes())
+            await runner.client.run_job()
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "done", final
+            assert "from-the-repo" in final["all_logs"]
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_nonzero_exit_fails_job(self, tmp_path):
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["test/app:1.0"])
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            await runner.client.submit(_job_spec(["exit 3"]), ClusterInfo())
+            await runner.client.run_job()
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "failed"
+            assert final["exit_status"] == 3
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_pull_failure_fails_job(self, tmp_path):
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock)
+        daemon.pull_error = "unauthorized: authentication required"
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            await runner.client.submit(_job_spec(["true"]), ClusterInfo())
+            await runner.client.run_job()
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "failed"
+            assert "unauthorized" in final["message"]
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_stop_kills_container(self, tmp_path):
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["test/app:1.0"])
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            await runner.client.submit(
+                _job_spec(["echo started", "sleep 300"]), ClusterInfo()
+            )
+            await runner.client.run_job()
+            # Wait until the container process is live, then stop.
+            for _ in range(100):
+                if any(c.running for c in daemon.containers.values()):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("container never started")
+            await runner.client.stop(abort=False)
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "terminated"
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_restart_recovery_reattaches(self, tmp_path):
+        """Agent dies mid-job; a fresh agent re-attaches to the labeled container
+        instead of double-running it (ref shim restoreStateFromContainers)."""
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["test/app:1.0"])
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        base_dir = runner.base_dir
+        spec = _job_spec(["echo recovery-marker", "sleep 1.2", "echo recovered-done"])
+        try:
+            await runner.client.submit(spec, ClusterInfo())
+            await runner.client.run_job()
+            for _ in range(100):
+                if any(c.running for c in daemon.containers.values()):
+                    break
+                await asyncio.sleep(0.05)
+            runner.kill()  # simulated agent crash; the container keeps running
+
+            runner2 = spawn_runner("always", sock, base_dir=base_dir)
+            try:
+                # The control plane re-submits after a healthcheck reset (idempotent).
+                await runner2.client.submit(spec, ClusterInfo())
+                await runner2.client.run_job()
+                final = await _pull_until_terminal(runner2.client)
+                assert final["state"] == "done", final
+                assert "re-attaching to container" in final["all_logs"]
+                # Exactly one container existed for the job lifetime; recovery did not
+                # create a second one.
+                assert len(daemon.pulls) == 0
+            finally:
+                runner2.kill()
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_retry_does_not_reattach_previous_submission(self, tmp_path):
+        """A retried submission (new job_submission_id) must NOT resurrect the
+        previous attempt's leftover container — it replaces it and runs fresh."""
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["test/app:1.0"])
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        spec1 = _job_spec(["echo attempt-one", "sleep 300"], job_submission_id="sub-1")
+        try:
+            await runner.client.submit(spec1, ClusterInfo())
+            await runner.client.run_job()
+            for _ in range(100):
+                if any(c.running for c in daemon.containers.values()):
+                    break
+                await asyncio.sleep(0.05)
+            runner.kill()  # crash mid-attempt; container lingers
+
+            runner2 = spawn_runner("always", sock, base_dir=runner.base_dir)
+            try:
+                spec2 = _job_spec(["echo attempt-two"], job_submission_id="sub-2")
+                await runner2.client.submit(spec2, ClusterInfo())
+                await runner2.client.run_job()
+                final = await _pull_until_terminal(runner2.client)
+                assert final["state"] == "done", final
+                assert "attempt-two" in final["all_logs"]
+                assert "re-attaching" not in final["all_logs"]
+                # Two creates: the retry replaced the stale same-name container.
+                assert len(daemon.creates) == 2
+            finally:
+                runner2.kill()
+        finally:
+            runner.kill()
+            await daemon.stop()
+
+    async def test_auto_mode_without_engine_runs_on_host(self, tmp_path):
+        runner = spawn_runner("auto", str(tmp_path / "nonexistent.sock"))
+        try:
+            await runner.client.submit(_job_spec(["echo host-fallback-ok"]), ClusterInfo())
+            await runner.client.run_job()
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "done"
+            assert "host-fallback-ok" in final["all_logs"]
+            assert "docker engine unreachable" in final["all_logs"]
+        finally:
+            runner.kill()
+
+
+class TestContainerE2E:
+    async def test_local_backend_runs_job_in_container(self, tmp_path, monkeypatch):
+        """Full control-plane path: submit a run with image:, the scheduler provisions
+        a local runner in --docker always mode, the job executes inside a (fake-engine)
+        container, logs land in log storage."""
+        from dstack_tpu.server import settings
+        from dstack_tpu.server.background import tasks
+        from dstack_tpu.server.services import logs as logs_service
+        from tests.common import api_server
+        from tests.test_e2e_local import _drive_until
+
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["my-registry.io/jax-tpu:2.0"])
+        await daemon.start()
+        monkeypatch.setattr(settings, "LOCAL_DOCKER_MODE", "always")
+        monkeypatch.setenv("DOCKER_HOST", f"unix://{sock}")
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path / "logs")))
+        try:
+            async with api_server() as api:
+                spec = {
+                    "run_spec": {
+                        "run_name": "cont-e2e",
+                        "configuration": {
+                            "type": "task",
+                            "image": "my-registry.io/jax-tpu:2.0",
+                            "commands": ["echo in-container-$((6*7))"],
+                        },
+                    }
+                }
+                await api.post("/api/project/main/runs/submit", spec)
+                run = await _drive_until(api, "cont-e2e", "done")
+                assert run["status"] == "done"
+                job = await api.db.fetchone("SELECT * FROM jobs")
+                events = logs_service.get_log_storage().poll_logs(
+                    job["project_id"], "cont-e2e", job["id"]
+                )
+                text = "".join(e.message for e in events)
+                assert "in-container-42" in text
+        finally:
+            await daemon.stop()
+            logs_service.set_log_storage(None)
