@@ -22,6 +22,7 @@ from dstack_tpu.core.errors import (
 from dstack_tpu.core.models.fleets import Fleet, FleetPlan, FleetSpec
 from dstack_tpu.core.models.instances import Instance
 from dstack_tpu.core.models.logs import JobSubmissionLogs
+from dstack_tpu.core.models.metrics import JobMetrics
 from dstack_tpu.core.models.runs import Run, RunPlan, RunSpec
 from dstack_tpu.core.models.volumes import Volume
 
@@ -56,6 +57,7 @@ class Client:
         self.offers = OffersApi(self)
         self.backends = BackendsApi(self)
         self.logs = LogsApi(self)
+        self.metrics = MetricsApi(self)
         self.instances = InstancesApi(self)
 
     def post(self, path: str, body: Optional[dict] = None, data: Optional[bytes] = None) -> Any:
@@ -233,6 +235,33 @@ class InstancesApi:
     def list(self) -> List[Instance]:
         data = self._c.post(self._c._p("/instances/list"))
         return [Instance.model_validate(i) for i in data]
+
+
+class MetricsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def get_job(
+        self,
+        run_name: str,
+        replica_num: int = 0,
+        job_num: int = 0,
+        limit: int = 100,
+        after: Optional[str] = None,
+        before: Optional[str] = None,
+    ) -> JobMetrics:
+        data = self._c.post(
+            self._c._p("/metrics/job"),
+            {
+                "run_name": run_name,
+                "replica_num": replica_num,
+                "job_num": job_num,
+                "limit": limit,
+                "after": after,
+                "before": before,
+            },
+        )
+        return JobMetrics.model_validate(data)
 
 
 class LogsApi:

@@ -253,6 +253,30 @@ def cmd_logs(args) -> None:
     sys.stdout.flush()
 
 
+def cmd_metrics(args) -> None:
+    client = _client()
+    m = client.metrics.get_job(
+        args.run_name, replica_num=args.replica, job_num=args.job, limit=args.limit
+    )
+    if not m.points:
+        print("no metrics collected yet (the job may have just started)")
+        return
+    rows = []
+    for p in m.points:
+        rows.append(
+            [
+                p.timestamp.strftime("%H:%M:%S"),
+                f"{p.cpu_usage_percent:.1f}%",
+                f"{p.memory_usage_bytes / (1024 ** 2):.0f}MB",
+                f"{p.tpu_duty_cycle_percent:.0f}%" if p.tpu_duty_cycle_percent is not None else "-",
+                f"{p.tpu_hbm_usage_bytes / (1024 ** 3):.1f}GB"
+                if p.tpu_hbm_usage_bytes is not None
+                else "-",
+            ]
+        )
+    print(_table(["TIME", "CPU", "MEM", "TPU DUTY", "HBM"], rows))
+
+
 def cmd_offer(args) -> None:
     client = _client()
     resources = {}
@@ -383,6 +407,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--name", help="override the run name")
     s.add_argument("--no-repo", action="store_true", help="do not upload the working tree")
     s.set_defaults(func=cmd_apply)
+
+    s = sub.add_parser("metrics", help="show a run's resource metrics")
+    s.add_argument("run_name")
+    s.add_argument("--replica", type=int, default=0)
+    s.add_argument("--job", type=int, default=0)
+    s.add_argument("--limit", type=int, default=20)
+    s.set_defaults(func=cmd_metrics)
 
     s = sub.add_parser("ps", help="list runs")
     s.add_argument("-a", "--all", action="store_true")

@@ -69,4 +69,9 @@ def start_background_tasks(app: web.Application) -> BackgroundScheduler:
         settings.PROCESS_INSTANCES_INTERVAL,
         "process_instances",
     )
+    sched.add_periodic(
+        lambda: tasks.process_metrics(db),
+        settings.PROCESS_METRICS_INTERVAL,
+        "process_metrics",
+    )
     return sched

@@ -1195,3 +1195,14 @@ async def _cleanup_auto_fleets(db: Database) -> None:
         " AND NOT EXISTS (SELECT 1 FROM runs r WHERE r.fleet_id = fleets.id AND r.deleted = 0"
         " AND r.status NOT IN ('terminated', 'failed', 'done'))",
     )
+
+
+async def process_metrics(db: Database) -> None:
+    """Sample every running job's agent into job_metrics_points + TTL sweep.
+
+    Parity: reference background/tasks/process_metrics.py (collect_metrics /
+    delete_metrics)."""
+    from dstack_tpu.server.services import metrics as metrics_service
+
+    await metrics_service.collect_job_metrics(db)
+    await metrics_service.sweep_metrics(db)

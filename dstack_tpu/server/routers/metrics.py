@@ -1,0 +1,53 @@
+"""Job metrics API + Prometheus export.
+
+Parity: reference routers/metrics.py (GET job metrics with after/before/limit
+windows) and routers/prometheus.py (text exposition gated by
+ENABLE_PROMETHEUS_METRICS)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.errors import ResourceNotExistsError
+from dstack_tpu.server import settings
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, required
+from dstack_tpu.server.services import metrics as metrics_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/metrics/job")
+async def get_job_metrics(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    db = request.app["db"]
+    run_name = required(body, "run_name")
+    replica_num = int(body.get("replica_num") or 0)
+    job_num = int(body.get("job_num") or 0)
+    row = await db.fetchone(
+        "SELECT j.id FROM jobs j JOIN runs r ON r.id = j.run_id"
+        " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
+        "   AND j.replica_num = ? AND j.job_num = ?"
+        " ORDER BY j.submission_num DESC LIMIT 1",
+        (project_row["id"], run_name, replica_num, job_num),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"no job {job_num}/{replica_num} for run {run_name}")
+    result = await metrics_service.get_job_metrics(
+        db,
+        row["id"],
+        limit=int(body.get("limit") or 100),
+        after=body.get("after"),
+        before=body.get("before"),
+    )
+    return model_response(result)
+
+
+@routes.get("/metrics")
+async def prometheus_metrics(request: web.Request) -> web.Response:
+    if not settings.ENABLE_PROMETHEUS_METRICS:
+        raise web.HTTPNotFound()
+    from dstack_tpu.server.services import prometheus
+
+    text = await prometheus.render_metrics(request.app["db"])
+    return web.Response(text=text, content_type="text/plain", charset="utf-8")

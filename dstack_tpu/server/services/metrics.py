@@ -1,0 +1,126 @@
+"""Job metrics: collection from runner agents, query API, TTL sweep.
+
+Parity: reference server/services/metrics.py (get_job_metrics derives
+cpu_usage_percent from consecutive cpu_usage_micro samples) +
+background/tasks/process_metrics.py (collect/delete loops). TPU re-design: the
+``tpu`` column stores the agent's TPU sample (duty-cycle %, HBM bytes — scraped
+from the runtime metrics endpoint by the C++ agent, runner/src/executor.cpp) in
+place of the reference's per-GPU DCGM rows.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import logging
+from typing import Optional
+
+from dstack_tpu.core.models.metrics import JobMetrics, MetricPoint
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services.jobs import job_jpd, job_jrd
+from dstack_tpu.server.services.runner.client import get_runner_client
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+MAX_JOBS_PER_PASS = 100
+COLLECT_CONCURRENCY = 10
+
+
+async def collect_job_metrics(db: Database) -> int:
+    """One collection pass: sample every running job's agent. Returns #points."""
+    rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status = 'running'"
+        " ORDER BY last_processed_at ASC LIMIT ?",
+        (MAX_JOBS_PER_PASS,),
+    )
+    if not rows:
+        return 0
+    sem = asyncio.Semaphore(COLLECT_CONCURRENCY)
+
+    async def _one(row) -> int:
+        async with sem:
+            try:
+                jpd = job_jpd(row)
+                if jpd is None or jpd.hostname is None:
+                    return 0
+                client = get_runner_client(jpd, job_jrd(row))
+                sample = await client.metrics()
+            except Exception as e:  # a dead tunnel must not kill the whole pass
+                logger.debug("metrics: job %s unreachable: %s", row["id"], e)
+                return 0
+            if not sample:
+                return 0
+            tpu = sample.get("tpu")
+            await db.execute(
+                "INSERT INTO job_metrics_points"
+                " (job_id, timestamp, cpu_usage_micro, memory_usage_bytes,"
+                "  memory_working_set_bytes, tpu)"
+                " VALUES (?, ?, ?, ?, ?, ?)",
+                (
+                    row["id"],
+                    sample.get("timestamp") or to_iso(now_utc()),
+                    int(sample.get("cpu_usage_micro") or 0),
+                    int(sample.get("memory_usage_bytes") or 0),
+                    int(sample.get("memory_working_set_bytes") or sample.get("memory_usage_bytes") or 0),
+                    json.dumps(tpu) if tpu else None,
+                ),
+            )
+            return 1
+
+    results = await asyncio.gather(*(_one(r) for r in rows))
+    return sum(results)
+
+
+async def sweep_metrics(db: Database) -> None:
+    """TTL delete (reference keeps separate running/finished TTLs; one TTL here —
+    finished jobs' points age out the same way)."""
+    cutoff = to_iso(now_utc() - datetime.timedelta(seconds=settings.METRICS_TTL_SECONDS))
+    await db.execute("DELETE FROM job_metrics_points WHERE timestamp < ?", (cutoff,))
+
+
+async def get_job_metrics(
+    db: Database,
+    job_id: str,
+    limit: int = 100,
+    after: Optional[str] = None,
+    before: Optional[str] = None,
+) -> JobMetrics:
+    """Latest-first points. cpu_usage_percent needs consecutive samples, so one
+    extra row is fetched beyond `limit` and consumed by the delta computation
+    (reference services/metrics.py:35-50)."""
+    q = "SELECT * FROM job_metrics_points WHERE job_id = ?"
+    args: list = [job_id]
+    if after:
+        q += " AND timestamp >= ?"
+        args.append(after)
+    if before:
+        q += " AND timestamp < ?"
+        args.append(before)
+    q += " ORDER BY timestamp DESC LIMIT ?"
+    args.append(min(limit, 1000) + 1)
+    rows = await db.fetchall(q, tuple(args))
+
+    points = []
+    for i in range(len(rows) - 1):
+        cur, prev = rows[i], rows[i + 1]
+        t_cur, t_prev = from_iso(cur["timestamp"]), from_iso(prev["timestamp"])
+        window_micro = max(1, int((t_cur - t_prev).total_seconds() * 1_000_000))
+        cpu_pct = (
+            max(0, cur["cpu_usage_micro"] - prev["cpu_usage_micro"]) / window_micro * 100.0
+        )
+        tpu = json.loads(cur["tpu"]) if cur["tpu"] else {}
+        points.append(
+            MetricPoint(
+                timestamp=t_cur,
+                cpu_usage_percent=round(cpu_pct, 2),
+                memory_usage_bytes=cur["memory_usage_bytes"],
+                memory_working_set_bytes=cur["memory_working_set_bytes"],
+                tpu_duty_cycle_percent=tpu.get("duty_cycle_percent"),
+                tpu_hbm_usage_bytes=tpu.get("hbm_usage_bytes"),
+                tpu_tensorcore_util_percent=tpu.get("tensorcore_util_percent"),
+            )
+        )
+    return JobMetrics(points=points[:limit])

@@ -1,0 +1,116 @@
+"""Prometheus text exposition for the control plane.
+
+Parity: reference server/services/prometheus.py:31 (get_metrics: instance, run,
+and per-job gauges rendered for scraping). Rendered by hand — the exposition
+format is a dozen lines of text; no client library needed. TPU re-design: the
+per-job hardware gauges are TPU duty-cycle / HBM (from the agents' runtime
+scrape) instead of per-GPU DCGM series.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from dstack_tpu.server.db import Database
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(name: str, help_: str, type_: str, samples: List[Tuple[Dict[str, str], float]]) -> str:
+    lines = [f"# HELP {name} {help_}", f"# TYPE {name} {type_}"]
+    for labels, value in samples:
+        if labels:
+            inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+            lines.append(f"{name}{{{inner}}} {value:g}")
+        else:
+            lines.append(f"{name} {value:g}")
+    return "\n".join(lines)
+
+
+async def render_metrics(db: Database) -> str:
+    sections = []
+
+    rows = await db.fetchall(
+        "SELECT p.name AS project, r.status, COUNT(*) AS n FROM runs r"
+        " JOIN projects p ON p.id = r.project_id"
+        " WHERE r.deleted = 0 GROUP BY p.name, r.status"
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_runs_total",
+            "Runs by project and status",
+            "gauge",
+            [({"project": r["project"], "status": r["status"]}, float(r["n"])) for r in rows],
+        )
+    )
+
+    rows = await db.fetchall(
+        "SELECT backend, status, COUNT(*) AS n FROM instances"
+        " WHERE status NOT IN ('terminated') GROUP BY backend, status"
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_instances_total",
+            "Slice worker instances by backend and status",
+            "gauge",
+            [({"backend": r["backend"] or "", "status": r["status"]}, float(r["n"])) for r in rows],
+        )
+    )
+
+    rows = await db.fetchall(
+        "SELECT instance_type, price FROM instances"
+        " WHERE status IN ('idle', 'busy', 'provisioning')"
+    )
+    cost_by_type: Dict[str, float] = {}
+    for r in rows:
+        itype = json.loads(r["instance_type"]) if r["instance_type"] else {}
+        name = itype.get("name") or ""
+        cost_by_type[name] = cost_by_type.get(name, 0.0) + float(r["price"] or 0.0)
+    sections.append(
+        _fmt(
+            "dstack_tpu_instance_price_dollars_per_hour",
+            "Active provisioned capacity price by instance type",
+            "gauge",
+            [({"instance_type": k}, v) for k, v in sorted(cost_by_type.items())],
+        )
+    )
+
+    # Per-running-job latest sample (cpu micro is a counter; TPU gauges as-is).
+    rows = await db.fetchall(
+        "SELECT j.run_name, j.job_num, j.replica_num, m.cpu_usage_micro,"
+        "       m.memory_usage_bytes, m.tpu"
+        " FROM jobs j JOIN job_metrics_points m ON m.job_id = j.id"
+        " WHERE j.status = 'running'"
+        "   AND m.timestamp = (SELECT MAX(timestamp) FROM job_metrics_points WHERE job_id = j.id)"
+    )
+    cpu, mem, duty, hbm = [], [], [], []
+    for r in rows:
+        labels = {
+            "run": r["run_name"],
+            "job": str(r["job_num"]),
+            "replica": str(r["replica_num"]),
+        }
+        cpu.append((labels, float(r["cpu_usage_micro"]) / 1e6))
+        mem.append((labels, float(r["memory_usage_bytes"])))
+        tpu = json.loads(r["tpu"]) if r["tpu"] else {}
+        if tpu.get("duty_cycle_percent") is not None:
+            duty.append((labels, float(tpu["duty_cycle_percent"])))
+        if tpu.get("hbm_usage_bytes") is not None:
+            hbm.append((labels, float(tpu["hbm_usage_bytes"])))
+    sections.append(
+        _fmt("dstack_tpu_job_cpu_seconds_total", "Job CPU time consumed", "counter", cpu)
+    )
+    sections.append(
+        _fmt("dstack_tpu_job_memory_usage_bytes", "Job resident memory", "gauge", mem)
+    )
+    sections.append(
+        _fmt("dstack_tpu_job_tpu_duty_cycle_percent", "TPU duty cycle", "gauge", duty)
+    )
+    sections.append(
+        _fmt("dstack_tpu_job_tpu_hbm_usage_bytes", "TPU HBM in use", "gauge", hbm)
+    )
+
+    return "\n".join(sections) + "\n"

@@ -1,6 +1,7 @@
 #include "executor.hpp"
 
 #include "docker.hpp"
+#include "tpu_metrics.hpp"
 
 #include <cerrno>
 #include <fcntl.h>
@@ -226,9 +227,10 @@ dj::Json Executor::metrics() const {
   out.set("timestamp", iso_now());
   out.set("cpu_usage_micro", cpu_micro);
   out.set("memory_usage_bytes", rss_bytes);
-  // TPU duty-cycle/HBM come from the shim's libtpu monitor on TPU hosts; the runner
-  // reports null so the server knows to ask the shim (reference: DCGM relay split).
-  out.set("tpu", dj::Json());
+  // TPU duty-cycle/HBM scraped from the runtime metrics endpoint when
+  // DSTACK_TPU_RUNTIME_METRICS_URL is set (the DCGM-exporter analog); null
+  // otherwise (src/tpu_metrics.cpp).
+  out.set("tpu", dtpu::sample_tpu_metrics());
   return out;
 }
 

@@ -1,0 +1,151 @@
+#include "tpu_metrics.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <vector>
+
+namespace dtpu {
+
+namespace {
+
+struct Series {
+  double sum = 0;
+  int count = 0;
+};
+
+// "name{labels} value" / "name value" -> (name, value); false for comments/blank.
+bool parse_sample(const std::string& line, std::string* name, double* value) {
+  if (line.empty() || line[0] == '#') return false;
+  size_t name_end = line.find_first_of("{ ");
+  if (name_end == std::string::npos) return false;
+  *name = line.substr(0, name_end);
+  size_t value_start;
+  if (line[name_end] == '{') {
+    size_t close = line.find('}', name_end);
+    if (close == std::string::npos) return false;
+    value_start = close + 1;
+  } else {
+    value_start = name_end;
+  }
+  while (value_start < line.size() && line[value_start] == ' ') ++value_start;
+  if (value_start >= line.size()) return false;
+  char* end = nullptr;
+  *value = strtod(line.c_str() + value_start, &end);
+  return end != line.c_str() + value_start;
+}
+
+bool name_has(const std::string& name, const char* needle) {
+  return name.find(needle) != std::string::npos;
+}
+
+}  // namespace
+
+dj::Json parse_prometheus_tpu(const std::string& text) {
+  // Known exporters name these variously (tpu-device-plugin: duty_cycle,
+  // memory_used, memory_total; libtpu monitoring: tensorcore_utilization,
+  // hbm_memory_usage_bytes) — match on substrings.
+  Series duty, tensorcore, mem_used, mem_total;
+  std::istringstream ss(text);
+  std::string line;
+  while (std::getline(ss, line)) {
+    std::string name;
+    double value = 0;
+    if (!parse_sample(line, &name, &value)) continue;
+    if (name_has(name, "tensorcore_util")) {
+      tensorcore.sum += value;
+      ++tensorcore.count;
+    } else if (name_has(name, "duty_cycle")) {
+      duty.sum += value;
+      ++duty.count;
+    } else if (name_has(name, "memory_used") || name_has(name, "memory_usage")) {
+      mem_used.sum += value;
+      ++mem_used.count;
+    } else if (name_has(name, "memory_total") || name_has(name, "memory_capacity")) {
+      mem_total.sum += value;
+      ++mem_total.count;
+    }
+  }
+  if (duty.count == 0 && tensorcore.count == 0 && mem_used.count == 0) return dj::Json();
+  dj::Json out = dj::Json::object();
+  if (duty.count > 0) out.set("duty_cycle_percent", duty.sum / duty.count);
+  if (tensorcore.count > 0) out.set("tensorcore_util_percent", tensorcore.sum / tensorcore.count);
+  if (mem_used.count > 0) out.set("hbm_usage_bytes", mem_used.sum);
+  if (mem_total.count > 0) out.set("hbm_total_bytes", mem_total.sum);
+  return out;
+}
+
+namespace {
+
+// Minimal blocking HTTP GET over TCP with a short deadline; metrics sampling
+// must never stall the agent's API thread for long.
+std::string http_get(const std::string& host, int port, const std::string& path,
+                     int timeout_ms) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0) return "";
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return "";
+  std::string req = "GET " + path + " HTTP/1.0\r\nHost: " + host + "\r\n\r\n";
+  if (write(fd, req.data(), req.size()) != static_cast<ssize_t>(req.size())) {
+    close(fd);
+    return "";
+  }
+  std::string raw;
+  char buf[8192];
+  while (true) {
+    pollfd pfd{fd, POLLIN, 0};
+    if (poll(&pfd, 1, timeout_ms) <= 0) break;
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) break;
+    raw.append(buf, static_cast<size_t>(n));
+  }
+  close(fd);
+  size_t body = raw.find("\r\n\r\n");
+  if (body == std::string::npos) return "";
+  if (raw.compare(0, 5, "HTTP/") != 0 || raw.find(" 200") > 12) return "";
+  return raw.substr(body + 4);
+}
+
+}  // namespace
+
+dj::Json sample_tpu_metrics() {
+  const char* url = getenv("DSTACK_TPU_RUNTIME_METRICS_URL");
+  if (!url || !*url) return dj::Json();
+  std::string u = url;
+  if (u.compare(0, 7, "http://") != 0) return dj::Json();
+  u = u.substr(7);
+  std::string path = "/metrics";
+  auto slash = u.find('/');
+  if (slash != std::string::npos) {
+    path = u.substr(slash);
+    u = u.substr(0, slash);
+  }
+  int port = 80;
+  auto colon = u.rfind(':');
+  if (colon != std::string::npos) {
+    port = atoi(u.c_str() + colon + 1);
+    u = u.substr(0, colon);
+  }
+  std::string body = http_get(u, port, path, 2000);
+  if (body.empty()) return dj::Json();
+  return parse_prometheus_tpu(body);
+}
+
+}  // namespace dtpu

@@ -1,0 +1,26 @@
+// TPU hardware metrics for the agent's /api/metrics endpoint.
+//
+// Parity: the reference relays GPU utilization through a DCGM exporter sidecar
+// (runner/internal/shim/dcgm/exporter.go). The TPU analog: the runtime exposes a
+// Prometheus endpoint (GKE tpu-device-plugin :2112, or libtpu's monitoring
+// exporter) with per-chip duty-cycle and HBM gauges; the agent scrapes and
+// reduces it to one host-level sample the control plane stores per job.
+#pragma once
+
+#include <string>
+
+#include "json.hpp"
+
+namespace dtpu {
+
+// Reduce Prometheus exposition text to {"duty_cycle_percent", "hbm_usage_bytes",
+// "hbm_total_bytes", "tensorcore_util_percent"} (keys present only when the
+// corresponding series exist). Duty/utilization average across chips; memory sums.
+dj::Json parse_prometheus_tpu(const std::string& text);
+
+// Scrape the endpoint named by DSTACK_TPU_RUNTIME_METRICS_URL
+// (http://host:port/path). Returns a null Json when unset or unreachable —
+// the control plane stores no TPU sample for the point then.
+dj::Json sample_tpu_metrics();
+
+}  // namespace dtpu

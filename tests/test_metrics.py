@@ -1,0 +1,195 @@
+"""Metrics pipeline: agent sample -> process_metrics loop -> job_metrics_points ->
+metrics API + CLI shape -> Prometheus export -> TTL sweep.
+
+Parity: reference background/tasks/process_metrics.py, services/metrics.py
+(cpu % from consecutive counter samples), routers/metrics.py, prometheus.py:31.
+The TPU sample rides the agent's runtime scrape (runner/src/tpu_metrics.cpp),
+the DCGM-exporter analog."""
+
+import asyncio
+import datetime
+import json
+
+import pytest
+from aiohttp import web
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.server.services import metrics as metrics_service
+from dstack_tpu.utils.common import now_utc, to_iso
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.common import api_server
+
+pytestmark = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+
+async def _drive(api, passes=3):
+    for _ in range(passes):
+        await tasks.process_submitted_jobs(api.db)
+        await tasks.process_running_jobs(api.db)
+        await tasks.process_runs(api.db)
+        await asyncio.sleep(0.1)
+
+
+class TestMetricsPipeline:
+    async def test_collect_query_prometheus_and_sweep(self, tmp_path):
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        try:
+            async with api_server() as api:
+                spec = {
+                    "run_spec": {
+                        "run_name": "m-run",
+                        "configuration": {
+                            "type": "task",
+                            # Burn a little CPU so the usage counter advances.
+                            "commands": [
+                                "python3 -c \"import time; t=time.time()\n"
+                                "while time.time()-t < 6: sum(range(2000))\""
+                            ],
+                        },
+                    }
+                }
+                await api.post("/api/project/main/runs/submit", spec)
+                for _ in range(60):
+                    await _drive(api, passes=1)
+                    run = await api.post("/api/project/main/runs/get", {"run_name": "m-run"})
+                    if run["status"] == "running":
+                        break
+                else:
+                    raise AssertionError("run never reached running")
+
+                # Two collection passes ~1s apart -> at least 2 points -> cpu %.
+                n1 = await metrics_service.collect_job_metrics(api.db)
+                await asyncio.sleep(1.2)
+                n2 = await metrics_service.collect_job_metrics(api.db)
+                assert n1 == 1 and n2 == 1
+
+                res = await api.post(
+                    "/api/project/main/metrics/job", {"run_name": "m-run", "limit": 10}
+                )
+                assert len(res["points"]) >= 1
+                point = res["points"][0]
+                assert point["memory_usage_bytes"] > 0
+                assert point["cpu_usage_percent"] >= 0.0
+
+                # Prometheus exposition reflects the run and the sample.
+                resp = await api.client.get("/metrics")
+                text = await resp.text()
+                assert resp.status == 200
+                assert 'dstack_tpu_runs_total{project="main",status="running"} 1' in text
+                assert "dstack_tpu_job_cpu_seconds_total" in text
+                assert 'run="m-run"' in text
+
+                # TTL sweep: age the points out and confirm deletion.
+                old = to_iso(now_utc() - datetime.timedelta(hours=2))
+                await api.db.execute("UPDATE job_metrics_points SET timestamp = ?", (old,))
+                await metrics_service.sweep_metrics(api.db)
+                left = await api.db.fetchone("SELECT COUNT(*) AS n FROM job_metrics_points")
+                assert left["n"] == 0
+
+                # Cleanup: stop the run (kills the local runner process).
+                await api.post("/api/project/main/runs/stop", {"runs_names": ["m-run"], "abort": True})
+                for _ in range(40):
+                    await tasks.process_terminating_jobs(api.db)
+                    await tasks.process_runs(api.db)
+                    run = await api.post("/api/project/main/runs/get", {"run_name": "m-run"})
+                    if run["status"] in ("terminated", "aborted", "failed", "done"):
+                        break
+                    await asyncio.sleep(0.1)
+        finally:
+            logs_service.set_log_storage(None)
+
+    async def test_unreachable_runner_does_not_fail_pass(self):
+        async with api_server() as api:
+            # A running job whose agent endpoint is dead (default project + admin).
+            proj = await api.db.fetchone("SELECT * FROM projects LIMIT 1")
+            await api.db.execute(
+                "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
+                " run_spec) VALUES ('r1', ?, ?, 'dead-run', '2026-01-01', 'running', '{}')",
+                (proj["id"], proj["owner_id"]),
+            )
+            jpd = {
+                "backend": "local",
+                "instance_type": {"name": "local", "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1}},
+                "instance_id": "i-dead",
+                "hostname": "127.0.0.1",
+                "region": "local",
+                "ssh_port": 0,
+                "backend_data": json.dumps({"runner_port": 1}),  # nothing listens
+            }
+            await api.db.execute(
+                "INSERT INTO jobs (id, project_id, run_id, run_name, job_spec, status,"
+                " submitted_at, job_provisioning_data) VALUES ('j1', ?, 'r1', 'dead-run',"
+                " '{}', 'running', '2026-01-01', ?)",
+                (proj["id"], json.dumps(jpd)),
+            )
+            n = await metrics_service.collect_job_metrics(api.db)
+            assert n == 0  # unreachable — skipped, no exception
+
+
+class TestTpuRuntimeScrape:
+    async def test_agent_reports_tpu_sample(self, tmp_path):
+        """The agent scrapes a Prometheus TPU runtime endpoint and reduces per-chip
+        series to one host sample."""
+        exposition = "\n".join(
+            [
+                "# HELP duty_cycle TPU duty cycle",
+                "# TYPE duty_cycle gauge",
+                'duty_cycle{accelerator_id="0"} 80',
+                'duty_cycle{accelerator_id="1"} 60',
+                'memory_used{accelerator_id="0"} 1000000',
+                'memory_used{accelerator_id="1"} 2000000',
+                'memory_total{accelerator_id="0"} 16000000',
+                'memory_total{accelerator_id="1"} 16000000',
+                "",
+            ]
+        )
+
+        async def metrics_handler(request):
+            return web.Response(text=exposition, content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics_handler)
+        runner_http = web.AppRunner(app)
+        await runner_http.setup()
+        site = web.TCPSite(runner_http, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        import os
+        import subprocess
+        import tempfile
+
+        from tests.test_container import _LISTEN_RE
+
+        env = dict(os.environ)
+        env["DSTACK_TPU_RUNTIME_METRICS_URL"] = f"http://127.0.0.1:{port}/metrics"
+        proc = subprocess.Popen(
+            [
+                find_runner_binary(),
+                "--host", "127.0.0.1",
+                "--port", "0",
+                "--base-dir", tempfile.mkdtemp(),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        try:
+            line = proc.stdout.readline().decode()
+            m = _LISTEN_RE.search(line)
+            assert m, line
+            from dstack_tpu.server.services.runner.client import RunnerClient
+
+            client = RunnerClient("127.0.0.1", int(m.group(1)))
+            sample = await client.metrics()
+            tpu = sample["tpu"]
+            assert tpu["duty_cycle_percent"] == 70.0  # averaged across chips
+            assert tpu["hbm_usage_bytes"] == 3000000  # summed
+            assert tpu["hbm_total_bytes"] == 32000000
+        finally:
+            proc.kill()
+            proc.wait()
+            await runner_http.cleanup()
