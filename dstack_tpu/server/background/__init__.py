@@ -74,4 +74,9 @@ def start_background_tasks(app: web.Application) -> BackgroundScheduler:
         settings.PROCESS_METRICS_INTERVAL,
         "process_metrics",
     )
+    sched.add_periodic(
+        lambda: tasks.process_services(db),
+        settings.PROCESS_SERVICES_INTERVAL,
+        "process_services",
+    )
     return sched

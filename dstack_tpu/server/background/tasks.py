@@ -398,6 +398,18 @@ async def _process_provisioning(db: Database, job_row) -> None:
     # Unique per submission: a retried gang gets fresh container labels, so the
     # agent's restart recovery can't resurrect a previous attempt's container.
     spec.job_submission_id = job_row["id"]
+    # Service data plane: assign the app port and surface it as
+    # DSTACK_SERVICE_PORT. On the shared-host local backend each replica gets an
+    # ephemeral port (recorded in ports_mapping for the proxy) so replicas on one
+    # host never collide; on cloud workers the configured port is used as-is.
+    if spec.service_port is not None and spec.job_num == 0:
+        assigned = spec.service_port
+        if jpd.backend == "local":
+            from dstack_tpu.core.services.ssh.tunnel import allocate_local_port
+
+            assigned = jrd.ports_mapping.get(spec.service_port) or allocate_local_port()
+        jrd.ports_mapping[spec.service_port] = assigned
+        spec.env["DSTACK_SERVICE_PORT"] = str(assigned)
     await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
     code = await _get_code(db, job_row["project_id"], run_spec)
     if code:
@@ -750,6 +762,21 @@ async def _process_active_run(db: Database, run_row) -> None:
     replicas: Dict[int, List] = {}
     for (replica_num, _), r in sorted(latest.items()):
         replicas.setdefault(replica_num, []).append(r)
+
+    # Scaled-down replicas are history, not signal: the autoscaler retired them on
+    # purpose, so they must feed neither retries nor the run-status aggregation
+    # (reference process_runs.py treats SCALED_DOWN the same way).
+    def _scaled_down(rows: List) -> bool:
+        return all(
+            r["termination_reason"] == "scaled_down"
+            and (JobStatus(r["status"]).is_finished() or r["status"] == "terminating")
+            for r in rows
+        )
+
+    replicas = {n: rows for n, rows in replicas.items() if not _scaled_down(rows)}
+    latest = {
+        k: r for k, r in latest.items() if k[0] in replicas
+    }
 
     # stop_criteria: master-done ends the run when job 0 of replica 0 finishes OK
     # (reference _should_stop_on_master_done :443).
@@ -1206,3 +1233,68 @@ async def process_metrics(db: Database) -> None:
 
     await metrics_service.collect_job_metrics(db)
     await metrics_service.sweep_metrics(db)
+
+
+# =====================================================================================
+# process_services: RPS autoscaler (parity: reference autoscalers.py:60-110 RPSAutoscaler
+# + process_runs.py scale handling; stats come from the in-server proxy)
+
+
+async def process_services(db: Database, batch: Optional[int] = None) -> None:
+    from dstack_tpu.server.services import proxy as proxy_service
+    from dstack_tpu.server.services.runs import classify_replicas, scale_run_replicas
+
+    rows = await db.fetchall(
+        "SELECT * FROM runs WHERE deleted = 0 AND status IN"
+        " ('submitted', 'provisioning', 'running')"
+        " ORDER BY last_processed_at IS NOT NULL, last_processed_at LIMIT ?",
+        (batch or settings.PROCESS_BATCH_SIZE,),
+    )
+    for run_row in rows:
+        run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+        conf = run_spec.configuration
+        if getattr(conf, "type", None) != "service" or conf.scaling is None:
+            continue
+        async with get_locker().lock(f"run:{run_row['id']}"):
+            job_rows = await db.fetchall(
+                "SELECT * FROM jobs WHERE run_id = ?", (run_row["id"],)
+            )
+            active, _ = classify_replicas(job_rows)
+
+            # Average RPS over the last minute -> target replicas (clamped).
+            import math
+
+            rps = proxy_service.stats.rps(run_row["id"], window=60.0)
+            target = math.ceil(rps / conf.scaling.target)
+            target = min(max(target, conf.replicas.min or 0), conf.replicas.max or 1)
+            diff = target - len(active)
+            if diff == 0:
+                continue
+
+            # Scale delays, derived from the DB so a server restart keeps them:
+            # last scale-up = newest job submission; last scale-down = newest
+            # scaled_down termination.
+            last_up = max(
+                (from_iso(r["submitted_at"]) for r in job_rows if r["submitted_at"]),
+                default=None,
+            )
+            last_down = max(
+                (
+                    from_iso(r["finished_at"])
+                    for r in job_rows
+                    if r["finished_at"] and r["termination_reason"] == "scaled_down"
+                ),
+                default=None,
+            )
+            last_scaled = max((t for t in (last_up, last_down) if t), default=None)
+            elapsed = (now_utc() - last_scaled).total_seconds() if last_scaled else None
+            if diff > 0 and active and elapsed is not None and elapsed < conf.scaling.scale_up_delay:
+                continue  # scale-from-zero skips the delay (reference :80-83)
+            if diff < 0 and elapsed is not None and elapsed < conf.scaling.scale_down_delay:
+                continue
+
+            await scale_run_replicas(db, run_row, diff)
+            await db.execute(
+                "UPDATE runs SET desired_replica_count = ? WHERE id = ?",
+                (target, run_row["id"]),
+            )

@@ -1,0 +1,47 @@
+"""Service proxy routes: /proxy/services/{project}/{run}/...
+
+Parity: reference server/services/proxy routers (service_proxy.py) — the
+in-server data plane for `type: service` runs. Auth follows the service's
+``auth:`` flag: enabled (default) requires a project token; disabled services
+are public through the proxy."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.runs import RunSpec
+from dstack_tpu.server.db import loads
+from dstack_tpu.server.routers._common import auth_project
+from dstack_tpu.server.services import proxy as proxy_service
+
+routes = web.RouteTableDef()
+
+
+async def _handle(request: web.Request) -> web.StreamResponse:
+    db = request.app["db"]
+    project_name = request.match_info["project_name"]
+    run_name = request.match_info["run_name"]
+    tail = request.match_info.get("tail", "")
+
+    project_row = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project_row is None:
+        raise web.HTTPNotFound(text=f"no project {project_name}")
+    run_row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise web.HTTPNotFound(text=f"no run {run_name}")
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    conf = run_spec.configuration
+    if getattr(conf, "type", None) != "service":
+        raise web.HTTPBadRequest(text=f"run {run_name} is not a service")
+    if getattr(conf, "auth", True):
+        await auth_project(request)
+
+    return await proxy_service.proxy_request(request, db, project_row, run_name, tail)
+
+
+routes.route("*", "/proxy/services/{project_name}/{run_name}/{tail:.*}")(_handle)

@@ -1,0 +1,170 @@
+"""In-server service proxy + per-service request stats (the autoscaler's input).
+
+Parity: reference server/services/proxy/ — routes
+``/proxy/services/{project}/{run}/...`` to replica app sockets over the instance
+tunnels (proxy/lib/service_connection.py:158), balancing across running replicas;
+request counts per window feed the RPS autoscaler (autoscalers.py:60-110).
+TPU re-design: replica app ports ride the same per-worker SSH tunnel pool the
+runner protocol uses (one extra forward per service port), and on the shared-host
+local backend each replica gets an ephemeral port assigned at submit time
+(jobs' ``ports_mapping``) so replicas never collide.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+import aiohttp
+from aiohttp import web
+
+from dstack_tpu.core.models.runs import JobProvisioningData, JobRuntimeData
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.server.services.jobs import job_jpd, job_jrd, job_spec as load_job_spec
+from dstack_tpu.server.services.runner import ssh as runner_ssh
+
+logger = logging.getLogger(__name__)
+
+STATS_WINDOW = 600.0  # seconds of request history kept per service
+
+
+class ServiceStats:
+    """Per-run request timestamps; in-memory (the reference keeps gateway stats
+    in-process too — a restart just resets the autoscaler's window)."""
+
+    def __init__(self) -> None:
+        self._requests: Dict[str, Deque[float]] = {}
+
+    def record(self, run_id: str, ts: Optional[float] = None) -> None:
+        dq = self._requests.setdefault(run_id, collections.deque())
+        dq.append(ts if ts is not None else time.monotonic())
+        self._trim(dq)
+
+    def rps(self, run_id: str, window: float = 60.0) -> float:
+        dq = self._requests.get(run_id)
+        if not dq:
+            return 0.0
+        self._trim(dq)
+        cutoff = time.monotonic() - window
+        n = sum(1 for t in dq if t >= cutoff)
+        return n / window
+
+    def _trim(self, dq: Deque[float]) -> None:
+        cutoff = time.monotonic() - STATS_WINDOW
+        while dq and dq[0] < cutoff:
+            dq.popleft()
+
+    def reset(self) -> None:
+        self._requests.clear()
+
+
+stats = ServiceStats()
+
+# Round-robin cursor per run.
+_rr: Dict[str, int] = {}
+
+# Hop-by-hop headers never forwarded (RFC 9110 §7.6.1).
+_HOP_HEADERS = {
+    "connection",
+    "keep-alive",
+    "proxy-authenticate",
+    "proxy-authorization",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "host",
+    "content-length",
+}
+
+
+async def list_service_replicas(
+    db: Database, project_id: str, run_name: str
+) -> List[Tuple[dict, JobProvisioningData, Optional[JobRuntimeData], int]]:
+    """(job_row, jpd, jrd, effective_port) for every RUNNING replica of a service.
+
+    The service socket lives on job 0 of each replica (the slice's worker 0 for
+    multi-host services)."""
+    rows = await db.fetchall(
+        "SELECT j.* FROM jobs j JOIN runs r ON r.id = j.run_id"
+        " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
+        "   AND j.status = 'running' AND j.job_num = 0",
+        (project_id, run_name),
+    )
+    out = []
+    for row in rows:
+        spec = load_job_spec(row)
+        if spec.service_port is None:
+            continue
+        jpd = job_jpd(row)
+        if jpd is None or jpd.hostname is None:
+            continue
+        jrd = job_jrd(row)
+        port = spec.service_port
+        if jrd is not None and jrd.ports_mapping:
+            port = jrd.ports_mapping.get(spec.service_port, port)
+        out.append((row, jpd, jrd, port))
+    return out
+
+
+async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, int]:
+    if runner_ssh.tunnel_required(jpd):
+        return await runner_ssh.tunneled_app_endpoint(jpd, port)
+    return jpd.hostname or "127.0.0.1", port
+
+
+async def proxy_request(
+    request: web.Request, db: Database, project_row, run_name: str, tail: str
+) -> web.StreamResponse:
+    """Forward one HTTP request to a replica; records the request for autoscaling
+    (recorded even when no replica is up, so scale-from-zero sees demand)."""
+    run_row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise web.HTTPNotFound(text=f"no service run {run_name}")
+    stats.record(run_row["id"])
+
+    replicas = await list_service_replicas(db, project_row["id"], run_name)
+    if not replicas:
+        raise web.HTTPServiceUnavailable(
+            text=f"service {run_name} has no running replicas"
+        )
+    cursor = _rr.get(run_row["id"], 0)
+    _rr[run_row["id"]] = cursor + 1
+    row, jpd, jrd, port = replicas[cursor % len(replicas)]
+
+    try:
+        host, local_port = await replica_endpoint(jpd, port)
+    except Exception as e:
+        logger.warning("proxy: tunnel to %s failed: %s", jpd.hostname, e)
+        raise web.HTTPBadGateway(text="replica unreachable")
+
+    url = f"http://{host}:{local_port}/{tail}"
+    if request.query_string:
+        url += f"?{request.query_string}"
+    headers = {
+        k: v for k, v in request.headers.items() if k.lower() not in _HOP_HEADERS
+    }
+    body = await request.read()
+    try:
+        timeout = aiohttp.ClientTimeout(total=300)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.request(
+                request.method, url, headers=headers, data=body, allow_redirects=False
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_chunked(64 * 1024):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+    except (aiohttp.ClientError, OSError) as e:
+        logger.warning("proxy: request to replica %s failed: %s", jpd.hostname, e)
+        raise web.HTTPBadGateway(text="replica request failed")

@@ -112,11 +112,44 @@ async def tunneled_endpoint(
         return "127.0.0.1", local_port
 
 
+async def tunneled_app_endpoint(jpd: JobProvisioningData, remote_port: int) -> Tuple[str, int]:
+    """Like tunneled_endpoint but for an arbitrary app port on the worker (service
+    sockets, dev-env servers). One tunnel per (worker, port), pooled the same way."""
+    key = f"{_key(jpd)}:app{remote_port}"
+    async with await _key_lock(key):
+        async with _lock():
+            tunnel = _pool.get(key)
+        if tunnel is not None and tunnel.is_open:
+            return "127.0.0.1", tunnel.forwards[0].local_port
+        if tunnel is not None:
+            await tunnel.close()
+            async with _lock():
+                _pool.pop(key, None)
+        local_port = allocate_local_port()
+        tunnel = SSHTunnel(
+            hostname=jpd.hostname or "",
+            username=jpd.username or "root",
+            port=jpd.ssh_port or 22,
+            identity_file=settings.SSH_IDENTITY_FILE or _server_identity(),
+            proxy=jpd.ssh_proxy,
+            forwards=[Forward(local_port, "127.0.0.1", remote_port)],
+        )
+        await tunnel.open()
+        async with _lock():
+            _pool[key] = tunnel
+        logger.debug("app tunnel up: %s (local %s)", key, local_port)
+        return "127.0.0.1", local_port
+
+
 async def close_tunnel(jpd: JobProvisioningData) -> None:
+    """Close the worker's runner tunnel AND any app-port tunnels riding it."""
+    base = _key(jpd)
     async with _lock():
-        tunnel = _pool.pop(_key(jpd), None)
-        _key_locks.pop(_key(jpd), None)
-    if tunnel is not None:
+        keys = [k for k in _pool if k == base or k.startswith(base + ":app")]
+        tunnels = [_pool.pop(k) for k in keys]
+        for k in keys:
+            _key_locks.pop(k, None)
+    for tunnel in tunnels:
         await tunnel.close()
 
 

@@ -7,7 +7,8 @@ server/background/tasks (M3 of the build plan)."""
 from __future__ import annotations
 
 import json
-from typing import List, Optional
+import logging
+from typing import Dict, List, Optional, Tuple
 
 from dstack_tpu.core.errors import (
     ResourceExistsError,
@@ -32,6 +33,8 @@ from dstack_tpu.server.db import Database, dumps, loads, new_id
 from dstack_tpu.server.services.jobs.configurators import get_job_specs
 from dstack_tpu.utils.common import from_iso, now_utc, to_iso
 from dstack_tpu.utils.random_names import generate_name
+
+logger = logging.getLogger(__name__)
 
 
 def row_to_job_submission(row) -> JobSubmission:
@@ -205,8 +208,15 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
     now = to_iso(now_utc())
     replicas = 1
     conf = run_spec.configuration
+    service_spec_json = None
     if conf.type == "service":
         replicas = conf.replicas.min or 0
+        from dstack_tpu.core.models.services import ServiceSpec
+
+        service_spec_json = ServiceSpec(
+            url=f"/proxy/services/{project_row['name']}/{run_spec.run_name}/",
+            model=conf.model,
+        ).model_dump_json()
 
     # Validate/configure all job specs before writing anything, then insert the run and
     # its jobs in one transaction so a failure can't leave an orphan 'submitted' run.
@@ -226,8 +236,11 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
             conn.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (existing["id"],))
         conn.execute(
             "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
-            " run_spec, desired_replica_count) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
-            (run_id, project_id, user_id, run_name, now, RunStatus.SUBMITTED.value, run_spec_json, replicas),
+            " run_spec, service_spec, desired_replica_count) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                run_id, project_id, user_id, run_name, now, RunStatus.SUBMITTED.value,
+                run_spec_json, service_spec_json, replicas,
+            ),
         )
         for _, job_spec in all_specs:
             conn.execute(
@@ -350,3 +363,108 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
 def _validate_run_name(name: str) -> None:
     if not name or not all(c.isalnum() or c in "-_" for c in name):
         raise ServerClientError(f"invalid run name {name!r}")
+
+
+# =====================================================================================
+# Replica scaling (parity: reference runs.py:995 scale_run_replicas)
+
+
+def _latest_by_replica(job_rows) -> Dict[int, List]:
+    """replica_num -> latest-submission job rows (ordered by job_num)."""
+    latest: Dict[tuple, dict] = {}
+    for r in job_rows:
+        key = (r["replica_num"], r["job_num"])
+        cur = latest.get(key)
+        if cur is None or r["submission_num"] > cur["submission_num"]:
+            latest[key] = r
+    replicas: Dict[int, List] = {}
+    for (replica_num, _), r in sorted(latest.items()):
+        replicas.setdefault(replica_num, []).append(r)
+    return replicas
+
+
+def classify_replicas(job_rows) -> Tuple[List[Tuple[int, int, List]], List[Tuple[int, List]]]:
+    """(active, inactive): active carries (importance, replica_num, rows) — submitted=0,
+    provisioning/pulling=1, running=2 (reference runs.py:1007-1024)."""
+    active, inactive = [], []
+    for replica_num, rows in _latest_by_replica(job_rows).items():
+        statuses = {JobStatus(r["status"]) for r in rows}
+        if JobStatus.TERMINATING in statuses or any(s.is_finished() for s in statuses):
+            inactive.append((replica_num, rows))
+        elif JobStatus.SUBMITTED in statuses:
+            active.append((0, replica_num, rows))
+        elif statuses & {JobStatus.PROVISIONING, JobStatus.PULLING}:
+            active.append((1, replica_num, rows))
+        else:
+            active.append((2, replica_num, rows))
+    # Most important first (stable by replica_num): scale-down takes from the tail.
+    active.sort(key=lambda t: (-t[0], t[1]))
+    return active, inactive
+
+
+async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
+    """Add (+diff) or remove (-diff) service replicas.
+
+    Scale-down marks the least-important replicas' jobs TERMINATING with reason
+    SCALED_DOWN (the run FSM ignores such replicas); scale-up resubmits inactive
+    replicas first, then mints new replica_nums. Inserts are per-replica-atomic
+    like the gang-retry path."""
+    if diff == 0:
+        return
+    job_rows = await db.fetchall("SELECT * FROM jobs WHERE run_id = ?", (run_row["id"],))
+    active, inactive = classify_replicas(job_rows)
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    logger.info(
+        "run %s: scaling %s by %d (active=%d)",
+        run_row["run_name"], "up" if diff > 0 else "down", abs(diff), len(active),
+    )
+
+    if diff < 0:
+        from dstack_tpu.server.services.jobs import terminate_job
+
+        for _, _, rows in reversed(active[diff:]):
+            for r in rows:
+                await terminate_job(
+                    db, r, JobTerminationReason.SCALED_DOWN, "scaled down by autoscaler"
+                )
+    else:
+        now = to_iso(now_utc())
+        scheduled = 0
+        used_nums = set(_latest_by_replica(job_rows))
+
+        async def _insert_replica(replica_num: int, specs, submission_num: int) -> None:
+            await db.executemany(
+                "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+                " submission_num, job_spec, status, submitted_at)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
+                [
+                    (
+                        new_id(),
+                        run_row["project_id"],
+                        run_row["id"],
+                        run_row["run_name"],
+                        s.job_num,
+                        replica_num,
+                        submission_num,
+                        s.model_dump_json(),
+                        now,
+                    )
+                    for s in specs
+                ],
+            )
+
+        # Revive previously scaled-down/finished replicas first (fresh submission).
+        for replica_num, rows in inactive:
+            if scheduled >= diff:
+                break
+            if any(not JobStatus(r["status"]).is_finished() for r in rows):
+                continue  # still terminating; pick a new num instead
+            specs = get_job_specs(run_spec, replica_num=replica_num)
+            await _insert_replica(replica_num, specs, rows[0]["submission_num"] + 1)
+            scheduled += 1
+        next_num = max(used_nums, default=-1) + 1
+        while scheduled < diff:
+            specs = get_job_specs(run_spec, replica_num=next_num)
+            await _insert_replica(next_num, specs, 0)
+            next_num += 1
+            scheduled += 1

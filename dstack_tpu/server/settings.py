@@ -39,6 +39,7 @@ PROCESS_FLEETS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_FLEETS_INTERVAL", 
 PROCESS_VOLUMES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_VOLUMES_INTERVAL", "5.0"))
 PROCESS_GATEWAYS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_GATEWAYS_INTERVAL", "5.0"))
 PROCESS_METRICS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_METRICS_INTERVAL", "10.0"))
+PROCESS_SERVICES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_SERVICES_INTERVAL", "5.0"))
 PROCESS_BATCH_SIZE = int(os.getenv("DSTACK_TPU_PROCESS_BATCH_SIZE", "10"))
 METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL", "3600"))
 

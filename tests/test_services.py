@@ -1,0 +1,206 @@
+"""Service data plane: in-server proxy, replica scaling, RPS autoscaler.
+
+Parity: reference server/services/proxy/ (routing over instance tunnels,
+service_connection.py:158), runs.py:995 scale_run_replicas, autoscalers.py:60-110
+RPSAutoscaler. E2E: a real service process (spawned by the real C++ agent through
+the local backend) serves HTTP through the proxy; synthetic RPS scales 1→2→1.
+"""
+
+import asyncio
+
+import pytest
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.server.services import proxy as proxy_service
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.common import api_server
+
+pytestmark = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+# A minimal HTTP app binding the port the control plane assigns (the contract:
+# services listen on DSTACK_SERVICE_PORT, which equals the configured port on
+# dedicated hosts and an ephemeral port on the shared-host local backend).
+_APP = (
+    "python3 -c \"\n"
+    "import http.server, os\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        body = ('pong:' + self.path).encode()\n"
+    "        self.send_response(200)\n"
+    "        self.send_header('Content-Length', str(len(body)))\n"
+    "        self.end_headers()\n"
+    "        self.wfile.write(body)\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', int(os.environ['DSTACK_SERVICE_PORT'])), H).serve_forever()\n"
+    "\""
+)
+
+
+async def _drive(api, passes=1):
+    for _ in range(passes):
+        await tasks.process_submitted_jobs(api.db)
+        await tasks.process_running_jobs(api.db)
+        await tasks.process_terminating_jobs(api.db)
+        await tasks.process_runs(api.db)
+        await tasks.process_instances(api.db)
+
+
+async def _drive_until_replicas(api, run_name, want_running, timeout=40.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        await _drive(api)
+        rows = await api.db.fetchall(
+            "SELECT * FROM jobs WHERE run_name = ? AND status = 'running'", (run_name,)
+        )
+        if len(rows) == want_running:
+            return rows
+        await asyncio.sleep(0.15)
+    raise AssertionError(f"never reached {want_running} running replicas")
+
+
+async def _stop_run(api, run_name):
+    await api.post(
+        f"/api/project/main/runs/stop", {"runs_names": [run_name], "abort": True}
+    )
+    for _ in range(60):
+        await _drive(api)
+        run = await api.post("/api/project/main/runs/get", {"run_name": run_name})
+        if run["status"] in ("terminated", "failed", "done"):
+            return
+        await asyncio.sleep(0.1)
+
+
+class TestServiceProxy:
+    async def test_proxy_routes_to_replica(self, tmp_path):
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        proxy_service.stats.reset()
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "svc",
+                            "configuration": {
+                                "type": "service",
+                                "commands": [_APP],
+                                "port": 8000,
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "svc", 1)
+                # The service socket takes a moment after the job turns running.
+                body = None
+                for _ in range(50):
+                    resp = await api.client.get(
+                        "/proxy/services/main/svc/hello/world?q=1",
+                        headers={"Authorization": f"Bearer {api.token}"},
+                    )
+                    if resp.status == 200:
+                        body = await resp.text()
+                        break
+                    await asyncio.sleep(0.2)
+                assert body == "pong:/hello/world?q=1"
+
+                # service_spec recorded the proxy URL.
+                run = await api.post("/api/project/main/runs/get", {"run_name": "svc"})
+                assert run["service"]["url"] == "/proxy/services/main/svc/"
+
+                # auth: default-on -> no token is a 401.
+                resp = await api.client.get("/proxy/services/main/svc/hello")
+                assert resp.status == 401
+
+                await _stop_run(api, "svc")
+        finally:
+            logs_service.set_log_storage(None)
+
+    async def test_proxy_404_for_missing_run(self):
+        async with api_server() as api:
+            resp = await api.client.get(
+                "/proxy/services/main/ghost/x",
+                headers={"Authorization": f"Bearer {api.token}"},
+            )
+            assert resp.status == 404
+
+
+class TestAutoscaler:
+    async def test_rps_scales_up_then_down(self, tmp_path):
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        proxy_service.stats.reset()
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "asvc",
+                            "configuration": {
+                                "type": "service",
+                                "commands": [_APP],
+                                "port": 8000,
+                                "replicas": "1..3",
+                                "scaling": {
+                                    "metric": "rps",
+                                    "target": 1,
+                                    "scale_up_delay": 0,
+                                    "scale_down_delay": 0,
+                                },
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "asvc", 1)
+                run_row = await api.db.fetchone(
+                    "SELECT * FROM runs WHERE run_name = 'asvc'"
+                )
+
+                # Synthetic demand: ~2 rps over the last minute -> target 2.
+                for _ in range(120):
+                    proxy_service.stats.record(run_row["id"])
+                await tasks.process_services(api.db)
+                rows = await _drive_until_replicas(api, "asvc", 2)
+                assert {r["replica_num"] for r in rows} == {0, 1}
+                run = await api.post("/api/project/main/runs/get", {"run_name": "asvc"})
+                assert run["status"] == "running"
+
+                # Proxy balances across both replicas (different assigned ports);
+                # retry while the fresh replica's socket binds.
+                ok = 0
+                for _ in range(100):
+                    resp = await api.client.get(
+                        "/proxy/services/main/asvc/ping",
+                        headers={"Authorization": f"Bearer {api.token}"},
+                    )
+                    if resp.status == 200:
+                        ok += 1
+                        if ok >= 4:
+                            break
+                    else:
+                        await asyncio.sleep(0.2)
+                assert ok >= 4
+                replicas = await proxy_service.list_service_replicas(
+                    api.db, run_row["project_id"], "asvc"
+                )
+                seen_ports = {port for *_, port in replicas}
+                assert len(seen_ports) == 2  # distinct ephemeral ports on one host
+
+                # Demand evaporates -> scale back down to min (1).
+                proxy_service.stats.reset()
+                await tasks.process_services(api.db)
+                rows = await _drive_until_replicas(api, "asvc", 1)
+                run = await api.post("/api/project/main/runs/get", {"run_name": "asvc"})
+                assert run["status"] == "running"  # scaled-down replica is not a failure
+                scaled = await api.db.fetchall(
+                    "SELECT * FROM jobs WHERE run_name = 'asvc'"
+                    " AND termination_reason = 'scaled_down'"
+                )
+                assert len(scaled) == 1
+
+                await _stop_run(api, "asvc")
+        finally:
+            logs_service.set_log_storage(None)
