@@ -1,0 +1,126 @@
+"""Client-side port forwarding over the control plane's attach bridge.
+
+Parity: reference api/_public/runs.py:244-351 (Run.attach: client-side SSH
+port-forward). TPU re-design: no instance keys needed client-side — each local
+TCP connection is piped over a WebSocket to the server, which relays to the
+worker over its pooled SSH tunnels (server/services/attach.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import List, Optional, Tuple
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+
+async def _pipe_connection(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    ws_url: str,
+    token: str,
+) -> None:
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                ws_url, headers={"Authorization": f"Bearer {token}"}, heartbeat=30
+            ) as ws:
+
+                async def local_to_ws() -> None:
+                    try:
+                        while True:
+                            data = await reader.read(64 * 1024)
+                            if not data:
+                                break
+                            await ws.send_bytes(data)
+                    except (ConnectionError, asyncio.CancelledError):
+                        pass
+                    finally:
+                        if not ws.closed:
+                            await ws.close()
+
+                pump = asyncio.ensure_future(local_to_ws())
+                try:
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            writer.write(msg.data)
+                            await writer.drain()
+                        elif msg.type in (aiohttp.WSMsgType.CLOSE, aiohttp.WSMsgType.ERROR):
+                            break
+                finally:
+                    pump.cancel()
+    except aiohttp.ClientError as e:
+        logger.warning("attach: bridge connection failed: %s", e)
+    finally:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+async def forward_port(
+    server_url: str,
+    token: str,
+    project: str,
+    run_name: str,
+    local_port: int,
+    remote_port: int,
+) -> asyncio.AbstractServer:
+    """Listen on 127.0.0.1:local_port and pipe every connection to remote_port on
+    the run's worker. Returns the asyncio server (close() to stop)."""
+    base = server_url.rstrip("/")
+    ws_url = f"{base}/api/project/{project}/runs/{run_name}/attach/{remote_port}"
+
+    async def on_connect(reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        await _pipe_connection(reader, writer, ws_url, token)
+
+    server = await asyncio.start_server(on_connect, "127.0.0.1", local_port)
+    logger.info("forwarding 127.0.0.1:%s -> %s:%s", local_port, run_name, remote_port)
+    return server
+
+
+class PortForwarder:
+    """Sync facade for the CLI: runs forward_port servers on a daemon thread."""
+
+    def __init__(
+        self,
+        server_url: str,
+        token: str,
+        project: str,
+        run_name: str,
+        forwards: List[Tuple[int, int]],  # (local_port, remote_port)
+    ) -> None:
+        self._args = (server_url, token, project, run_name)
+        self._forwards = forwards
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    def start(self) -> None:
+        def _run() -> None:
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+
+            async def _open_all() -> None:
+                for local, remote in self._forwards:
+                    await forward_port(*self._args, local, remote)
+                self._started.set()
+
+            loop.run_until_complete(_open_all())
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="attach-forwarder")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -175,9 +175,43 @@ def _confirm() -> bool:
     return answer in ("y", "yes")
 
 
-def _attach(client: Client, run_name: str) -> None:
-    """Stream status transitions + logs until the run finishes (parity: reference
-    Run.attach + CLI log streaming)."""
+def cmd_attach(args) -> None:
+    client = _client()
+    run = client.runs.get(args.run_name)
+    forwards = []
+    for f in args.forward or []:
+        local, _, remote = f.partition(":")
+        forwards.append((int(local), int(remote or local)))
+    conf = run.run_spec.configuration
+    if not forwards and getattr(conf, "type", None) == "dev-environment":
+        from dstack_tpu.core.models.configurations import DEFAULT_IDE_PORT
+
+        forwards = [(DEFAULT_IDE_PORT, DEFAULT_IDE_PORT)]
+    _attach(client, args.run_name, forwards=forwards)
+
+
+def _attach(client: Client, run_name: str, forwards=None) -> None:
+    """Stream status transitions + logs until the run finishes; optionally forward
+    ports over the control plane's attach bridge (parity: reference Run.attach +
+    attach.py:28 port-forward — but WS-bridged, see api/attach.py)."""
+    forwarder = None
+    if forwards:
+        from dstack_tpu.api.attach import PortForwarder
+
+        forwarder = PortForwarder(
+            client.url, client.token, client.project, run_name, forwards
+        )
+        forwarder.start()
+        for local, remote in forwards:
+            print(f"forwarding 127.0.0.1:{local} -> {run_name}:{remote}", file=sys.stderr)
+    try:
+        _attach_stream(client, run_name)
+    finally:
+        if forwarder is not None:
+            forwarder.stop()
+
+
+def _attach_stream(client: Client, run_name: str) -> None:
     print(f"attached to {run_name} (Ctrl-C to detach)")
     last_status = None
     line = 0
@@ -407,6 +441,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--name", help="override the run name")
     s.add_argument("--no-repo", action="store_true", help="do not upload the working tree")
     s.set_defaults(func=cmd_apply)
+
+    s = sub.add_parser("attach", help="stream logs and forward ports to a run")
+    s.add_argument("run_name")
+    s.add_argument(
+        "-L", "--forward", action="append", metavar="LOCAL[:REMOTE]",
+        help="forward 127.0.0.1:LOCAL to the run's REMOTE port (repeatable)",
+    )
+    s.set_defaults(func=cmd_attach)
 
     s = sub.add_parser("metrics", help="show a run's resource metrics")
     s.add_argument("run_name")

@@ -29,6 +29,7 @@ from dstack_tpu.core.models.services import ModelSpec, RateLimit, ScalingSpec
 
 DEFAULT_REPO_DIR = "/workflow"
 DEFAULT_TPU_IMAGE = "dstack-tpu/base:latest"  # docker/tpu image: libtpu + JAX/XLA + sshd
+DEFAULT_IDE_PORT = 8010  # dev-environment IDE backend port (attach target)
 
 
 class PortMapping(ConfigModel):

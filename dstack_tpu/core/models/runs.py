@@ -118,12 +118,17 @@ class RunTerminationReason(str, Enum):
     RETRY_LIMIT_EXCEEDED = "retry_limit_exceeded"
     STOPPED_BY_USER = "stopped_by_user"
     ABORTED_BY_USER = "aborted_by_user"
+    INACTIVITY_DURATION_EXCEEDED = "inactivity_duration_exceeded"
     SERVER_ERROR = "server_error"
 
     def to_status(self) -> RunStatus:
         if self == self.ALL_JOBS_DONE:
             return RunStatus.DONE
-        if self in (self.STOPPED_BY_USER, self.ABORTED_BY_USER):
+        if self in (
+            self.STOPPED_BY_USER,
+            self.ABORTED_BY_USER,
+            self.INACTIVITY_DURATION_EXCEEDED,
+        ):
             return RunStatus.TERMINATED
         return RunStatus.FAILED
 
@@ -134,6 +139,8 @@ class RunTerminationReason(str, Enum):
             return JobTerminationReason.TERMINATED_BY_USER
         if self == self.ABORTED_BY_USER:
             return JobTerminationReason.ABORTED_BY_USER
+        if self == self.INACTIVITY_DURATION_EXCEEDED:
+            return JobTerminationReason.INACTIVITY_DURATION_EXCEEDED
         return JobTerminationReason.TERMINATED_BY_SERVER
 
 

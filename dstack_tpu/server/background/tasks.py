@@ -778,6 +778,36 @@ async def _process_active_run(db: Database, run_row) -> None:
         k: r for k, r in latest.items() if k[0] in replicas
     }
 
+    # Dev environments stop themselves after inactivity; the attach bridge is the
+    # activity signal (reference shim connections.go + dev-env inactivity stop,
+    # process_running_jobs.py:764). Never-attached clocks run from job start.
+    if getattr(conf, "type", None) == "dev-environment" and conf.inactivity_duration:
+        from dstack_tpu.server.services.attach import activity as attach_activity
+
+        master = latest.get((0, 0))
+        if master is not None and master["status"] == "running":
+            inact = attach_activity.inactivity_secs(run_row["id"])
+            if inact is None:
+                jrd = job_jrd(master)
+                anchor = (
+                    jrd.started_at
+                    if jrd is not None and jrd.started_at
+                    else from_iso(master["submitted_at"])
+                )
+                inact = int((now_utc() - anchor).total_seconds())
+            await db.execute(
+                "UPDATE jobs SET inactivity_secs = ? WHERE id = ?", (inact, master["id"])
+            )
+            if inact >= conf.inactivity_duration:
+                logger.info(
+                    "run %s: idle for %ss (limit %ss), stopping",
+                    run_row["run_name"], inact, conf.inactivity_duration,
+                )
+                await _terminate_run(
+                    db, run_row, RunTerminationReason.INACTIVITY_DURATION_EXCEEDED
+                )
+                return
+
     # stop_criteria: master-done ends the run when job 0 of replica 0 finishes OK
     # (reference _should_stop_on_master_done :443).
     if getattr(conf, "stop_criteria", None) == StopCriteria.MASTER_DONE:

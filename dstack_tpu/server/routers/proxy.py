@@ -45,3 +45,21 @@ async def _handle(request: web.Request) -> web.StreamResponse:
 
 
 routes.route("*", "/proxy/services/{project_name}/{run_name}/{tail:.*}")(_handle)
+
+
+@routes.get("/api/project/{project_name}/runs/{run_name}/attach/{port}")
+async def attach_ws(request: web.Request) -> web.StreamResponse:
+    """TCP-over-WebSocket port forward to a run's worker (services/attach.py)."""
+    from dstack_tpu.server.services import attach as attach_service
+
+    db = request.app["db"]
+    _, project_row = await auth_project(request)
+    run_name = request.match_info["run_name"]
+    port = int(request.match_info["port"])
+    run_row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise web.HTTPNotFound(text=f"no run {run_name}")
+    return await attach_service.ws_bridge(request, db, run_row, port)

@@ -1,0 +1,140 @@
+"""Attach bridge: TCP-over-WebSocket port forwarding through the control plane.
+
+Parity: reference `dstack attach` (cli/commands/attach.py:28,
+api/_public/runs.py:244-351) forwards ports by SSHing from the client straight to
+the instance with the user's key. TPU re-design: the client rarely holds instance
+keys — but the control plane already maintains SSH tunnels to every worker, so
+attach rides them: the CLI opens local listeners and pipes each accepted
+connection over one WebSocket to the server, which pipes it on to the worker's
+port (directly for local workers, over the pooled app tunnel for cloud ones).
+
+Bridge activity doubles as the dev-environment inactivity signal (the reference
+tracks SSH connections in the shim, runner/internal/shim/connections.go): open
+bridges hold inactivity at zero, and the clock starts at the last disconnect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional
+
+from aiohttp import WSMsgType, web
+
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services.jobs import job_jpd, job_jrd, job_spec as load_job_spec
+from dstack_tpu.server.services.runner import ssh as runner_ssh
+
+logger = logging.getLogger(__name__)
+
+
+class ActivityRegistry:
+    """Per-run attach-connection bookkeeping, in-memory (a server restart resets
+    the inactivity clock — same trade-off the scale-delay derivation makes)."""
+
+    def __init__(self) -> None:
+        self._active: Dict[str, int] = {}
+        self._last_disconnect: Dict[str, float] = {}
+
+    def on_connect(self, run_id: str) -> None:
+        self._active[run_id] = self._active.get(run_id, 0) + 1
+
+    def on_disconnect(self, run_id: str) -> None:
+        n = self._active.get(run_id, 0)
+        self._active[run_id] = max(0, n - 1)
+        if self._active[run_id] == 0:
+            self._last_disconnect[run_id] = time.monotonic()
+
+    def inactivity_secs(self, run_id: str) -> Optional[int]:
+        """0 while attached; seconds since last detach; None if never attached."""
+        if self._active.get(run_id, 0) > 0:
+            return 0
+        last = self._last_disconnect.get(run_id)
+        if last is None:
+            return None
+        return int(time.monotonic() - last)
+
+    def reset(self) -> None:
+        self._active.clear()
+        self._last_disconnect.clear()
+
+
+activity = ActivityRegistry()
+
+
+async def resolve_job_endpoint(
+    db: Database, run_row, port: int, replica_num: int = 0, job_num: int = 0
+):
+    """(host, port) reaching `port` on the chosen worker, honoring ports_mapping."""
+    row = await db.fetchone(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND job_num = ?"
+        "   AND status = 'running'"
+        " ORDER BY submission_num DESC LIMIT 1",
+        (run_row["id"], replica_num, job_num),
+    )
+    if row is None:
+        return None
+    jpd = job_jpd(row)
+    if jpd is None or jpd.hostname is None:
+        return None
+    jrd = job_jrd(row)
+    effective = port
+    if jrd is not None and jrd.ports_mapping:
+        effective = jrd.ports_mapping.get(port, port)
+    if runner_ssh.tunnel_required(jpd):
+        return await runner_ssh.tunneled_app_endpoint(jpd, effective)
+    return jpd.hostname, effective
+
+
+async def ws_bridge(request: web.Request, db: Database, run_row, port: int) -> web.StreamResponse:
+    """Upgrade to WS and pipe bytes bidirectionally to the worker port."""
+    endpoint = await resolve_job_endpoint(
+        db,
+        run_row,
+        port,
+        replica_num=int(request.query.get("replica", 0)),
+        job_num=int(request.query.get("job", 0)),
+    )
+    if endpoint is None:
+        raise web.HTTPServiceUnavailable(text="no running job to attach to")
+    host, eport = endpoint
+    try:
+        reader, writer = await asyncio.open_connection(host, eport)
+    except OSError as e:
+        raise web.HTTPBadGateway(text=f"worker port {port} unreachable: {e}")
+
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+    activity.on_connect(run_row["id"])
+
+    async def tcp_to_ws() -> None:
+        try:
+            while True:
+                data = await reader.read(64 * 1024)
+                if not data:
+                    break
+                await ws.send_bytes(data)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            if not ws.closed:
+                await ws.close()
+
+    pump = asyncio.ensure_future(tcp_to_ws())
+    try:
+        async for msg in ws:
+            if msg.type == WSMsgType.BINARY:
+                writer.write(msg.data)
+                await writer.drain()
+            elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+    finally:
+        activity.on_disconnect(run_row["id"])
+        pump.cancel()
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+    return ws

@@ -11,6 +11,7 @@ from typing import List
 
 from dstack_tpu.core.errors import ServerClientError
 from dstack_tpu.core.models.configurations import (
+    DEFAULT_IDE_PORT,
     DEFAULT_TPU_IMAGE,
     DevEnvironmentConfiguration,
     ServiceConfiguration,
@@ -100,8 +101,14 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
                 retry=profile.retry,
                 requirements=_requirements(run_spec, profile),
                 app_ports=_app_ports(conf),
+                # The primary app socket: the service's port, or the dev env's IDE
+                # backend. Gets a DSTACK_SERVICE_PORT assignment at submit time.
                 service_port=(
-                    conf.port.container_port if isinstance(conf, ServiceConfiguration) else None
+                    conf.port.container_port
+                    if isinstance(conf, ServiceConfiguration)
+                    else DEFAULT_IDE_PORT
+                    if isinstance(conf, DevEnvironmentConfiguration)
+                    else None
                 ),
             )
         )
@@ -110,11 +117,20 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
 
 def _build_commands(conf) -> List[str]:
     if isinstance(conf, DevEnvironmentConfiguration):
-        # IDE bootstrap + init commands, then keep the environment alive.
+        # init, then an IDE backend on the assigned port (reference
+        # configurators/dev.py installs code-server; zero-egress hosts fall back
+        # to serving the workspace over HTTP so `attach` always has a socket).
+        # The server keeps the env alive and IS the attach target.
         return [
             *conf.init,
             f"echo 'dev environment ready ({conf.ide.value})'",
-            "tail -f /dev/null",
+            'if command -v code-server >/dev/null 2>&1; then'
+            ' echo "ide: code-server on port $DSTACK_SERVICE_PORT";'
+            ' exec code-server --bind-addr "127.0.0.1:$DSTACK_SERVICE_PORT" --auth none;'
+            " else"
+            ' echo "ide: serving workspace over http on port $DSTACK_SERVICE_PORT";'
+            ' exec python3 -m http.server "$DSTACK_SERVICE_PORT" --bind 127.0.0.1;'
+            " fi",
         ]
     return list(conf.commands)
 

@@ -1,0 +1,141 @@
+"""Attach bridge + real dev environments.
+
+Parity: reference attach port-forward (api/_public/runs.py:244-351, attach.py:28)
+and dev-env inactivity stop (configurators/dev.py, shim connections.go). The
+bridge is WS-over-the-control-plane (server/services/attach.py): a local TCP
+listener pipes through the server to the worker's port, and bridge activity
+drives the dev env's inactivity clock.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dstack_tpu.api.attach import forward_port
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import attach as attach_service
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.common import api_server
+from tests.test_services import _drive, _drive_until_replicas
+
+pytestmark = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+
+class TestAttachBridge:
+    async def test_dev_env_end_to_end(self, tmp_path):
+        """Dev env boots a real IDE-backend socket; a local forwarded port reaches
+        it through the WS bridge; after detach + idle timeout the env stops."""
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        attach_service.activity.reset()
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "dev",
+                            "configuration": {
+                                "type": "dev-environment",
+                                "ide": "vscode",
+                                "init": ["echo init-ran"],
+                                "inactivity_duration": "1s",
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "dev", 1)
+
+                server_url = str(api.client.make_url("")).rstrip("/")
+                local_srv = await forward_port(
+                    server_url, api.token, "main", "dev", 0, 8010
+                )
+                local_port = local_srv.sockets[0].getsockname()[1]
+
+                # The http.server fallback serves the workspace: GET / through the
+                # forwarded port must answer (retry while the env's socket binds).
+                status = None
+                async with aiohttp.ClientSession() as session:
+                    for _ in range(60):
+                        try:
+                            async with session.get(
+                                f"http://127.0.0.1:{local_port}/",
+                                timeout=aiohttp.ClientTimeout(total=3),
+                            ) as resp:
+                                status = resp.status
+                                if status == 200:
+                                    break
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.2)
+                assert status == 200
+
+                # While a bridge was open, inactivity was pinned at 0.
+                run_row = await api.db.fetchone("SELECT * FROM runs WHERE run_name = 'dev'")
+                # (connections are transient HTTP GETs; at least the registry saw them)
+                assert attach_service.activity.inactivity_secs(run_row["id"]) is not None
+
+                # Detach and idle out: the run stops itself.
+                local_srv.close()
+                await local_srv.wait_closed()
+                await asyncio.sleep(1.3)
+                for _ in range(60):
+                    await _drive(api)
+                    run = await api.post("/api/project/main/runs/get", {"run_name": "dev"})
+                    if run["status"] in ("terminated", "failed", "done"):
+                        break
+                    await asyncio.sleep(0.1)
+                assert run["status"] == "terminated"
+                assert run["termination_reason"] == "inactivity_duration_exceeded"
+
+                # inactivity_secs was persisted to the job for API display.
+                job = await api.db.fetchone(
+                    "SELECT * FROM jobs WHERE run_name = 'dev' ORDER BY submission_num DESC"
+                )
+                assert job["inactivity_secs"] is not None and job["inactivity_secs"] >= 1
+        finally:
+            logs_service.set_log_storage(None)
+
+    async def test_bridge_rejects_unauthenticated(self, tmp_path):
+        async with api_server() as api:
+            async with aiohttp.ClientSession() as session:
+                url = str(api.client.make_url("/api/project/main/runs/nope/attach/80"))
+                async with session.get(url) as resp:
+                    assert resp.status in (401, 403)
+
+    async def test_never_attached_dev_env_times_out_from_start(self, tmp_path):
+        """A dev env nobody ever attached to still idles out (clock anchored at
+        job start)."""
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        attach_service.activity.reset()
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "lonely",
+                            "configuration": {
+                                "type": "dev-environment",
+                                "inactivity_duration": "1s",
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "lonely", 1)
+                await asyncio.sleep(1.2)
+                for _ in range(60):
+                    await _drive(api)
+                    run = await api.post(
+                        "/api/project/main/runs/get", {"run_name": "lonely"}
+                    )
+                    if run["status"] in ("terminated", "failed", "done"):
+                        break
+                    await asyncio.sleep(0.1)
+                assert run["status"] == "terminated"
+                assert run["termination_reason"] == "inactivity_duration_exceeded"
+        finally:
+            logs_service.set_log_storage(None)
