@@ -31,8 +31,11 @@ class Compute(abc.ABC):
         instance_name: str,
         ssh_public_key: str = "",
         startup_script: Optional[str] = None,
+        volumes: Optional[List[Volume]] = None,
     ) -> List[JobProvisioningData]:
-        """Provision the slice behind `offer`; one JobProvisioningData per worker host."""
+        """Provision the slice behind `offer`; one JobProvisioningData per worker host.
+        `volumes` (when the backend supports them) attach to every host of the slice
+        at create time (TPU data disks, reference gcp/compute.py:1003-1016)."""
 
     @abc.abstractmethod
     async def terminate_slice(self, slice_id: str, region: str, backend_data: Optional[str] = None) -> None:

@@ -20,6 +20,7 @@ from dstack_tpu.backends.gcp.auth import TokenProvider
 from dstack_tpu.core.errors import BackendError
 
 API_ROOT = "https://tpu.googleapis.com/v2"
+COMPUTE_ROOT = "https://compute.googleapis.com/compute/v1"
 
 
 class GcpApiError(BackendError):
@@ -121,3 +122,29 @@ class TpuV2Client:
         return await self._t.request(
             "GET", f"{API_ROOT}/{self._parent(zone)}/acceleratorTypes"
         )
+
+    # -- persistent disks (TPU data volumes; compute API, not the TPU API) ------------
+
+    def _disk_url(self, zone: str, name: str = "") -> str:
+        base = f"{COMPUTE_ROOT}/projects/{self.project_id}/zones/{zone}/disks"
+        return f"{base}/{name}" if name else base
+
+    async def create_disk(
+        self, zone: str, name: str, size_gb: int, disk_type: str = "pd-balanced"
+    ) -> dict:
+        return await self._t.request(
+            "POST",
+            self._disk_url(zone),
+            body={
+                "name": name,
+                "sizeGb": str(size_gb),
+                "type": f"projects/{self.project_id}/zones/{zone}/diskTypes/{disk_type}",
+                "labels": {"owner": "dstack-tpu"},
+            },
+        )
+
+    async def get_disk(self, zone: str, name: str) -> dict:
+        return await self._t.request("GET", self._disk_url(zone, name))
+
+    async def delete_disk(self, zone: str, name: str) -> dict:
+        return await self._t.request("DELETE", self._disk_url(zone, name))

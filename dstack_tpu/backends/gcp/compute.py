@@ -126,6 +126,7 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
         instance_name: str,
         ssh_public_key: str = "",
         startup_script: Optional[str] = None,
+        volumes: Optional[List[Volume]] = None,
     ) -> List[JobProvisioningData]:
         spec = self._slice_spec(offer)
         zones = offer.availability_zones or TPU_ZONES.get(spec.generation, {}).get(
@@ -133,6 +134,19 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
         )
         if not zones:
             raise NoCapacityError(f"no TPU zone known for {spec.generation} in {offer.region}")
+        if volumes:
+            # A data disk is zonal: the slice must land in the disks' zone.
+            vzones = {
+                v.provisioning_data.availability_zone
+                for v in volumes
+                if v.provisioning_data is not None
+            }
+            if len(vzones) > 1:
+                raise ServerClientError(
+                    f"volumes span multiple zones ({sorted(vzones)}); one slice cannot attach them all"
+                )
+            if vzones:
+                zones = [z for z in zones if z in vzones] or sorted(vzones)
         if startup_script is None:
             startup_script = build_startup_script(
                 self.runner_url,
@@ -149,6 +163,25 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
             },
             "metadata": {"startup-script": startup_script},
             "labels": {"owner": "dstack-tpu", "dstack_name": instance_name},
+            # TPU data disks attach at node-create time and reach every host of
+            # the slice (reference gcp/compute.py:1003-1016 AttachedDisk).
+            **(
+                {
+                    "dataDisks": [
+                        {
+                            "sourceDisk": (
+                                f"projects/{self.project_id}/zones/"
+                                f"{(v.provisioning_data.availability_zone if v.provisioning_data else '')}"
+                                f"/disks/{v.provisioning_data.volume_id if v.provisioning_data else v.name}"
+                            ),
+                            "mode": "READ_WRITE",
+                        }
+                        for v in volumes
+                    ]
+                }
+                if volumes
+                else {}
+            ),
             **(
                 {"serviceAccount": {"email": self.service_account}}
                 if self.service_account
@@ -285,8 +318,58 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
 
     # -- volumes (TPU data disks; reference gcp/compute.py:1003-1016) -----------------
 
+    def _volume_zone(self, volume: Volume) -> str:
+        conf = volume.configuration
+        if conf.availability_zone:
+            return conf.availability_zone
+        zones = sorted(
+            {z for regions in TPU_ZONES.values() for z in regions.get(conf.region, [])}
+        )
+        if not zones:
+            raise ComputeError(f"no TPU zone known for region {conf.region}")
+        return zones[0]
+
     async def create_volume(self, volume: Volume) -> VolumeProvisioningData:
-        raise NotImplementedError("gcp volume support lands with the volumes subsystem")
+        zone = self._volume_zone(volume)
+        size_gb = int(volume.configuration.size or 100)
+        try:
+            await self.client.create_disk(zone, volume.name, size_gb)
+        except GcpApiError as e:
+            raise ComputeError(f"creating disk {volume.name}: {e}") from e
+        return VolumeProvisioningData(
+            backend="gcp",
+            volume_id=volume.name,
+            size_gb=size_gb,
+            availability_zone=zone,
+            # pd-balanced list price; the control plane only needs an estimate.
+            price=size_gb * 0.1 / 730.0,
+            backend_data=json.dumps({"zone": zone}),
+        )
+
+    async def register_volume(self, volume: Volume) -> VolumeProvisioningData:
+        zone = self._volume_zone(volume)
+        try:
+            disk = await self.client.get_disk(zone, volume.configuration.volume_id)
+        except GcpApiError as e:
+            raise ComputeError(f"disk {volume.configuration.volume_id} not found: {e}") from e
+        size_gb = int(disk.get("sizeGb") or 0)
+        return VolumeProvisioningData(
+            backend="gcp",
+            volume_id=volume.configuration.volume_id,
+            size_gb=size_gb,
+            availability_zone=zone,
+            price=size_gb * 0.1 / 730.0,
+            backend_data=json.dumps({"zone": zone}),
+        )
+
+    async def delete_volume(self, volume: Volume) -> None:
+        pd = volume.provisioning_data
+        zone = pd.availability_zone if pd else self._volume_zone(volume)
+        try:
+            await self.client.delete_disk(zone, pd.volume_id if pd else volume.name)
+        except GcpApiError as e:
+            if e.status != 404:
+                raise ComputeError(f"deleting disk {volume.name}: {e}") from e
 
     @staticmethod
     def _slice_spec(offer: InstanceOffer) -> TpuSliceSpec:

@@ -79,6 +79,7 @@ class LocalCompute(Compute):
         instance_name: str,
         ssh_public_key: str = "",
         startup_script: Optional[str] = None,
+        volumes=None,
     ) -> List[JobProvisioningData]:
         loop = asyncio.get_running_loop()
 
@@ -186,3 +187,27 @@ class LocalCompute(Compute):
                     proc.wait(timeout=5)
 
             await loop.run_in_executor(None, _reap)
+
+    # -- volumes: a "disk" is a host directory (dev parity for the data-disk path) ----
+
+    async def create_volume(self, volume):
+        import json as _json
+
+        from dstack_tpu.core.models.volumes import VolumeProvisioningData
+
+        host_dir = tempfile.mkdtemp(prefix=f"dstack-tpu-vol-{volume.name}-")
+        return VolumeProvisioningData(
+            backend="local",
+            volume_id=host_dir,
+            size_gb=float(volume.configuration.size or 1),
+            availability_zone="local",
+            price=0.0,
+            backend_data=_json.dumps({"host_dir": host_dir}),
+        )
+
+    async def delete_volume(self, volume) -> None:
+        import shutil
+
+        pd = volume.provisioning_data
+        if pd is not None and pd.volume_id and os.path.isdir(pd.volume_id):
+            shutil.rmtree(pd.volume_id, ignore_errors=True)

@@ -25,6 +25,9 @@ class MockTpuCompute(Compute):
         self.regions = regions
         self.created: List[str] = []
         self.terminated: List[str] = []
+        self.created_volumes: List[str] = []
+        self.deleted_volumes: List[str] = []
+        self.slice_volumes: Dict[str, List[str]] = {}  # slice_id -> volume names
 
     async def get_offers(self, requirements: Requirements, regions: Optional[List[str]] = None) -> List[InstanceOffer]:
         return catalog.get_catalog_offers(
@@ -37,6 +40,7 @@ class MockTpuCompute(Compute):
         instance_name: str,
         ssh_public_key: str = "",
         startup_script: Optional[str] = None,
+        volumes=None,
     ) -> List[JobProvisioningData]:
         if self.fail_provision:
             from dstack_tpu.core.errors import NoCapacityError
@@ -45,6 +49,8 @@ class MockTpuCompute(Compute):
         n = next(_counter)
         slice_id = f"mock-slice-{n}"
         self.created.append(slice_id)
+        if volumes:
+            self.slice_volumes[slice_id] = [v.name for v in volumes]
         return [
             JobProvisioningData(
                 backend="mock",
@@ -67,3 +73,31 @@ class MockTpuCompute(Compute):
 
     async def terminate_slice(self, slice_id: str, region: str, backend_data: Optional[str] = None) -> None:
         self.terminated.append(slice_id)
+
+    # -- volumes (instant-provision fakes for scheduler tests) ------------------------
+
+    async def create_volume(self, volume):
+        from dstack_tpu.core.models.volumes import VolumeProvisioningData
+
+        self.created_volumes.append(volume.name)
+        return VolumeProvisioningData(
+            backend="mock",
+            volume_id=f"mock-disk-{volume.name}",
+            size_gb=float(volume.configuration.size or 100),
+            availability_zone=f"{volume.configuration.region}-a",
+            price=0.0,
+        )
+
+    async def register_volume(self, volume):
+        from dstack_tpu.core.models.volumes import VolumeProvisioningData
+
+        return VolumeProvisioningData(
+            backend="mock",
+            volume_id=volume.configuration.volume_id,
+            size_gb=100,
+            availability_zone=f"{volume.configuration.region}-a",
+            price=0.0,
+        )
+
+    async def delete_volume(self, volume) -> None:
+        self.deleted_volumes.append(volume.name)

@@ -166,6 +166,17 @@ class RunSpec(CoreModel):
         return merge_profiles(self.profile, self.configuration.inline_profile())
 
 
+class VolumeMount(CoreModel):
+    """A volume mount as the agent sees it: where to put it, and how the host
+    exposes it (a block device on cloud workers — /dev/disk/by-id/google-<id> for
+    GCP data disks — or a host directory on the local backend)."""
+
+    name: str
+    path: str
+    device: Optional[str] = None
+    host_dir: Optional[str] = None
+
+
 class JobSpec(CoreModel):
     replica_num: int = 0
     job_num: int = 0
@@ -191,6 +202,10 @@ class JobSpec(CoreModel):
     requirements: Requirements
     app_ports: List[int] = Field(default_factory=list)
     service_port: Optional[int] = None
+    # Volume mounts; device/host_dir are resolved by the server at submit time.
+    volumes: List[VolumeMount] = Field(default_factory=list)
+    # Host-directory bind mounts (instance_path:path).
+    instance_mounts: List[Dict[str, str]] = Field(default_factory=list)
 
 
 class JobProvisioningData(CoreModel):

@@ -79,4 +79,9 @@ def start_background_tasks(app: web.Application) -> BackgroundScheduler:
         settings.PROCESS_SERVICES_INTERVAL,
         "process_services",
     )
+    sched.add_periodic(
+        lambda: tasks.process_volumes(db),
+        settings.PROCESS_VOLUMES_INTERVAL,
+        "process_volumes",
+    )
     return sched

@@ -130,6 +130,30 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
         )
         fleet_ids = [r["id"] for r in frows]
 
+    # Requested volumes must be active before placement; a volume still
+    # provisioning parks the gang for the next pass (process_volumes drives it).
+    run_volumes = []
+    if spec0.volumes:
+        from dstack_tpu.server.services import volumes as volumes_service
+
+        for m in spec0.volumes:
+            vrow = await volumes_service.get_volume_row(db, run_row["project_id"], m.name)
+            if vrow is None:
+                for j in job_rows:
+                    await set_job_status(
+                        db, j, JobStatus.TERMINATING,
+                        JobTerminationReason.VOLUME_ERROR,
+                        f"volume {m.name} does not exist",
+                    )
+                return
+            if vrow["status"] != "active":
+                for j in job_rows:
+                    await _touch(db, j)
+                return
+            run_volumes.append(
+                await volumes_service.row_to_volume(db, vrow, project_row["name"])
+            )
+
     # Slice-by-slice gang placement. job_num w of slice s is job_rows[s*hosts+w].
     num_slices = max(1, len(job_rows) // max(1, hosts_per_slice))
     idle_slices = await instances_service.find_idle_slices(
@@ -151,6 +175,14 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
         # process_submitted_jobs.py:344 _assign_job_to_pool_instance). Mark-busy and
         # the gang's assignments commit in one transaction: a crash mid-pass must not
         # leave a busy slice with unassigned jobs (or vice versa).
+        # TPU data disks attach at slice-create time only: a volume-backed gang can
+        # reuse a slice only if that slice already carries ALL its volumes.
+        if run_volumes and idle_slices:
+            idle_slices = [
+                ws
+                for ws in idle_slices
+                if await _slice_has_volumes(db, ws, run_volumes)
+            ]
         if idle_slices:
             workers = idle_slices.pop(0)
 
@@ -170,7 +202,9 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
                 db, project_row, requirements, profile
             )
             offers = [o for o in offers if o.availability.is_available()]
-        created = await _provision_slice(db, project_row, run_row, run_spec, offers, slice_jobs)
+        created = await _provision_slice(
+            db, project_row, run_row, run_spec, offers, slice_jobs, volumes=run_volumes
+        )
         if not created:
             placed_all = False
 
@@ -186,8 +220,36 @@ def _assign_job_tx(conn, job_row, instance_id: str, jpd_dict: dict) -> None:
     )
 
 
+async def _slice_has_volumes(db: Database, workers: List, volumes: List) -> bool:
+    """True when every volume is attached to every worker of the slice."""
+    ids = [w["id"] for w in workers]
+    for vol in volumes:
+        rows = await db.fetchall(
+            f"SELECT instance_id FROM volume_attachments WHERE volume_id = ?"
+            f" AND instance_id IN ({','.join('?' for _ in ids)})",
+            [str(vol.id), *ids],
+        )
+        if len(rows) < len(ids):
+            return False
+    return True
+
+
+def _volume_attachment_data(volume) -> dict:
+    """How the host exposes the disk (device path / host dir), per backend."""
+    pd = volume.provisioning_data
+    backend = pd.backend if pd else None
+    if backend == "gcp":
+        # GCE guarantees stable by-id naming for attached persistent disks.
+        return {"device_name": f"/dev/disk/by-id/google-{pd.volume_id}"}
+    if backend == "local":
+        data = json.loads(pd.backend_data) if pd.backend_data else {}
+        return {"host_dir": data.get("host_dir")}
+    return {"device_name": f"/dev/disk/dstack/{volume.name}"}
+
+
 async def _provision_slice(
-    db: Database, project_row, run_row, run_spec: RunSpec, offers: List[InstanceOffer], slice_jobs: List
+    db: Database, project_row, run_row, run_spec: RunSpec, offers: List[InstanceOffer],
+    slice_jobs: List, volumes: Optional[List] = None,
 ) -> bool:
     """Try offers in price order until a slice provisions; create instance rows and
     assign the gang. Returns False when every offer fails with no capacity.
@@ -208,7 +270,7 @@ async def _provision_slice(
         keys = [k for k in (run_spec.ssh_key_pub, _server_public_key()) if k]
         try:
             jpds = await compute.create_slice(
-                offer, name, ssh_public_key="\n".join(keys)
+                offer, name, ssh_public_key="\n".join(keys), volumes=volumes or None
             )
         except NoCapacityError as e:
             logger.debug("offer %s/%s no capacity: %s", offer.backend, offer.instance.name, e)
@@ -238,6 +300,16 @@ async def _provision_slice(
                 )
             for jpd, iid, j_row in zip(jpds, ids, slice_jobs):
                 _assign_job_tx(conn, j_row, iid, json.loads(jpd.model_dump_json()))
+            # Volumes attached at create time: record one attachment per
+            # (volume, worker) — a TPU data disk reaches every host of the slice.
+            for vol in volumes or []:
+                data = json.dumps(_volume_attachment_data(vol))
+                for iid in ids:
+                    conn.execute(
+                        "INSERT OR REPLACE INTO volume_attachments"
+                        " (volume_id, instance_id, attachment_data) VALUES (?, ?, ?)",
+                        (str(vol.id), iid, data),
+                    )
 
         await db.run(_commit_placement)
         return True
@@ -410,6 +482,21 @@ async def _process_provisioning(db: Database, job_row) -> None:
             assigned = jrd.ports_mapping.get(spec.service_port) or allocate_local_port()
         jrd.ports_mapping[spec.service_port] = assigned
         spec.env["DSTACK_SERVICE_PORT"] = str(assigned)
+    # Volume mounts: resolve how THIS worker's host exposes each disk (device
+    # path for cloud data disks, host dir on the local backend) from the
+    # attachments the placement recorded.
+    if spec.volumes and job_row["instance_id"]:
+        att_rows = await db.fetchall(
+            "SELECT va.attachment_data, v.name AS vol_name FROM volume_attachments va"
+            " JOIN volumes v ON v.id = va.volume_id WHERE va.instance_id = ?",
+            (job_row["instance_id"],),
+        )
+        by_name = {a["vol_name"]: loads(a["attachment_data"]) or {} for a in att_rows}
+        for m in spec.volumes:
+            data = by_name.get(m.name, {})
+            m.device = data.get("device_name")
+            m.host_dir = data.get("host_dir")
+        jrd.volume_names = [m.name for m in spec.volumes]
     await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
     code = await _get_code(db, job_row["project_id"], run_spec)
     if code:
@@ -1242,6 +1329,12 @@ async def _terminate_slice_when_drained(db: Database, row) -> None:
         f" ({','.join('?' for _ in ids)})",
         [now, *ids],
     )
+    # The slice's data disks detach with the node (delete QR releases them);
+    # drop the bookkeeping so the volume shows unattached and can be deleted.
+    await db.execute(
+        f"DELETE FROM volume_attachments WHERE instance_id IN ({','.join('?' for _ in ids)})",
+        ids,
+    )
 
 
 async def _cleanup_auto_fleets(db: Database) -> None:
@@ -1328,3 +1421,78 @@ async def process_services(db: Database, batch: Optional[int] = None) -> None:
                 "UPDATE runs SET desired_replica_count = ? WHERE id = ?",
                 (target, run_row["id"]),
             )
+
+
+# =====================================================================================
+# process_volumes (parity: reference background/tasks/process_volumes.py —
+# submitted -> provisioning -> active via the backend, auto-cleanup of idle volumes)
+
+
+async def process_volumes(db: Database, batch: Optional[int] = None) -> None:
+    from dstack_tpu.core.models.volumes import VolumeStatus
+    from dstack_tpu.server.services import volumes as volumes_service
+
+    rows = await db.fetchall(
+        "SELECT * FROM volumes WHERE deleted = 0 AND status IN ('submitted', 'provisioning')"
+        " LIMIT ?",
+        (batch or settings.PROCESS_BATCH_SIZE,),
+    )
+    for row in rows:
+        project_row = await db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        volume = await volumes_service.row_to_volume(db, row, project_row["name"])
+        conf = volume.configuration
+        try:
+            compute = await backends_service.get_compute(db, project_row, conf.backend)
+            if volume.external:
+                pd = await compute.register_volume(volume)
+            else:
+                pd = await compute.create_volume(volume)
+        except NotImplementedError:
+            await db.execute(
+                "UPDATE volumes SET status = 'failed', status_message = ? WHERE id = ?",
+                (f"backend {conf.backend} has no volume support", row["id"]),
+            )
+            continue
+        except Exception as e:
+            logger.warning("volume %s provisioning failed: %s", row["name"], e)
+            await db.execute(
+                "UPDATE volumes SET status = 'failed', status_message = ? WHERE id = ?",
+                (str(e)[:500], row["id"]),
+            )
+            continue
+        await db.execute(
+            "UPDATE volumes SET status = ?, volume_id = ?, provisioning_data = ?,"
+            " last_job_processed_at = ? WHERE id = ?",
+            (
+                VolumeStatus.ACTIVE.value,
+                pd.volume_id,
+                pd.model_dump_json(),
+                to_iso(now_utc()),
+                row["id"],
+            ),
+        )
+        logger.info("volume %s active (%s)", row["name"], pd.volume_id)
+
+    # Auto-cleanup: unattached active volumes past their idle duration.
+    idle_rows = await db.fetchall(
+        "SELECT v.* FROM volumes v WHERE v.deleted = 0 AND v.status = 'active'"
+        " AND NOT EXISTS (SELECT 1 FROM volume_attachments a WHERE a.volume_id = v.id)"
+    )
+    for row in idle_rows:
+        volume = await volumes_service.row_to_volume(db, row)
+        duration = volume.configuration.auto_cleanup_duration
+        if not duration:
+            continue
+        anchor = from_iso(row["last_job_processed_at"]) or from_iso(row["created_at"])
+        if (now_utc() - anchor).total_seconds() < duration:
+            continue
+        project_row = await db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        logger.info("volume %s idle past %ss; deleting", row["name"], duration)
+        try:
+            await volumes_service.delete_volumes(db, project_row, [row["name"]])
+        except Exception as e:
+            logger.warning("volume %s auto-cleanup failed: %s", row["name"], e)

@@ -14,8 +14,10 @@ from dstack_tpu.core.models.configurations import (
     DEFAULT_IDE_PORT,
     DEFAULT_TPU_IMAGE,
     DevEnvironmentConfiguration,
+    InstanceMountPoint,
     ServiceConfiguration,
     TaskConfiguration,
+    VolumeMountPoint,
 )
 from dstack_tpu.core.models.profiles import Profile
 from dstack_tpu.core.models.runs import JobSpec, Requirements, RunSpec
@@ -101,6 +103,16 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
                 retry=profile.retry,
                 requirements=_requirements(run_spec, profile),
                 app_ports=_app_ports(conf),
+                volumes=[
+                    {"name": m.name, "path": m.path}
+                    for m in conf.volumes
+                    if isinstance(m, VolumeMountPoint)
+                ],
+                instance_mounts=[
+                    {"instance_path": m.instance_path, "path": m.path}
+                    for m in conf.volumes
+                    if isinstance(m, InstanceMountPoint)
+                ],
                 # The primary app socket: the service's port, or the dev env's IDE
                 # backend. Gets a DSTACK_SERVICE_PORT assignment at submit time.
                 service_port=(

@@ -204,6 +204,19 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
             f"run {run_spec.run_name} already exists and is {existing['status']}"
         )
 
+    # Referenced volumes must exist up front (fail fast; activation is async).
+    from dstack_tpu.core.models.configurations import VolumeMountPoint
+
+    for mount in getattr(run_spec.configuration, "volumes", []) or []:
+        if isinstance(mount, VolumeMountPoint):
+            from dstack_tpu.server.services import volumes as volumes_service
+
+            vrow = await volumes_service.get_volume_row(db, project_row["id"], mount.name)
+            if vrow is None:
+                raise ResourceNotExistsError(
+                    f"volume {mount.name} does not exist; create it first"
+                )
+
     run_id = new_id()
     now = to_iso(now_utc())
     replicas = 1

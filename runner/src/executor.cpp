@@ -304,6 +304,24 @@ static std::vector<std::string> cluster_env(const dj::Json& ci) {
   return env;
 }
 
+// Shell snippet readying one volume on the host: format-if-empty + mount for
+// block devices (reference shim/docker.go:542 formatAndMountVolume), symlink for
+// host-dir volumes (local backend).
+static std::string volume_prep_cmds(const dj::Json& v, const std::string& mount_path) {
+  const std::string& dev = v["device"].as_string();
+  const std::string& host_dir = v["host_dir"].as_string();
+  std::string s;
+  if (!dev.empty()) {
+    s += "if ! blkid '" + dev + "' >/dev/null 2>&1; then mkfs.ext4 -q '" + dev + "'; fi\n";
+    s += "mkdir -p '" + mount_path + "'\n";
+    s += "mountpoint -q '" + mount_path + "' || mount '" + dev + "' '" + mount_path + "'\n";
+  } else if (!host_dir.empty()) {
+    s += "mkdir -p \"$(dirname '" + mount_path + "')\" 2>/dev/null || true\n";
+    s += "[ -e '" + mount_path + "' ] || ln -sfn '" + host_dir + "' '" + mount_path + "'\n";
+  }
+  return s;
+}
+
 std::string Executor::build_script() const {
   // Join commands into one shell script (reference joins with && semantics via sh -c;
   // we use strict mode so any failing command fails the job).
@@ -433,6 +451,30 @@ void Executor::exec_container(uint64_t generation) {
       host.set("Privileged", job_spec_["privileged"].as_bool());
       dj::Json binds = dj::Json::array();
       binds.push_back(repo_dir + ":/workflow");
+      // Volume mounts: host dirs bind directly; block devices are readied on the
+      // host first (mounted under base_dir), then bound (the shim pattern:
+      // docker.go:505-575 prepareVolumes + getVolumeMounts).
+      for (const auto& v : job_spec_["volumes"].as_array()) {
+        const std::string& vpath = v["path"].as_string();
+        if (vpath.empty()) continue;
+        const std::string& host_dir = v["host_dir"].as_string();
+        if (!host_dir.empty()) {
+          binds.push_back(host_dir + ":" + vpath);
+        } else if (!v["device"].as_string().empty()) {
+          std::string mnt = base_dir_ + "/mnt-" + v["name"].as_string();
+          std::string prep = volume_prep_cmds(v, mnt);
+          std::string cmd = "sh -c '" + prep + "'";
+          if (system(cmd.c_str()) != 0) {
+            add_log("warning: preparing volume " + v["name"].as_string() + " failed\n");
+          }
+          binds.push_back(mnt + ":" + vpath);
+        }
+      }
+      for (const auto& im : job_spec_["instance_mounts"].as_array()) {
+        if (!im["instance_path"].as_string().empty() && !im["path"].as_string().empty()) {
+          binds.push_back(im["instance_path"].as_string() + ":" + im["path"].as_string());
+        }
+      }
       host.set("Binds", std::move(binds));
       // TPU chips reach the container as device files, the TPU analog of the
       // reference's GPU device requests (shim/docker.go:1008-1102).
@@ -532,7 +574,13 @@ void Executor::exec_host(uint64_t generation) {
   add_state("running");
   std::string repo_dir = extract_code();
 
-  std::string script = build_script();
+  // Ready volume mounts before the user's commands (host path: mounts happen in
+  // the job shell itself, which runs as the host user).
+  std::string prep;
+  for (const auto& v : job_spec_["volumes"].as_array()) {
+    if (!v["path"].as_string().empty()) prep += volume_prep_cmds(v, v["path"].as_string());
+  }
+  std::string script = prep + build_script();
 
   std::string workdir = repo_dir;
   if (!job_spec_["working_dir"].is_null() && !job_spec_["working_dir"].as_string().empty()) {

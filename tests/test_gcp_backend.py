@@ -408,3 +408,72 @@ class TestAuth:
             ),
             ServiceAccountTokenProvider,
         )
+
+
+class TestVolumes:
+    """TPU data disks: created via the compute API, attached at QR-create time,
+    slice pinned to the disk's zone (reference gcp/compute.py:1003-1016)."""
+
+    async def test_create_volume_calls_disk_api(self):
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.core.models.volumes import Volume, VolumeStatus
+        import datetime
+        import uuid
+
+        t = FakeTransport()
+        gcp = make_gcp(t)
+        vol = Volume(
+            id=uuid.uuid4(),
+            name="data",
+            project_name="main",
+            configuration=VolumeConfiguration(
+                type="volume", name="data", backend="gcp", region="us-east5", size="100GB"
+            ),
+            created_at=datetime.datetime.now(datetime.timezone.utc),
+            status=VolumeStatus.SUBMITTED,
+        )
+        pd = await gcp.create_volume(vol)
+        assert pd.volume_id == "data"
+        assert pd.availability_zone == "us-east5-a"
+        assert pd.size_gb == 100
+        [(method, url, body, _)] = [r for r in t.requests if "/disks" in r[1]]
+        assert method == "POST"
+        assert "compute.googleapis.com" in url and "zones/us-east5-a/disks" in url
+        assert body["name"] == "data" and body["sizeGb"] == "100"
+
+    async def test_create_slice_attaches_data_disks_in_disk_zone(self):
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.core.models.volumes import (
+            Volume,
+            VolumeProvisioningData,
+            VolumeStatus,
+        )
+        import datetime
+        import uuid
+
+        t = FakeTransport()
+        gcp = make_gcp(t)
+        vol = Volume(
+            id=uuid.uuid4(),
+            name="data",
+            project_name="main",
+            configuration=VolumeConfiguration(
+                type="volume", name="data", backend="gcp", region="us-east5", size="100GB"
+            ),
+            created_at=datetime.datetime.now(datetime.timezone.utc),
+            status=VolumeStatus.ACTIVE,
+            provisioning_data=VolumeProvisioningData(
+                backend="gcp", volume_id="data", availability_zone="us-east5-b"
+            ),
+        )
+        offers = await gcp.get_offers(make_requirements("v5p-16"))
+        jpds = await gcp.create_slice(offers[0], "vslice", volumes=[vol])
+        assert jpds[0].availability_zone == "us-east5-b"  # pinned to the disk's zone
+        [(_, _, body, _)] = [r for r in t.requests if "queuedResources" in r[1] and r[0] == "POST"]
+        node = body["tpu"]["nodeSpec"][0]["node"]
+        assert node["dataDisks"] == [
+            {
+                "sourceDisk": "projects/proj-1/zones/us-east5-b/disks/data",
+                "mode": "READ_WRITE",
+            }
+        ]

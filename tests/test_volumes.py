@@ -1,0 +1,238 @@
+"""Volumes v1: lifecycle loop, backend create/register/delete, slice attach,
+scheduler mounts, local-backend persistence.
+
+Parity: reference services/volumes.py + process_volumes.py + TPU data disks
+(gcp/compute.py:1003-1016 — disks attach at node-create time to every host of
+the slice)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+    tpu_task_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fake_runner(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    yield
+
+
+VOLUME_CONF = {
+    "configuration": {
+        "type": "volume",
+        "name": "data",
+        "backend": "mock",
+        "region": "us-east5",
+        "size": "100GB",
+    }
+}
+
+
+class TestVolumeLifecycle:
+    async def test_create_activate_delete(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            vol = await api.post("/api/project/main/volumes/create", VOLUME_CONF)
+            assert vol["status"] == "submitted"
+            await tasks.process_volumes(api.db)
+            vol = await api.post("/api/project/main/volumes/get", {"name": "data"})
+            assert vol["status"] == "active"
+            assert vol["volume_id"] == "mock-disk-data"
+            assert vol["provisioning_data"]["availability_zone"] == "us-east5-a"
+
+            compute = dict(
+                await backends_service.get_project_computes(
+                    api.db, await api.db.fetchone("SELECT * FROM projects")
+                )
+            )["mock"]
+            assert compute.created_volumes == ["data"]
+
+            await api.post("/api/project/main/volumes/delete", {"names": ["data"]})
+            assert compute.deleted_volumes == ["data"]
+
+    async def test_register_external_disk(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/volumes/create",
+                {
+                    "configuration": {
+                        "type": "volume",
+                        "name": "ext",
+                        "backend": "mock",
+                        "region": "us-east5",
+                        "volume_id": "pre-existing-disk",
+                    }
+                },
+            )
+            await tasks.process_volumes(api.db)
+            vol = await api.post("/api/project/main/volumes/get", {"name": "ext"})
+            assert vol["status"] == "active"
+            assert vol["external"] is True
+            assert vol["volume_id"] == "pre-existing-disk"
+
+    async def test_unconfigured_backend_fails_volume(self):
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/volumes/create",
+                {
+                    "configuration": {
+                        "type": "volume",
+                        "name": "bad",
+                        "backend": "gcp",
+                        "region": "us-east5",
+                        "size": "10GB",
+                    }
+                },
+            )
+            await tasks.process_volumes(api.db)
+            vol = await api.post("/api/project/main/volumes/get", {"name": "bad"})
+            assert vol["status"] == "failed"
+            assert "gcp" in vol["status_message"]
+
+
+class TestVolumeScheduling:
+    async def test_slice_run_mounts_volume_on_all_hosts(self, monkeypatch):
+        monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/volumes/create", VOLUME_CONF)
+            await tasks.process_volumes(api.db)
+
+            # v5p-16 = 2 hosts: the data disk must reach BOTH workers.
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("vt", "v5p-16", volumes=["data:/data"]),
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "vt"})
+            assert run["status"] == "done", run.get("termination_reason")
+
+            vrow = await api.db.fetchone("SELECT * FROM volumes WHERE name = 'data'")
+            # Attachments recorded per worker, then cleaned when the slice retired...
+            fakes = list(FakeRunnerClient.registry.values())
+            assert len(fakes) == 2
+            for fake in fakes:
+                [mount] = fake.submitted.volumes
+                assert mount.path == "/data"
+                assert mount.device == "/dev/disk/dstack/data"
+
+            compute = dict(
+                await backends_service.get_project_computes(
+                    api.db, await api.db.fetchone("SELECT * FROM projects")
+                )
+            )["mock"]
+            # The slice was created WITH the volume (attach-at-create, not hot).
+            assert list(compute.slice_volumes.values()) == [["data"]]
+
+            att = await api.db.fetchall("SELECT * FROM volume_attachments")
+            assert len(att) == 2
+            for a in att:
+                assert json.loads(a["attachment_data"])["device_name"] == "/dev/disk/dstack/data"
+
+    async def test_volume_backed_gang_does_not_reuse_bare_slice(self, monkeypatch):
+        """An idle slice without the volume cannot host a volume-backed gang —
+        data disks attach at create time only."""
+        monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            # First run provisions a bare slice and returns it to the pool.
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("bare", "v5p-16"))
+            await drive(api.db)
+            idle = await api.db.fetchall("SELECT * FROM instances WHERE status = 'idle'")
+            assert len(idle) == 2
+
+            await api.post("/api/project/main/volumes/create", VOLUME_CONF)
+            await tasks.process_volumes(api.db)
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("vt2", "v5p-16", volumes=["data:/data"]),
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "vt2"})
+            assert run["status"] == "done"
+            compute = dict(
+                await backends_service.get_project_computes(
+                    api.db, await api.db.fetchone("SELECT * FROM projects")
+                )
+            )["mock"]
+            # A SECOND slice was created (with the volume); the bare one was not reused.
+            assert len(compute.created) == 2
+            assert len(compute.slice_volumes) == 1
+
+    async def test_missing_volume_rejected_at_submit(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            resp = await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("ghostly", "v5p-16", volumes=["ghost:/data"]),
+                expect=404,
+            )
+            assert "ghost" in str(resp)
+
+
+@pytest.mark.skipif(find_runner_binary() is None, reason="native runner binary unavailable")
+class TestLocalVolumeE2E:
+    async def test_job_writes_persist_into_volume_dir(self, tmp_path):
+        """Local backend: the volume is a host dir; the agent links it at the mount
+        path and job writes land in it."""
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/volumes/create",
+                    {
+                        "configuration": {
+                            "type": "volume",
+                            "name": "scratch",
+                            "backend": "local",
+                            "region": "local",
+                            "size": "1GB",
+                        }
+                    },
+                )
+                await tasks.process_volumes(api.db)
+                vol = await api.post("/api/project/main/volumes/get", {"name": "scratch"})
+                assert vol["status"] == "active"
+                host_dir = json.loads(vol["provisioning_data"]["backend_data"])["host_dir"]
+
+                mount_path = str(tmp_path / "mnt" / "scratch")
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "vol-e2e",
+                            "configuration": {
+                                "type": "task",
+                                "commands": [f"echo persisted-data > {mount_path}/out.txt"],
+                                "volumes": [f"scratch:{mount_path}"],
+                            },
+                        }
+                    },
+                )
+                for _ in range(100):
+                    await drive(api.db, passes=1)
+                    run = await api.post(
+                        "/api/project/main/runs/get", {"run_name": "vol-e2e"}
+                    )
+                    if run["status"] in ("done", "failed", "terminated"):
+                        break
+                    await asyncio.sleep(0.1)
+                assert run["status"] == "done"
+                with open(f"{host_dir}/out.txt") as f:
+                    assert f.read().strip() == "persisted-data"
+        finally:
+            logs_service.set_log_storage(None)
