@@ -47,6 +47,32 @@ def make_mesh(
     return Mesh(arr, MESH_AXES)
 
 
+def make_multislice_mesh(
+    num_slices: int,
+    dp: int = 1,
+    fsdp: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    devices=None,
+) -> Mesh:
+    """Multislice mesh: the LEADING dp axis spans slices over DCN (MegaScale);
+    everything inside stays on one slice's ICI.
+
+    The scaling-book multislice recipe: only pure data parallelism crosses the
+    slow DCN hop, so the device array is ordered slice-major (on TPU hardware,
+    sorted by ``device.slice_index``) and the dp axis absorbs the slice count —
+    XLA then emits the cross-slice gradient all-reduce over DCN and every other
+    collective over ICI. Cluster env contract: MEGASCALE_NUM_SLICES/SLICE_ID
+    (runner/src/executor.cpp cluster_env)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) % num_slices != 0:
+        raise ValueError(f"{len(devices)} devices not divisible by {num_slices} slices")
+    # Group slice-major so contiguous blocks of the leading axis are one slice.
+    if getattr(devices[0], "slice_index", None) is not None:
+        devices = sorted(devices, key=lambda d: (d.slice_index, d.id))
+    return make_mesh(dp=num_slices * dp, fsdp=fsdp, tp=tp, sp=sp, devices=devices)
+
+
 # Logical -> physical sharding rules for the stacked-layer parameter tree (model.py).
 # Layer-stacked tensors carry a leading L axis that stays unsharded.
 PARAM_SPECS: Dict[str, P] = {

@@ -1,0 +1,131 @@
+"""Multislice gang scheduling (BASELINE config #5).
+
+One run spanning N slices: the replica's jobs fan out slice-by-slice, every
+worker receives the MegaScale DCN contract (MEGASCALE_NUM_SLICES / SLICE_ID /
+coordinator anchored at slice 0 worker 0), and any slice failure requeues the
+WHOLE multislice gang — a MegaScale program cannot survive a partial restart.
+Parity: reference cluster env contract (executor.go:262-274) extended to
+multislice, which the reference does not orchestrate at all."""
+
+import pytest
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fake_runner(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+    yield
+
+
+def multislice_spec(run_name: str, count: int = 2, **conf) -> dict:
+    return {
+        "run_spec": {
+            "run_name": run_name,
+            "configuration": {
+                "type": "task",
+                "commands": ["python train.py"],
+                # v5p 8 chips = 2 hosts per slice; count slices.
+                "resources": {"tpu": {"generation": "v5p", "chips": 8, "count": count}},
+                **conf,
+            },
+        }
+    }
+
+
+class TestMultislice:
+    async def test_two_slice_gang_runs_with_megascale_env(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", multislice_spec("ms", 2))
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "ms"})
+            assert run["status"] == "done", run.get("termination_reason")
+
+            # Two distinct slices provisioned, 2 workers each.
+            compute = dict(
+                await backends_service.get_project_computes(
+                    api.db, await api.db.fetchone("SELECT * FROM projects")
+                )
+            )["mock"]
+            assert len(compute.created) == 2
+            inst = await api.db.fetchall("SELECT * FROM instances")
+            assert len(inst) == 4
+            assert len({r["slice_id"] for r in inst}) == 2
+
+            # Every worker got the MegaScale contract; slice ids split 2/2; the
+            # coordinator anchors at slice 0 worker 0 for everyone.
+            fakes = sorted(
+                FakeRunnerClient.registry.values(), key=lambda f: f.cluster_info.node_rank
+            )
+            assert len(fakes) == 4
+            infos = [f.cluster_info for f in fakes]
+            assert [i.slice_id for i in infos] == [0, 0, 1, 1]
+            assert all(i.num_slices == 2 for i in infos)
+            assert all(i.megascale_coordinator_address for i in infos)
+            assert len({i.megascale_coordinator_address for i in infos}) == 1
+            # Within each slice the TPU worker ids restart at 0.
+            assert [i.tpu_worker_id for i in infos] == [0, 1, 0, 1]
+            # The global rank spans both slices.
+            assert [i.node_rank for i in infos] == [0, 1, 2, 3]
+            assert all(i.nodes_num == 4 for i in infos)
+
+    async def test_single_slice_has_no_megascale_env(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", multislice_spec("ss", 1))
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "ss"})
+            assert run["status"] == "done"
+            infos = [f.cluster_info for f in FakeRunnerClient.registry.values()]
+            assert all(i.num_slices == 1 for i in infos)
+            assert all(i.megascale_coordinator_address is None for i in infos)
+
+    async def test_slice_failure_requeues_entire_multislice_gang(self, monkeypatch):
+        """A failure on any worker of any slice resubmits ALL slices' jobs."""
+        monkeypatch.setattr("dstack_tpu.server.settings.RETRY_BACKOFF_BASE", 0.0)
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            orig_for_jpd = FakeRunnerClient.for_jpd
+            injected = []
+
+            def failing_for_jpd(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                # Fail one worker of one slice, first attempt only.
+                if jpd.worker_num == 1 and not injected and fake.submitted is None:
+                    injected.append(True)
+                    fake.script = [
+                        {
+                            "job_states": [{"state": "failed", "exit_status": 1}],
+                            "logs": [],
+                            "offset": 1,
+                        }
+                    ]
+                return fake
+
+            monkeypatch.setattr(tasks, "get_runner_client", failing_for_jpd)
+            await api.post(
+                "/api/project/main/runs/submit",
+                multislice_spec("msr", 2, retry={"on_events": ["error"], "duration": "1h"}),
+            )
+            await drive(api.db, passes=25)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "msr"})
+            assert run["status"] == "done"
+            rows = await api.db.fetchall(
+                "SELECT * FROM jobs WHERE run_name = 'msr' ORDER BY submission_num, job_num"
+            )
+            # All 4 jobs of submission 0, then ALL 4 requeued as submission 1 —
+            # including the slices that had not failed.
+            assert len(rows) == 8
+            assert [r["submission_num"] for r in rows] == [0, 0, 0, 0, 1, 1, 1, 1]
+            final = [r for r in rows if r["submission_num"] == 1]
+            assert all(r["status"] == "done" for r in final)
