@@ -100,6 +100,11 @@ class RunsApi:
         )
         return Run.model_validate(data)
 
+    def update(self, run_spec: dict) -> Run:
+        """In-place update of a live run (only update-safe fields may change)."""
+        data = self._c.post(self._c._p("/runs/update"), {"run_spec": run_spec})
+        return Run.model_validate(data)
+
     def list(self) -> List[Run]:
         data = self._c.post(self._c._p("/runs/list"))
         return [Run.model_validate(r) for r in data]

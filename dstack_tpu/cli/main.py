@@ -159,8 +159,12 @@ def cmd_apply(args) -> None:
             run_spec["repo_id"] = repo
             run_spec["repo_data"] = {"code_hash": code_hash}
 
-    run = client.runs.submit(run_spec)
-    print(f"submitted {run.run_name} ({run.status.value})")
+    if plan.action == "update":
+        run = client.runs.update(run_spec)
+        print(f"updated {run.run_name} in place ({run.status.value})")
+    else:
+        run = client.runs.submit(run_spec)
+        print(f"submitted {run.run_name} ({run.status.value})")
     if args.detach:
         return
     _attach(client, run.run_name)

@@ -54,6 +54,15 @@ async def apply_plan(request: web.Request) -> web.Response:
     return model_response(run)
 
 
+@routes.post("/api/project/{project_name}/runs/update")
+async def update(request: web.Request) -> web.Response:
+    user_row, project_row = await auth_project(request)
+    body = await body_dict(request)
+    run_spec = RunSpec.model_validate(body["run_spec"])
+    run = await runs_service.update_run(request.app["db"], project_row, user_row, run_spec)
+    return model_response(run)
+
+
 @routes.post("/api/project/{project_name}/runs/submit")
 async def submit(request: web.Request) -> web.Response:
     user_row, project_row = await auth_project(request)

@@ -173,7 +173,14 @@ async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -
     ) if run_spec.run_name else None
     if existing is not None:
         current = await run_model_to_run(db, existing)
-        action = "update" if not current.status.is_finished() else "create"
+        can_update = False
+        if not current.status.is_finished():
+            try:
+                check_can_update_run_spec(current.run_spec, plan_spec)
+                can_update = True
+            except ServerClientError:
+                pass
+        action = "update" if can_update else "create"
 
     return RunPlan(
         project_name=project_row["name"],
@@ -481,3 +488,81 @@ async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
             await _insert_replica(next_num, specs, 0)
             next_num += 1
             scheduled += 1
+
+
+# =====================================================================================
+# In-place update (parity: reference runs.py:896-944 _check_can_update_run_spec —
+# only fields that don't require re-provisioning may change on a live run)
+
+_UPDATABLE_SPEC_FIELDS = ["configuration", "repo_data"]
+_CONF_UPDATABLE_FIELDS: List[str] = []
+_TYPE_SPECIFIC_CONF_UPDATABLE_FIELDS = {
+    # Service capacity/routing knobs redeploy via replica scaling, not re-provision.
+    "service": ["replicas", "scaling", "strip_prefix", "rate_limits"],
+    "dev-environment": ["inactivity_duration"],
+}
+
+
+def _changed_fields(a, b) -> List[str]:
+    da, db_ = a.model_dump(mode="json"), b.model_dump(mode="json")
+    return sorted(k for k in set(da) | set(db_) if da.get(k) != db_.get(k))
+
+
+def check_can_update_run_spec(current: RunSpec, new: RunSpec) -> None:
+    changed = _changed_fields(current, new)
+    for key in changed:
+        if key not in _UPDATABLE_SPEC_FIELDS:
+            raise ServerClientError(
+                f"cannot update fields {changed} in place; only {_UPDATABLE_SPEC_FIELDS}"
+                " may change on a live run (stop and re-apply for the rest)"
+            )
+    cur_conf, new_conf = current.configuration, new.configuration
+    if cur_conf.type != new_conf.type:
+        raise ServerClientError(
+            f"configuration type changed {cur_conf.type} -> {new_conf.type}; cannot update"
+        )
+    allowed = _CONF_UPDATABLE_FIELDS + _TYPE_SPECIFIC_CONF_UPDATABLE_FIELDS.get(
+        new_conf.type, []
+    )
+    conf_changed = _changed_fields(cur_conf, new_conf)
+    for key in conf_changed:
+        if key not in allowed:
+            raise ServerClientError(
+                f"cannot update configuration fields {conf_changed} in place;"
+                f" a {new_conf.type} run allows only {allowed}"
+            )
+
+
+async def update_run(db: Database, project_row, user_row, run_spec: RunSpec) -> Run:
+    """Apply an updated spec to a live run (reference update_run runs.py:915)."""
+    row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_spec.run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"run {run_spec.run_name} not found")
+    if RunStatus(row["status"]).is_finished():
+        raise ServerClientError(
+            f"run {run_spec.run_name} is {row['status']}; submit a new run instead"
+        )
+    current = RunSpec.model_validate(loads(row["run_spec"]))
+    check_can_update_run_spec(current, run_spec)
+    await db.execute(
+        "UPDATE runs SET run_spec = ? WHERE id = ?",
+        (run_spec.model_dump_json(), row["id"]),
+    )
+    conf = run_spec.configuration
+    if conf.type == "service" and conf.scaling is None:
+        # Manual replica count: converge now (autoscaled services converge via
+        # process_services reading the updated spec).
+        target = conf.replicas.min or 0
+        job_rows = await db.fetchall("SELECT * FROM jobs WHERE run_id = ?", (row["id"],))
+        active, _ = classify_replicas(job_rows)
+        if target != len(active):
+            await scale_run_replicas(db, row, target - len(active))
+        await db.execute(
+            "UPDATE runs SET desired_replica_count = ? WHERE id = ?", (target, row["id"])
+        )
+    row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (row["id"],))
+    logger.info("run %s updated in place", run_spec.run_name)
+    return await run_model_to_run(db, row)

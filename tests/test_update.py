@@ -1,0 +1,127 @@
+"""In-place run update (parity: reference runs.py:896-944 update rules + update_run).
+
+Only fields that need no re-provisioning may change on a live run: service
+replica/scaling knobs (converged via replica scaling) and dev-env inactivity;
+anything else must be stopped and re-applied."""
+
+import pytest
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.server.services import proxy as proxy_service
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend
+
+pytestmark = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+from tests.test_services import _APP, _drive_until_replicas, _stop_run
+
+
+def service_spec(run_name: str, replicas=1, **conf) -> dict:
+    return {
+        "run_spec": {
+            "run_name": run_name,
+            "configuration": {
+                "type": "service",
+                "commands": [_APP],
+                "port": 8000,
+                "replicas": replicas,
+                **conf,
+            },
+        }
+    }
+
+
+class TestInPlaceUpdate:
+    async def test_manual_replica_update_scales_live_service(self, tmp_path):
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        proxy_service.stats.reset()
+        try:
+            async with api_server() as api:
+                await api.post("/api/project/main/runs/submit", service_spec("upsvc", 1))
+                await _drive_until_replicas(api, "upsvc", 1)
+
+                # The plan reports an in-place update for a replicas-only change.
+                plan = await api.post(
+                    "/api/project/main/runs/get_plan",
+                    service_spec("upsvc", 2),
+                )
+                assert plan["action"] == "update"
+
+                run = await api.post(
+                    "/api/project/main/runs/update", service_spec("upsvc", 2)
+                )
+                assert run["status"] == "running"
+                await _drive_until_replicas(api, "upsvc", 2)
+                row = await api.db.fetchone("SELECT * FROM runs WHERE run_name = 'upsvc'")
+                assert row["desired_replica_count"] == 2
+
+                # Scale back down in place.
+                await api.post("/api/project/main/runs/update", service_spec("upsvc", 1))
+                await _drive_until_replicas(api, "upsvc", 1)
+                run = await api.post("/api/project/main/runs/get", {"run_name": "upsvc"})
+                assert run["status"] == "running"
+                await _stop_run(api, "upsvc")
+        finally:
+            logs_service.set_log_storage(None)
+
+    async def test_non_updatable_change_rejected(self, tmp_path):
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        try:
+            async with api_server() as api:
+                await api.post("/api/project/main/runs/submit", service_spec("fix", 1))
+                await _drive_until_replicas(api, "fix", 1)
+                # Changing the command is not an in-place update.
+                bad = service_spec("fix", 1)
+                bad["run_spec"]["configuration"]["commands"] = ["echo changed"]
+                plan = await api.post("/api/project/main/runs/get_plan", bad)
+                assert plan["action"] == "create"  # cannot update -> stop & re-apply
+                resp = await api.post("/api/project/main/runs/update", bad, expect=400)
+                assert "cannot update" in str(resp)
+                await _stop_run(api, "fix")
+        finally:
+            logs_service.set_log_storage(None)
+
+    async def test_dev_env_inactivity_update(self, monkeypatch):
+        """inactivity_duration changes apply to the live dev env (the FSM reads the
+        updated spec on its next pass)."""
+        monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+        FakeRunnerClient.reset()
+        backends_service.reset_compute_cache()
+        async with api_server() as api:
+            spec = {
+                "run_spec": {
+                    "run_name": "denv",
+                    "configuration": {
+                        "type": "dev-environment",
+                        "inactivity_duration": "1h",
+                    },
+                }
+            }
+            await api.post("/api/project/main/runs/submit", spec)
+            # FakeRunnerClient's script ends the job 'done'; just verify the spec
+            # update path.
+            new = {
+                "run_spec": {
+                    "run_name": "denv",
+                    "configuration": {
+                        "type": "dev-environment",
+                        "inactivity_duration": "2h",
+                    },
+                }
+            }
+            run = await api.post("/api/project/main/runs/update", new)
+            assert (
+                run["run_spec"]["configuration"]["inactivity_duration"] == 7200
+            )
+
+    async def test_update_unknown_run_404(self):
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/runs/update",
+                service_spec("nope", 1),
+                expect=404,
+            )
