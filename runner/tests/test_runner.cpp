@@ -1,0 +1,307 @@
+// Unit tests for the runner agent's core: pty exec, stop/abort races, pull
+// pagination, idempotent submit, env contract, JSON, docker helpers, TPU
+// metrics parsing. No framework — a tiny CHECK harness (the reference covers the
+// same surface with 1,957 LoC of Go tests, runner/internal/executor/executor_test.go).
+//
+// Build + run: `make test` in runner/.
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "docker.hpp"
+#include "executor.hpp"
+#include "json.hpp"
+#include "tpu_metrics.hpp"
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                              \
+  do {                                                                           \
+    ++g_checks;                                                                  \
+    if (!(cond)) {                                                               \
+      ++g_failures;                                                              \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);            \
+    }                                                                            \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                           \
+  do {                                                                           \
+    ++g_checks;                                                                  \
+    auto va = (a);                                                               \
+    auto vb = (b);                                                               \
+    if (!(va == vb)) {                                                           \
+      ++g_failures;                                                              \
+      fprintf(stderr, "FAIL %s:%d: %s != %s\n", __FILE__, __LINE__, #a, #b);     \
+    }                                                                            \
+  } while (0)
+
+namespace {
+
+std::string temp_dir() {
+  char tmpl[] = "/tmp/drunner-test-XXXXXX";
+  char* d = mkdtemp(tmpl);
+  return d ? d : "/tmp";
+}
+
+dj::Json make_submit(const std::string& job_name, const std::vector<std::string>& commands) {
+  dj::Json spec = dj::Json::object();
+  spec.set("job_name", job_name);
+  dj::Json cmds = dj::Json::array();
+  for (const auto& c : commands) cmds.push_back(c);
+  spec.set("commands", std::move(cmds));
+  spec.set("image_name", "");
+  dj::Json env = dj::Json::object();
+  env.set("MY_TEST_VAR", "var-value");
+  spec.set("env", std::move(env));
+
+  dj::Json ci = dj::Json::object();
+  ci.set("node_rank", static_cast<int64_t>(3));
+  ci.set("nodes_num", static_cast<int64_t>(4));
+  ci.set("tpu_worker_id", static_cast<int64_t>(1));
+  ci.set("num_slices", static_cast<int64_t>(2));
+  ci.set("slice_id", static_cast<int64_t>(1));
+  ci.set("megascale_coordinator_address", "10.0.0.1:8081");
+
+  dj::Json secrets = dj::Json::object();
+  secrets.set("MY_SECRET", "s3cret");
+
+  dj::Json body = dj::Json::object();
+  body.set("job_spec", std::move(spec));
+  body.set("cluster_info", std::move(ci));
+  body.set("secrets", std::move(secrets));
+  return body;
+}
+
+// Drains pull until a terminal state or timeout; returns (state, all_logs, pulls).
+struct RunResult {
+  std::string state;
+  int exit_status = 0;
+  std::string logs;
+  int64_t final_offset = 0;
+  int pages = 0;
+  bool saw_has_more = false;
+};
+
+RunResult pump_until_terminal(drunner::Executor& ex, int timeout_ms = 15000,
+                              int64_t start_offset = 0) {
+  RunResult r;
+  int64_t offset = start_offset;
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    dj::Json page = ex.pull(offset);
+    ++r.pages;
+    // Offsets must be monotonic: the returned offset resumes the stream.
+    int64_t next = page["offset"].as_int();
+    if (next < offset) {
+      fprintf(stderr, "FAIL: offset went backwards %lld -> %lld\n",
+              static_cast<long long>(offset), static_cast<long long>(next));
+      ++g_failures;
+      return r;
+    }
+    offset = next;
+    if (page["has_more"].as_bool()) r.saw_has_more = true;
+    for (const auto& l : page["logs"].as_array()) r.logs += l["message"].as_string();
+    for (const auto& s : page["job_states"].as_array()) {
+      const std::string& st = s["state"].as_string();
+      if (st == "done" || st == "failed" || st == "terminated" || st == "aborted") {
+        r.state = st;
+        r.exit_status = static_cast<int>(s["exit_status"].as_int());
+        r.final_offset = offset;
+        return r;
+      }
+    }
+    if (!page["has_more"].as_bool()) usleep(50 * 1000);
+  }
+  r.state = "timeout";
+  return r;
+}
+
+void test_pty_exec_and_env() {
+  drunner::Executor ex(temp_dir());
+  ex.submit(make_submit("j1", {
+      "echo marker-$((40+2))",
+      "echo var=$MY_TEST_VAR secret=$MY_SECRET",
+      "echo rank=$DSTACK_NODE_RANK slice=$MEGASCALE_SLICE_ID of=$MEGASCALE_NUM_SLICES",
+  }));
+  ex.run();
+  RunResult r = pump_until_terminal(ex);
+  CHECK_EQ(r.state, std::string("done"));
+  CHECK_EQ(r.exit_status, 0);
+  CHECK(r.logs.find("marker-42") != std::string::npos);
+  CHECK(r.logs.find("var=var-value") != std::string::npos);
+  CHECK(r.logs.find("secret=s3cret") != std::string::npos);
+  // The TPU cluster contract reached the job (executor.cpp cluster_env).
+  CHECK(r.logs.find("rank=3 slice=1 of=2") != std::string::npos);
+}
+
+void test_failure_exit_status() {
+  drunner::Executor ex(temp_dir());
+  ex.submit(make_submit("j2", {"echo before", "exit 7", "echo after"}));
+  ex.run();
+  RunResult r = pump_until_terminal(ex);
+  CHECK_EQ(r.state, std::string("failed"));
+  CHECK_EQ(r.exit_status, 7);
+  CHECK(r.logs.find("before") != std::string::npos);
+  // set -e: nothing runs after the failing command.
+  CHECK(r.logs.find("after") == std::string::npos);
+}
+
+void test_idempotent_submit_and_conflict() {
+  drunner::Executor ex(temp_dir());
+  dj::Json body = make_submit("j3", {"sleep 5"});
+  ex.submit(body);
+  ex.run();
+  usleep(150 * 1000);
+  // Re-submit of the SAME job while live: idempotent no-op (lost-response retry).
+  ex.submit(body);
+  // Re-run: idempotent too.
+  ex.run();
+  // A DIFFERENT job while one is live: hard conflict.
+  bool threw = false;
+  try {
+    ex.submit(make_submit("other-job", {"true"}));
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  ex.stop(true);
+  RunResult r = pump_until_terminal(ex);
+  CHECK_EQ(r.state, std::string("aborted"));
+}
+
+void test_stop_graceful_vs_abort() {
+  {
+    drunner::Executor ex(temp_dir());
+    // Trap TERM so graceful stop is observable (handler exits 0).
+    ex.submit(make_submit("j4", {"trap 'echo got-term; exit 0' TERM", "sleep 30"}));
+    ex.run();
+    usleep(300 * 1000);
+    ex.stop(false);
+    RunResult r = pump_until_terminal(ex);
+    CHECK_EQ(r.state, std::string("terminated"));
+  }
+  {
+    drunner::Executor ex(temp_dir());
+    ex.submit(make_submit("j5", {"sleep 30"}));
+    ex.run();
+    usleep(300 * 1000);
+    ex.stop(true);
+    RunResult r = pump_until_terminal(ex);
+    CHECK_EQ(r.state, std::string("aborted"));
+  }
+}
+
+void test_stop_before_start_race() {
+  // Stop landing between submit and the exec thread's first breath must still
+  // terminate the job (executor.cpp stop()/exec_thread early-stop handshake).
+  drunner::Executor ex(temp_dir());
+  ex.submit(make_submit("j6", {"sleep 30"}));
+  ex.run();
+  ex.stop(false);  // no sleep: race the thread start
+  RunResult r = pump_until_terminal(ex);
+  CHECK(r.state == "terminated" || r.state == "aborted");
+}
+
+void test_pull_pagination() {
+  drunner::Executor ex(temp_dir());
+  // > kMaxEvents (5000) lines forces paging.
+  ex.submit(make_submit("j7", {"for i in $(seq 1 6000); do echo line-$i; done"}));
+  ex.run();
+  RunResult r = pump_until_terminal(ex, 30000);
+  CHECK_EQ(r.state, std::string("done"));
+  CHECK(r.saw_has_more);
+  CHECK(r.logs.find("line-1\r\n") != std::string::npos || r.logs.find("line-1\n") != std::string::npos);
+  CHECK(r.logs.find("line-6000") != std::string::npos);
+  // No duplicates: count occurrences of a middle line.
+  size_t count = 0, pos = 0;
+  while ((pos = r.logs.find("line-3000\r", pos)) != std::string::npos) {
+    ++count;
+    pos += 1;
+  }
+  CHECK_EQ(count, static_cast<size_t>(1));
+}
+
+void test_submit_resets_after_terminal() {
+  drunner::Executor ex(temp_dir());
+  ex.submit(make_submit("j8", {"echo one"}));
+  ex.run();
+  RunResult first = pump_until_terminal(ex);
+  CHECK_EQ(first.state, std::string("done"));
+  // A new job after a terminal state is accepted (slice reuse); the event stream
+  // continues — the server resumes from its stored offset, so the second job's
+  // events live past the first's terminal marker.
+  ex.submit(make_submit("j9", {"echo two"}));
+  ex.run();
+  RunResult r = pump_until_terminal(ex, 15000, first.final_offset);
+  CHECK_EQ(r.state, std::string("done"));
+  CHECK(r.logs.find("two") != std::string::npos);
+}
+
+void test_json_roundtrip() {
+  const char* text = R"({"a": [1, 2.5, "x\ny", true, null], "nested": {"k": -3}})";
+  dj::Json v = dj::Json::parse(text);
+  CHECK_EQ(v["a"].as_array().size(), static_cast<size_t>(5));
+  CHECK_EQ(v["a"].as_array()[2].as_string(), std::string("x\ny"));
+  CHECK_EQ(v["nested"]["k"].as_int(), static_cast<int64_t>(-3));
+  dj::Json round = dj::Json::parse(v.dump());
+  CHECK_EQ(round.dump(), v.dump());
+  bool threw = false;
+  try {
+    dj::Json::parse("{broken");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+void test_docker_helpers() {
+  CHECK_EQ(ddocker::url_escape("repo/img:1.0"), std::string("repo%2Fimg%3A1.0"));
+  // base64 of the credentials object (dj::Json orders keys alphabetically).
+  std::string auth = ddocker::encode_registry_auth("u", "p");
+  CHECK_EQ(auth, std::string("eyJwYXNzd29yZCI6InAiLCJ1c2VybmFtZSI6InUifQ=="));
+  CHECK_EQ(ddocker::encode_registry_auth("", ""), std::string(""));
+}
+
+void test_tpu_metrics_parse() {
+  std::string text =
+      "# HELP duty_cycle x\n"
+      "duty_cycle{chip=\"0\"} 90\n"
+      "duty_cycle{chip=\"1\"} 70\n"
+      "memory_used{chip=\"0\"} 100\n"
+      "memory_used{chip=\"1\"} 200\n"
+      "memory_total{chip=\"0\"} 1000\n"
+      "unrelated_metric 5\n";
+  dj::Json m = dtpu::parse_prometheus_tpu(text);
+  CHECK_EQ(m["duty_cycle_percent"].as_number(), 80.0);
+  CHECK_EQ(m["hbm_usage_bytes"].as_number(), 300.0);
+  CHECK_EQ(m["hbm_total_bytes"].as_number(), 1000.0);
+  CHECK(dtpu::parse_prometheus_tpu("nothing_useful 1\n").is_null());
+}
+
+}  // namespace
+
+int main() {
+  test_json_roundtrip();
+  test_docker_helpers();
+  test_tpu_metrics_parse();
+  test_pty_exec_and_env();
+  test_failure_exit_status();
+  test_idempotent_submit_and_conflict();
+  test_stop_graceful_vs_abort();
+  test_stop_before_start_race();
+  test_pull_pagination();
+  test_submit_resets_after_terminal();
+  if (g_failures == 0) {
+    printf("OK: %d checks passed\n", g_checks);
+    return 0;
+  }
+  fprintf(stderr, "FAILED: %d of %d checks\n", g_failures, g_checks);
+  return 1;
+}

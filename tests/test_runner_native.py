@@ -1,0 +1,24 @@
+"""Runs the C++ agent's native unit tests (runner/tests/test_runner.cpp) through
+pytest so the whole suite stays one command. `make -C runner test` also works
+standalone."""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+RUNNER_DIR = Path(__file__).resolve().parent.parent / "runner"
+
+
+@pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,
+                    reason="native toolchain unavailable")
+def test_native_runner_unit_tests():
+    result = subprocess.run(
+        ["make", "-C", str(RUNNER_DIR), "test"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert result.returncode == 0, f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "OK:" in result.stdout
