@@ -1,0 +1,41 @@
+"""Public plugin API: policy hooks applied on `apply` (parity: reference
+dstack/plugins/_base.py Plugin/ApplyPolicy).
+
+A plugin ships as an importable class; the server loads it from
+``plugins:`` in config.yml (or DSTACK_TPU_PLUGINS, comma-separated) as
+``package.module:ClassName`` entries — no packaging-entrypoint machinery
+required, which also keeps plugin loading explicit and auditable.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dstack_tpu.core.models.fleets import FleetSpec
+from dstack_tpu.core.models.runs import RunSpec
+
+
+class ApplyPolicy:
+    """Modify or reject specs on apply. Raise ValueError to reject; mutate and
+    return the spec to change it. Called for both the plan and the final apply
+    (always with the original spec)."""
+
+    def on_apply(self, user: str, project: str, spec):
+        if isinstance(spec, RunSpec):
+            return self.on_run_apply(user=user, project=project, spec=spec)
+        if isinstance(spec, FleetSpec):
+            return self.on_fleet_apply(user=user, project=project, spec=spec)
+        return spec
+
+    def on_run_apply(self, user: str, project: str, spec: RunSpec) -> RunSpec:
+        return spec
+
+    def on_fleet_apply(self, user: str, project: str, spec: FleetSpec) -> FleetSpec:
+        return spec
+
+
+class Plugin:
+    """Subclass and expose policies via get_apply_policies()."""
+
+    def get_apply_policies(self) -> List[ApplyPolicy]:
+        return []

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 from typing import Optional
 
 from aiohttp import web
@@ -57,6 +58,27 @@ async def _on_startup(app: web.Application) -> None:
     if existing is None:
         await projects_service.create_project(db, admin_row, settings.DEFAULT_PROJECT_NAME)
         logger.info("created default project %s", settings.DEFAULT_PROJECT_NAME)
+    # Declarative server config: converge projects/backends/plugins to config.yml
+    # (reference ServerConfigManager, services/config.py).
+    try:
+        from dstack_tpu.server.services import config as config_service
+        from dstack_tpu.server.services import encryption as encryption_service
+
+        server_config = config_service.load_config(settings.SERVER_DIR)
+        env_plugins = os.getenv("DSTACK_TPU_PLUGINS")
+        if env_plugins:
+            server_config.plugins.extend(
+                p.strip() for p in env_plugins.split(",") if p.strip()
+            )
+        if (
+            server_config.encryption is not None
+            and server_config.encryption.keys
+            and not settings.ENCRYPTION_KEYS  # env wins over the file
+        ):
+            encryption_service.configure_keys(server_config.encryption.keys)
+        await config_service.apply_config(db, admin_row, server_config)
+    except Exception:
+        logger.exception("applying server config failed; continuing with DB state")
     if app["run_background_tasks"]:
         from dstack_tpu.server.background import start_background_tasks
 

@@ -729,7 +729,20 @@ async def _get_code(db: Database, project_id: str, run_spec: RunSpec) -> Optiona
         " WHERE r.project_id = ? AND r.name = ? AND c.blob_hash = ?",
         (project_id, run_spec.repo_id, code_hash),
     )
-    return row["blob"] if row else None
+    if row is None:
+        return None
+    if row["blob"] is not None:
+        return row["blob"]
+    # Offloaded blob: fetch from the configured object store.
+    from dstack_tpu.server.services import repos as repos_service
+    from dstack_tpu.server.services import storage as storage_service
+
+    store = storage_service.get_storage()
+    if store is None:
+        return None
+    return await store.get(
+        repos_service.code_blob_key(project_id, run_spec.repo_id, code_hash)
+    )
 
 
 # =====================================================================================

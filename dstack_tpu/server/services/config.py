@@ -1,0 +1,102 @@
+"""Server config manager: apply ``config.yml`` at startup.
+
+Parity: reference server/services/config.py (ServerConfigManager — a declarative
+``~/.dstack/server/config.yml`` naming projects, their backends, encryption
+keys, and plugins, applied idempotently on boot). A default file is written on
+first start so operators have something to edit.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import List, Optional
+
+import yaml
+from pydantic import Field
+
+from dstack_tpu.core.models.backends import BackendConfig
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.server.db import Database
+
+logger = logging.getLogger(__name__)
+
+
+class ProjectConfig(CoreModel):
+    name: str
+    backends: List[BackendConfig] = Field(default_factory=list)
+
+
+class EncryptionConfig(CoreModel):
+    keys: List[dict] = Field(default_factory=list)
+
+
+class ServerConfig(CoreModel):
+    projects: List[ProjectConfig] = Field(default_factory=list)
+    plugins: List[str] = Field(default_factory=list)
+    encryption: Optional[EncryptionConfig] = None
+
+
+_DEFAULT_CONFIG = """\
+# dstack-tpu server configuration, applied at every startup.
+#
+# projects:
+#   - name: main
+#     backends:
+#       - type: gcp
+#         project_id: my-gcp-project
+#         creds:
+#           type: service_account
+#           filename: /path/to/sa.json
+#
+# plugins:
+#   - my_package.my_module:MyPlugin
+projects: []
+plugins: []
+"""
+
+
+def config_path(server_dir: Path) -> Path:
+    return server_dir / "config.yml"
+
+
+def load_config(server_dir: Path) -> ServerConfig:
+    """Read config.yml; writes the commented default on first boot."""
+    path = config_path(server_dir)
+    if not path.exists():
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(_DEFAULT_CONFIG)
+        logger.info("wrote default server config to %s", path)
+        return ServerConfig()
+    data = yaml.safe_load(path.read_text()) or {}
+    return ServerConfig.model_validate(data)
+
+
+async def apply_config(db: Database, admin_row, config: ServerConfig) -> None:
+    """Idempotently converge projects + backends + plugins to the file."""
+    from dstack_tpu.server.services import backends as backends_service
+    from dstack_tpu.server.services import plugins as plugins_service
+    from dstack_tpu.server.services import projects as projects_service
+
+    for proj in config.projects:
+        row = await db.fetchone(
+            "SELECT * FROM projects WHERE name = ? AND deleted = 0", (proj.name,)
+        )
+        if row is None:
+            await projects_service.create_project(db, admin_row, proj.name)
+            row = await db.fetchone(
+                "SELECT * FROM projects WHERE name = ? AND deleted = 0", (proj.name,)
+            )
+            logger.info("config: created project %s", proj.name)
+        for backend in proj.backends:
+            await backends_service.create_backend(db, row, backend)
+        if proj.backends:
+            logger.info(
+                "config: project %s backends: %s",
+                proj.name,
+                [b.type.value for b in proj.backends],
+            )
+
+    if config.plugins:
+        loaded = plugins_service.load_plugins(config.plugins)
+        logger.info("config: loaded plugins: %s", loaded)

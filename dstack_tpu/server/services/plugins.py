@@ -1,0 +1,53 @@
+"""Server-side plugin loading + policy application.
+
+Parity: reference server/services/plugins.py:59 (load_plugins / apply_plugin_policies).
+Import paths come from config.yml ``plugins:`` or DSTACK_TPU_PLUGINS instead of
+packaging entrypoints: explicit > discoverable for a control plane."""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import List
+
+from dstack_tpu.core.errors import ServerClientError
+from dstack_tpu.plugins import ApplyPolicy, Plugin
+
+logger = logging.getLogger(__name__)
+
+_plugins: List[Plugin] = []
+
+
+def load_plugins(import_paths: List[str]) -> List[str]:
+    """Load `module.path:ClassName` plugins; returns the names that loaded.
+    A broken plugin is skipped with a warning — one bad plugin must not take
+    the control plane down."""
+    _plugins.clear()
+    loaded = []
+    for path in import_paths:
+        module_path, _, class_name = path.partition(":")
+        try:
+            module = importlib.import_module(module_path)
+            cls = getattr(module, class_name)
+            if not (isinstance(cls, type) and issubclass(cls, Plugin)):
+                raise TypeError(f"{path} is not a dstack_tpu.plugins.Plugin subclass")
+            _plugins.append(cls())
+            loaded.append(path)
+        except Exception as e:
+            logger.warning("failed to load plugin %s: %s", path, e)
+    return loaded
+
+
+def reset_plugins() -> None:
+    _plugins.clear()
+
+
+def apply_policies(user: str, project: str, spec):
+    """Run every loaded policy over the spec; ValueError => client error."""
+    for plugin in _plugins:
+        for policy in plugin.get_apply_policies():
+            try:
+                spec = policy.on_apply(user, project, spec)
+            except ValueError as e:
+                raise ServerClientError(str(e) or "rejected by plugin policy")
+    return spec

@@ -51,8 +51,21 @@ async def upload_code(db: Database, project_row, repo_name: str, blob: bytes) ->
     if repo_row is None:
         raise ResourceNotExistsError(f"repo {repo_name} not found; run init first")
     blob_hash = hashlib.sha256(blob).hexdigest()
+    # Blob offload: with DSTACK_TPU_STORAGE configured, the bytes live in the
+    # object store and the DB keeps only the hash (reference services/storage/).
+    from dstack_tpu.server.services import storage as storage_service
+
+    store = storage_service.get_storage()
+    stored_blob = blob
+    if store is not None:
+        await store.put(code_blob_key(project_row["id"], repo_name, blob_hash), blob)
+        stored_blob = None
     await db.execute(
         "INSERT OR IGNORE INTO codes (id, repo_id, blob_hash, blob) VALUES (?, ?, ?, ?)",
-        (new_id(), repo_row["id"], blob_hash, blob),
+        (new_id(), repo_row["id"], blob_hash, stored_blob),
     )
     return blob_hash
+
+
+def code_blob_key(project_id: str, repo_name: str, blob_hash: str) -> str:
+    return f"codes/{project_id}/{repo_name}/{blob_hash}"

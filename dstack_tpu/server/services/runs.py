@@ -151,7 +151,16 @@ def _configured_name(run_spec: RunSpec):
     return getattr(run_spec.configuration, "name", None)
 
 
+def _apply_plugin_policies(project_row, user_row, run_spec: RunSpec) -> RunSpec:
+    from dstack_tpu.server.services import plugins as plugins_service
+
+    return plugins_service.apply_policies(
+        user_row["username"], project_row["name"], run_spec
+    )
+
+
 async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -> RunPlan:
+    run_spec = _apply_plugin_policies(project_row, user_row, run_spec)
     effective_name = run_spec.run_name or _configured_name(run_spec) or generate_name()
     plan_spec = run_spec.model_copy(deep=True)
     plan_spec.run_name = effective_name
@@ -197,6 +206,7 @@ async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -
 
 
 async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> Run:
+    run_spec = _apply_plugin_policies(project_row, user_row, run_spec)
     if not run_spec.run_name:
         run_spec = run_spec.model_copy(deep=True)
         run_spec.run_name = _configured_name(run_spec) or generate_name()

@@ -1,0 +1,193 @@
+"""Blob storage for code archives (and any future large objects).
+
+Parity: reference server/services/storage/ (S3/GCS blob offload for code blobs;
+default keeps blobs in the DB). Configure with DSTACK_TPU_STORAGE:
+  - unset              -> blobs stay in sqlite (codes.blob)
+  - file:///some/dir   -> local filesystem store
+  - gs://bucket[/pref] -> GCS over the JSON API, reusing the SDK-free gcp auth
+                          (backends/gcp/auth.py); transport injectable for tests.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Storage(abc.ABC):
+    @abc.abstractmethod
+    async def put(self, key: str, blob: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def get(self, key: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    async def delete(self, key: str) -> None: ...
+
+
+class FileStorage(Storage):
+    """Blobs as files under a root dir (one level of hash-prefix sharding)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        safe = key.replace("/", "_")
+        return self.root / safe[:2] / safe
+
+    async def put(self, key: str, blob: bytes) -> None:
+        import asyncio
+
+        path = self._path(key)
+
+        def _write() -> None:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(".tmp")
+            tmp.write_bytes(blob)
+            tmp.replace(path)
+
+        await asyncio.to_thread(_write)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        import asyncio
+
+        path = self._path(key)
+
+        def _read() -> Optional[bytes]:
+            try:
+                return path.read_bytes()
+            except FileNotFoundError:
+                return None
+
+        return await asyncio.to_thread(_read)
+
+    async def delete(self, key: str) -> None:
+        import asyncio
+
+        def _rm() -> None:
+            try:
+                self._path(key).unlink()
+            except FileNotFoundError:
+                pass
+
+        await asyncio.to_thread(_rm)
+
+
+class StorageError(Exception):
+    pass
+
+
+class GcsStorage(Storage):
+    """GCS JSON API (media upload/download/delete), SDK-free like the gcp backend.
+
+    ``request`` is injectable for tests: async (method, url, params, data) ->
+    (status, body_bytes); the default speaks aiohttp with a bearer token from
+    backends/gcp/auth.py (ambient metadata creds unless GOOGLE_APPLICATION* is
+    configured)."""
+
+    API = "https://storage.googleapis.com"
+
+    def __init__(self, bucket: str, prefix: str = "", request=None) -> None:
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self._request = request or self._aiohttp_request
+        self._tokens = None
+
+    def _name(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _object(self, key: str) -> str:
+        from urllib.parse import quote
+
+        return quote(self._name(key), safe="")
+
+    async def _aiohttp_request(self, method, url, params, data):
+        import aiohttp
+
+        if self._tokens is None:
+            from dstack_tpu.backends.gcp.auth import token_provider_from_creds
+
+            self._tokens = token_provider_from_creds(None)
+        token = await self._tokens.get_token()
+        async with aiohttp.ClientSession() as session:
+            async with session.request(
+                method,
+                url,
+                params=params,
+                data=data,
+                headers={"Authorization": f"Bearer {token}"},
+                timeout=aiohttp.ClientTimeout(total=60),
+            ) as resp:
+                return resp.status, await resp.read()
+
+    async def put(self, key: str, blob: bytes) -> None:
+        status, body = await self._request(
+            "POST",
+            f"{self.API}/upload/storage/v1/b/{self.bucket}/o",
+            {"uploadType": "media", "name": self._name(key)},
+            blob,
+        )
+        if status >= 400:
+            raise StorageError(f"gcs put {key}: HTTP {status}: {body[:200]!r}")
+
+    async def get(self, key: str) -> Optional[bytes]:
+        status, body = await self._request(
+            "GET",
+            f"{self.API}/storage/v1/b/{self.bucket}/o/{self._object(key)}",
+            {"alt": "media"},
+            None,
+        )
+        if status == 404:
+            return None
+        if status >= 400:
+            raise StorageError(f"gcs get {key}: HTTP {status}: {body[:200]!r}")
+        return body
+
+    async def delete(self, key: str) -> None:
+        status, body = await self._request(
+            "DELETE",
+            f"{self.API}/storage/v1/b/{self.bucket}/o/{self._object(key)}",
+            None,
+            None,
+        )
+        if status >= 400 and status != 404:
+            raise StorageError(f"gcs delete {key}: HTTP {status}: {body[:200]!r}")
+
+
+_storage: Optional[Storage] = None
+_configured = False
+
+
+def get_storage() -> Optional[Storage]:
+    """The configured blob store, or None (= keep blobs in the DB)."""
+    global _storage, _configured
+    if _configured:
+        return _storage
+    _configured = True
+    url = os.getenv("DSTACK_TPU_STORAGE", "")
+    if not url:
+        _storage = None
+    elif url.startswith("file://"):
+        _storage = FileStorage(url[len("file://"):])
+    elif url.startswith("gs://"):
+        rest = url[len("gs://"):]
+        bucket, _, prefix = rest.partition("/")
+        _storage = GcsStorage(bucket, prefix)
+    else:
+        logger.warning("unsupported DSTACK_TPU_STORAGE %r; using DB blobs", url)
+        _storage = None
+    if _storage is not None:
+        logger.info("blob storage: %s", url)
+    return _storage
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    global _storage, _configured
+    _storage = storage
+    _configured = True

@@ -1,0 +1,196 @@
+"""Server config manager, plugin policies, blob storage.
+
+Parity: reference services/config.py (ServerConfigManager), plugins.py:59
+(load_plugins/apply policies), services/storage/ (blob offload)."""
+
+import pytest
+
+from dstack_tpu.core.errors import ServerClientError
+from dstack_tpu.plugins import ApplyPolicy, Plugin
+from dstack_tpu.server.services import config as config_service
+from dstack_tpu.server.services import plugins as plugins_service
+from dstack_tpu.server.services import storage as storage_service
+from tests.common import api_server
+
+
+class TagPolicy(ApplyPolicy):
+    """Test policy: forces max_duration and rejects privileged runs."""
+
+    def on_run_apply(self, user, project, spec):
+        if spec.configuration.privileged:
+            raise ValueError("privileged runs are forbidden by policy")
+        spec.configuration.env.values["POLICY_APPLIED"] = f"{user}@{project}"
+        return spec
+
+
+class TestPlugin(Plugin):
+    __test__ = False  # not a pytest class
+
+    def get_apply_policies(self):
+        return [TagPolicy()]
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    plugins_service.reset_plugins()
+    storage_service.set_storage(None)
+    yield
+    plugins_service.reset_plugins()
+    storage_service.set_storage(None)
+
+
+class TestPlugins:
+    async def test_policy_mutates_and_rejects(self):
+        loaded = plugins_service.load_plugins(
+            ["tests.test_config_plugins_storage:TestPlugin"]
+        )
+        assert loaded == ["tests.test_config_plugins_storage:TestPlugin"]
+        async with api_server() as api:
+            run = await api.post(
+                "/api/project/main/runs/submit",
+                {
+                    "run_spec": {
+                        "run_name": "plugged",
+                        "configuration": {"type": "task", "commands": ["true"]},
+                    }
+                },
+            )
+            # The policy stamped the env before the spec was persisted.
+            assert (
+                run["run_spec"]["configuration"]["env"]["values"]["POLICY_APPLIED"]
+                == "admin@main"
+            )
+
+            resp = await api.post(
+                "/api/project/main/runs/submit",
+                {
+                    "run_spec": {
+                        "run_name": "nope",
+                        "configuration": {
+                            "type": "task",
+                            "commands": ["true"],
+                            "privileged": True,
+                        },
+                    }
+                },
+                expect=400,
+            )
+            assert "forbidden by policy" in str(resp)
+
+    def test_broken_plugin_skipped(self):
+        loaded = plugins_service.load_plugins(
+            ["nonexistent.module:Nope", "tests.test_config_plugins_storage:TagPolicy"]
+        )
+        assert loaded == []  # TagPolicy is not a Plugin subclass; both skipped
+
+
+class TestServerConfig:
+    async def test_config_creates_projects_and_backends(self, tmp_path):
+        (tmp_path / "config.yml").write_text(
+            """
+projects:
+  - name: research
+    backends:
+      - type: mock
+  - name: main
+    backends:
+      - type: mock
+"""
+        )
+        cfg = config_service.load_config(tmp_path)
+        assert [p.name for p in cfg.projects] == ["research", "main"]
+        async with api_server() as api:
+            admin = await api.db.fetchone("SELECT * FROM users WHERE username = 'admin'")
+            await config_service.apply_config(api.db, admin, cfg)
+            rows = await api.db.fetchall("SELECT name FROM projects WHERE deleted = 0")
+            assert {r["name"] for r in rows} == {"main", "research"}
+            backends = await api.post("/api/project/research/backends/list")
+            assert any(b["type"] == "mock" for b in backends)
+            # Idempotent: applying again changes nothing.
+            await config_service.apply_config(api.db, admin, cfg)
+            rows = await api.db.fetchall("SELECT name FROM projects WHERE deleted = 0")
+            assert len(rows) == 2
+
+    def test_default_config_written_on_first_boot(self, tmp_path):
+        cfg = config_service.load_config(tmp_path)
+        assert cfg.projects == []
+        text = (tmp_path / "config.yml").read_text()
+        assert "projects:" in text
+        # Second load parses the written default.
+        assert config_service.load_config(tmp_path).plugins == []
+
+
+class FakeGcsRequest:
+    """Scripted (method,url,params,data) -> (status, body) for GcsStorage."""
+
+    def __init__(self):
+        self.objects = {}
+        self.calls = []
+
+    async def __call__(self, method, url, params, data):
+        self.calls.append((method, url, params))
+        if method == "POST":
+            self.objects[params["name"]] = data
+            return 200, b"{}"
+        name = url.rsplit("/o/", 1)[1]
+        from urllib.parse import unquote
+
+        name = unquote(name)
+        if method == "GET":
+            if name not in self.objects:
+                return 404, b"not found"
+            return 200, self.objects[name]
+        if method == "DELETE":
+            return (204, b"") if self.objects.pop(name, None) is not None else (404, b"")
+        return 500, b"?"
+
+
+class TestStorage:
+    async def test_file_storage_roundtrip(self, tmp_path):
+        store = storage_service.FileStorage(str(tmp_path / "blobs"))
+        await store.put("codes/p/r/abc", b"tarball-bytes")
+        assert await store.get("codes/p/r/abc") == b"tarball-bytes"
+        await store.delete("codes/p/r/abc")
+        assert await store.get("codes/p/r/abc") is None
+
+    async def test_gcs_storage_roundtrip(self):
+        req = FakeGcsRequest()
+        store = storage_service.GcsStorage("my-bucket", prefix="dstack", request=req)
+        await store.put("codes/p/r/abc", b"blob")
+        assert await store.get("codes/p/r/abc") == b"blob"
+        assert req.objects == {"dstack/codes/p/r/abc": b"blob"}
+        await store.delete("codes/p/r/abc")
+        assert await store.get("codes/p/r/abc") is None
+
+    async def test_code_blobs_offloaded_and_fetched(self, tmp_path):
+        """With storage configured, upload_code keeps the DB row blob-less and the
+        scheduler's code fetch reads from the store."""
+        storage_service.set_storage(storage_service.FileStorage(str(tmp_path / "s")))
+        async with api_server() as api:
+            await api.post("/api/project/main/repos/init", {"repo_name": "r1"})
+            import json as _json
+
+            blob = b"fake-code-tarball"
+            resp = await api.client.post(
+                "/api/project/main/repos/r1/upload_code",
+                data=blob,
+                headers={"Authorization": f"Bearer {api.token}"},
+            )
+            assert resp.status == 200
+            code_hash = _json.loads(await resp.text())["code_hash"]
+            row = await api.db.fetchone("SELECT * FROM codes")
+            assert row["blob"] is None  # offloaded
+
+            from dstack_tpu.core.models.runs import RunSpec
+            from dstack_tpu.server.background.tasks import _get_code
+
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            spec = RunSpec.model_validate(
+                {
+                    "run_name": "x",
+                    "configuration": {"type": "task", "commands": ["true"]},
+                    "repo_id": "r1",
+                    "repo_data": {"code_hash": code_hash},
+                }
+            )
+            assert await _get_code(api.db, proj["id"], spec) == blob
