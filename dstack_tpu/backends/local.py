@@ -60,17 +60,33 @@ class LocalCompute(Compute):
         if requirements.resources.tpu is not None:
             return []  # TPU requests must go to a TPU-capable backend
         cpus = os.cpu_count() or 1
+        try:
+            memory_gb = (
+                os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE") / (1024**3)
+            )
+        except (ValueError, OSError):
+            memory_gb = 8.0
+        # The host must plausibly satisfy the request (round-1 finding: a 128-CPU
+        # ask must not land on a 4-CPU dev box). CPU overcommits up to a small
+        # floor — local jobs timeshare, and the default cpu>=2 ask must still run
+        # on a 1-CPU dev container; memory is filtered for real.
+        res = requirements.resources
+        if res.cpu.count.min and res.cpu.count.min > max(cpus, 4):
+            return []
+        if res.memory.min and res.memory.min > memory_gb:
+            return []
         offer = InstanceOffer(
             backend="local",
             instance=InstanceType(
                 name="local",
-                resources=HostResources(cpus=cpus, memory_gb=64.0, disk_gb=500.0),
+                resources=HostResources(
+                    cpus=cpus, memory_gb=round(memory_gb, 1), disk_gb=500.0
+                ),
             ),
             region="local",
             price=0.0,
             availability=InstanceAvailability.AVAILABLE,
         )
-        # Local host must still satisfy cpu/memory minimums loosely; don't over-filter dev runs.
         return [offer]
 
     async def create_slice(

@@ -119,6 +119,7 @@ class RunTerminationReason(str, Enum):
     STOPPED_BY_USER = "stopped_by_user"
     ABORTED_BY_USER = "aborted_by_user"
     INACTIVITY_DURATION_EXCEEDED = "inactivity_duration_exceeded"
+    TERMINATED_DUE_TO_UTILIZATION_POLICY = "terminated_due_to_utilization_policy"
     SERVER_ERROR = "server_error"
 
     def to_status(self) -> RunStatus:
@@ -128,6 +129,7 @@ class RunTerminationReason(str, Enum):
             self.STOPPED_BY_USER,
             self.ABORTED_BY_USER,
             self.INACTIVITY_DURATION_EXCEEDED,
+            self.TERMINATED_DUE_TO_UTILIZATION_POLICY,
         ):
             return RunStatus.TERMINATED
         return RunStatus.FAILED
@@ -141,6 +143,8 @@ class RunTerminationReason(str, Enum):
             return JobTerminationReason.ABORTED_BY_USER
         if self == self.INACTIVITY_DURATION_EXCEEDED:
             return JobTerminationReason.INACTIVITY_DURATION_EXCEEDED
+        if self == self.TERMINATED_DUE_TO_UTILIZATION_POLICY:
+            return JobTerminationReason.TERMINATED_DUE_TO_UTILIZATION_POLICY
         return JobTerminationReason.TERMINATED_BY_SERVER
 
 

@@ -102,7 +102,12 @@ def create_app(
     db_path: Optional[str] = None,
     run_background_tasks: bool = True,
 ) -> web.Application:
-    app = web.Application(middlewares=[error_middleware], client_max_size=settings.MAX_CODE_SIZE + 1024**2)
+    from dstack_tpu.server.services.request_metrics import request_metrics_middleware
+
+    app = web.Application(
+        middlewares=[request_metrics_middleware, error_middleware],
+        client_max_size=settings.MAX_CODE_SIZE + 1024**2,
+    )
     app["db"] = Database(db_path if db_path is not None else settings.DB_PATH)
     app["run_background_tasks"] = run_background_tasks
     app.router.add_get("/healthcheck", healthcheck)

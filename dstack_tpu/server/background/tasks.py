@@ -1049,6 +1049,20 @@ async def process_instances(db: Database, batch: Optional[int] = None) -> None:
             (to_iso(now_utc()), row["id"]),
         )
     await _cleanup_auto_fleets(db)
+    # Tunnel hygiene: close tunnels whose workers no longer exist (ADVICE r2 —
+    # the pool must not grow unbounded at fleet scale).
+    from dstack_tpu.server.services.runner import ssh as runner_ssh
+
+    live = await db.fetchall(
+        "SELECT job_provisioning_data FROM instances"
+        " WHERE deleted = 0 AND status != 'terminated'"
+    )
+    live_keys = set()
+    for r in live:
+        jpd = loads(r["job_provisioning_data"])
+        if jpd:
+            live_keys.add(f"{jpd.get('instance_id')}:{jpd.get('worker_num', 0)}")
+    await runner_ssh.reap_tunnels(live_keys)
 
 
 async def _process_instance(db: Database, row) -> None:
@@ -1368,6 +1382,7 @@ async def process_metrics(db: Database) -> None:
     from dstack_tpu.server.services import metrics as metrics_service
 
     await metrics_service.collect_job_metrics(db)
+    await metrics_service.enforce_utilization_policies(db)
     await metrics_service.sweep_metrics(db)
 
 

@@ -74,6 +74,63 @@ async def collect_job_metrics(db: Database) -> int:
     return sum(results)
 
 
+async def enforce_utilization_policies(db: Database) -> None:
+    """Terminate runs whose TPU duty-cycle stayed below the policy's threshold for
+    the whole window (reference process_running_jobs.py:764 _check_gpu_utilization —
+    GPU util there, TPU duty-cycle here). A gang dies whole, so enforcement is
+    run-level: any breaching job marks the run terminating; process_runs tears it
+    down. Decided from job_metrics_points so it composes with the collection loop."""
+    from dstack_tpu.core.models.runs import RunTerminationReason
+    from dstack_tpu.server.services.jobs import job_spec as load_job_spec
+
+    rows = await db.fetchall(
+        "SELECT j.* FROM jobs j JOIN runs r ON r.id = j.run_id"
+        " WHERE j.status = 'running' AND r.status NOT IN"
+        " ('terminating', 'terminated', 'failed', 'done')"
+    )
+    breached_runs = {}
+    for row in rows:
+        spec = load_job_spec(row)
+        policy = spec.utilization_policy
+        if policy is None or row["run_id"] in breached_runs:
+            continue
+        window_start = to_iso(
+            now_utc() - datetime.timedelta(seconds=policy.time_window)
+        )
+        points = await db.fetchall(
+            "SELECT * FROM job_metrics_points WHERE job_id = ? AND timestamp >= ?"
+            " ORDER BY timestamp",
+            (row["id"], window_start),
+        )
+        if not points:
+            continue
+        # The whole window must be covered by samples AND below threshold; a job
+        # that just started is not killable yet.
+        first_ts = from_iso(points[0]["timestamp"])
+        if (now_utc() - first_ts).total_seconds() < policy.time_window * 0.9:
+            continue
+        duties = []
+        for p in points:
+            tpu = json.loads(p["tpu"]) if p["tpu"] else {}
+            duty = tpu.get("duty_cycle_percent")
+            if duty is None:
+                duties = []  # no TPU signal -> never kill on missing data
+                break
+            duties.append(duty)
+        if duties and max(duties) < policy.min_tpu_utilization:
+            breached_runs[row["run_id"]] = (max(duties), policy)
+    for run_id, (duty, policy) in breached_runs.items():
+        logger.info(
+            "run %s: TPU duty %.1f%% < %s%% for %ss; terminating per utilization policy",
+            run_id, duty, policy.min_tpu_utilization, policy.time_window,
+        )
+        await db.execute(
+            "UPDATE runs SET status = 'terminating', termination_reason = ?"
+            " WHERE id = ? AND status NOT IN ('terminated', 'failed', 'done')",
+            (RunTerminationReason.TERMINATED_DUE_TO_UTILIZATION_POLICY.value, run_id),
+        )
+
+
 async def sweep_metrics(db: Database) -> None:
     """TTL delete (reference keeps separate running/finished TTLs; one TTL here —
     finished jobs' points age out the same way)."""

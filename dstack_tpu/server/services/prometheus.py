@@ -113,4 +113,24 @@ async def render_metrics(db: Database) -> str:
         _fmt("dstack_tpu_job_tpu_hbm_usage_bytes", "TPU HBM in use", "gauge", hbm)
     )
 
+    # HTTP request metrics from the middleware (services/request_metrics.py).
+    from dstack_tpu.server.services import request_metrics
+
+    req_counts, req_durations = [], []
+    for (method, route, status), count, dur in request_metrics.snapshot():
+        labels = {"method": method, "route": route, "status": str(status)}
+        req_counts.append((labels, float(count)))
+        req_durations.append((labels, dur))
+    sections.append(
+        _fmt("dstack_tpu_http_requests_total", "API requests served", "counter", req_counts)
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_http_request_duration_seconds_total",
+            "Cumulative API request wall time",
+            "counter",
+            req_durations,
+        )
+    )
+
     return "\n".join(sections) + "\n"

@@ -1,0 +1,47 @@
+"""HTTP request metrics middleware (the tracing/request-duration layer).
+
+Parity: reference server/app.py:81-89 + 227-271 (per-request duration metrics /
+Sentry tracing). In-process counters keyed by (method, route template, status),
+rendered into the Prometheus exposition (services/prometheus.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+from aiohttp import web
+
+_counts: Dict[Tuple[str, str, int], int] = {}
+_duration_sums: Dict[Tuple[str, str, int], float] = {}
+
+
+def record(method: str, route: str, status: int, seconds: float) -> None:
+    key = (method, route, status)
+    _counts[key] = _counts.get(key, 0) + 1
+    _duration_sums[key] = _duration_sums.get(key, 0.0) + seconds
+
+
+def snapshot() -> List[Tuple[Tuple[str, str, int], int, float]]:
+    return [(k, _counts[k], _duration_sums.get(k, 0.0)) for k in sorted(_counts)]
+
+
+def reset() -> None:
+    _counts.clear()
+    _duration_sums.clear()
+
+
+@web.middleware
+async def request_metrics_middleware(request: web.Request, handler):
+    start = time.monotonic()
+    status = 500
+    try:
+        response = await handler(request)
+        status = response.status
+        return response
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    finally:
+        resource = request.match_info.route.resource
+        route = resource.canonical if resource is not None else request.path
+        record(request.method, route, status, time.monotonic() - start)

@@ -153,6 +153,22 @@ async def close_tunnel(jpd: JobProvisioningData) -> None:
         await tunnel.close()
 
 
+async def reap_tunnels(live_keys) -> None:
+    """Close tunnels whose worker is gone (terminated outside the normal teardown
+    path — crashes, manual deletes). `live_keys` is the set of
+    ``instance_id:worker_num`` for every non-terminated instance; app-port
+    tunnels follow their worker's fate."""
+    async with _lock():
+        doomed = [k for k in _pool if k.split(":app", 1)[0] not in live_keys]
+        tunnels = [_pool.pop(k) for k in doomed]
+        for k in doomed:
+            _key_locks.pop(k, None)
+    for t in tunnels:
+        await t.close()
+    if doomed:
+        logger.info("reaped %d stale tunnel(s)", len(doomed))
+
+
 async def close_all_tunnels() -> None:
     async with _lock():
         tunnels = list(_pool.values())

@@ -1,0 +1,149 @@
+"""Request metrics middleware, utilization-kill, local offer filtering, tunnel
+reaping.
+
+Parity: reference app.py:81-89 (request duration middleware),
+process_running_jobs.py:764 (utilization enforcement — TPU duty-cycle here)."""
+
+import json
+import os
+
+import pytest
+
+from dstack_tpu.core.models.runs import JobProvisioningData
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import metrics as metrics_service
+from dstack_tpu.server.services import request_metrics
+from dstack_tpu.server.services.runner import ssh as runner_ssh
+from dstack_tpu.utils.common import now_utc, to_iso
+from tests.common import api_server
+
+
+class TestRequestMetrics:
+    async def test_middleware_counts_and_exports(self):
+        request_metrics.reset()
+        async with api_server() as api:
+            await api.post("/api/project/main/runs/list")
+            await api.post("/api/project/main/runs/list")
+            await api.post("/api/project/main/runs/get", {"run_name": "ghost"}, expect=404)
+            snap = {k: c for k, c, _ in request_metrics.snapshot()}
+            assert snap[("POST", "/api/project/{project_name}/runs/list", 200)] == 2
+            assert snap[("POST", "/api/project/{project_name}/runs/get", 404)] == 1
+
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            assert "dstack_tpu_http_requests_total{" in text
+            assert 'route="/api/project/{project_name}/runs/list"' in text
+            assert "dstack_tpu_http_request_duration_seconds_total" in text
+
+
+class TestUtilizationPolicy:
+    async def test_low_duty_cycle_terminates_run(self):
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await api.db.execute(
+                "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+                " status, run_spec) VALUES ('r1', ?, ?, 'hot', '2026-01-01', 'running', '{}')",
+                (proj["id"], proj["owner_id"]),
+            )
+            spec = {
+                "job_name": "hot-0-0",
+                "image_name": "x",
+                "requirements": {"resources": {}},
+                "utilization_policy": {"min_tpu_utilization": 40, "time_window": "1m"},
+            }
+            await api.db.execute(
+                "INSERT INTO jobs (id, project_id, run_id, run_name, job_spec, status,"
+                " submitted_at) VALUES ('j1', ?, 'r1', 'hot', ?, 'running', '2026-01-01')",
+                (proj["id"], json.dumps(spec)),
+            )
+            # 70s of samples at 5% duty — below the 40% floor for the window.
+            import datetime
+
+            for age in (58, 30, 5):
+                ts = to_iso(now_utc() - datetime.timedelta(seconds=age))
+                await api.db.execute(
+                    "INSERT INTO job_metrics_points (job_id, timestamp, cpu_usage_micro,"
+                    " memory_usage_bytes, tpu) VALUES ('j1', ?, 0, 0, ?)",
+                    (ts, json.dumps({"duty_cycle_percent": 5.0})),
+                )
+            await metrics_service.enforce_utilization_policies(api.db)
+            run = await api.db.fetchone("SELECT * FROM runs WHERE id = 'r1'")
+            assert run["status"] == "terminating"
+            assert run["termination_reason"] == "terminated_due_to_utilization_policy"
+
+    async def test_busy_tpu_not_killed(self):
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await api.db.execute(
+                "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+                " status, run_spec) VALUES ('r2', ?, ?, 'busy', '2026-01-01', 'running', '{}')",
+                (proj["id"], proj["owner_id"]),
+            )
+            spec = {
+                "job_name": "busy-0-0",
+                "image_name": "x",
+                "requirements": {"resources": {}},
+                "utilization_policy": {"min_tpu_utilization": 40, "time_window": "1m"},
+            }
+            await api.db.execute(
+                "INSERT INTO jobs (id, project_id, run_id, run_name, job_spec, status,"
+                " submitted_at) VALUES ('j2', ?, 'r2', 'busy', ?, 'running', '2026-01-01')",
+                (proj["id"], json.dumps(spec)),
+            )
+            import datetime
+
+            # One high sample inside the window keeps the run alive; missing TPU
+            # data must also never kill.
+            for age, duty in ((58, 5.0), (30, 85.0), (5, 5.0)):
+                ts = to_iso(now_utc() - datetime.timedelta(seconds=age))
+                await api.db.execute(
+                    "INSERT INTO job_metrics_points (job_id, timestamp, cpu_usage_micro,"
+                    " memory_usage_bytes, tpu) VALUES ('j2', ?, 0, 0, ?)",
+                    (ts, json.dumps({"duty_cycle_percent": duty})),
+                )
+            await metrics_service.enforce_utilization_policies(api.db)
+            run = await api.db.fetchone("SELECT * FROM runs WHERE id = 'r2'")
+            assert run["status"] == "running"
+
+
+class TestLocalOfferFiltering:
+    async def test_oversized_request_gets_no_local_offer(self):
+        from dstack_tpu.backends.local import LocalCompute
+        from dstack_tpu.core.models.resources import ResourcesSpec
+        from dstack_tpu.core.models.runs import Requirements
+
+        compute = LocalCompute()
+        cpus = os.cpu_count() or 1
+        huge = Requirements(resources=ResourcesSpec(cpu=cpus * 10, memory="4096GB"))
+        assert await compute.get_offers(huge) == []
+        sane = Requirements(resources=ResourcesSpec(cpu=1, memory="1GB"))
+        offers = await compute.get_offers(sane)
+        assert len(offers) == 1
+        assert offers[0].instance.resources.memory_gb > 0
+
+
+class TestTunnelReaping:
+    async def test_stale_tunnels_closed(self):
+        class FakeTunnel:
+            def __init__(self):
+                self.closed = False
+                self.is_open = True
+                self.forwards = []
+
+            async def close(self):
+                self.closed = True
+
+        live = FakeTunnel()
+        stale = FakeTunnel()
+        stale_app = FakeTunnel()
+        runner_ssh._pool.clear()
+        runner_ssh._pool["inst-live:0"] = live
+        runner_ssh._pool["inst-gone:0"] = stale
+        runner_ssh._pool["inst-gone:0:app8000"] = stale_app
+        try:
+            await runner_ssh.reap_tunnels({"inst-live:0"})
+            assert not live.closed
+            assert stale.closed and stale_app.closed
+            assert set(runner_ssh._pool) == {"inst-live:0"}
+        finally:
+            runner_ssh._pool.clear()
