@@ -21,6 +21,7 @@ from dstack_tpu.core.errors import (
 )
 from dstack_tpu.core.models.fleets import Fleet, FleetPlan, FleetSpec
 from dstack_tpu.core.models.instances import Instance
+from dstack_tpu.core.models.gateways import Gateway
 from dstack_tpu.core.models.logs import JobSubmissionLogs
 from dstack_tpu.core.models.metrics import JobMetrics
 from dstack_tpu.core.models.runs import Run, RunPlan, RunSpec
@@ -58,6 +59,7 @@ class Client:
         self.backends = BackendsApi(self)
         self.logs = LogsApi(self)
         self.metrics = MetricsApi(self)
+        self.gateways = GatewaysApi(self)
         self.instances = InstancesApi(self)
 
     def post(self, path: str, body: Optional[dict] = None, data: Optional[bytes] = None) -> Any:
@@ -240,6 +242,22 @@ class InstancesApi:
     def list(self) -> List[Instance]:
         data = self._c.post(self._c._p("/instances/list"))
         return [Instance.model_validate(i) for i in data]
+
+
+class GatewaysApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def list(self) -> List[Gateway]:
+        data = self._c.post(self._c._p("/gateways/list"))
+        return [Gateway.model_validate(g) for g in data]
+
+    def create(self, configuration: dict) -> Gateway:
+        data = self._c.post(self._c._p("/gateways/create"), {"configuration": configuration})
+        return Gateway.model_validate(data)
+
+    def delete(self, names: List[str]) -> None:
+        self._c.post(self._c._p("/gateways/delete"), {"names": names})
 
 
 class MetricsApi:

@@ -129,6 +129,21 @@ class TpuV2Client:
         base = f"{COMPUTE_ROOT}/projects/{self.project_id}/zones/{zone}/disks"
         return f"{base}/{name}" if name else base
 
+    # -- GCE instances (gateway appliance VMs) ----------------------------------------
+
+    def _instance_url(self, zone: str, name: str = "") -> str:
+        base = f"{COMPUTE_ROOT}/projects/{self.project_id}/zones/{zone}/instances"
+        return f"{base}/{name}" if name else base
+
+    async def insert_instance(self, zone: str, body: Dict[str, Any]) -> dict:
+        return await self._t.request("POST", self._instance_url(zone), body=body)
+
+    async def get_instance(self, zone: str, name: str) -> dict:
+        return await self._t.request("GET", self._instance_url(zone, name))
+
+    async def delete_instance(self, zone: str, name: str) -> dict:
+        return await self._t.request("DELETE", self._instance_url(zone, name))
+
     async def create_disk(
         self, zone: str, name: str, size_gb: int, disk_type: str = "pd-balanced"
     ) -> dict:

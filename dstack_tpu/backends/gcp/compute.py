@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import uuid
 from typing import Dict, List, Optional
 
 from dstack_tpu.backends import catalog
@@ -83,6 +84,10 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
         self.runner_url = config.get(
             "runner_url",
             "https://storage.googleapis.com/dstack-tpu-artifacts/dstack-tpu-runner",
+        )
+        self.gateway_wheel_url = config.get(
+            "gateway_wheel_url",
+            "https://storage.googleapis.com/dstack-tpu-artifacts/dstack_tpu-latest-py3-none-any.whl",
         )
         # TPU VM images ship sshd with root login disabled; "ubuntu" is the
         # stock login user (reference gcp/compute.py:278,342).
@@ -370,6 +375,99 @@ class GcpTpuCompute(Compute, ComputeWithVolumeSupport):
         except GcpApiError as e:
             if e.status != 404:
                 raise ComputeError(f"deleting disk {volume.name}: {e}") from e
+
+    # -- gateway (ingress appliance VM; reference gateways run on e2-medium VMs) ------
+
+    GATEWAY_PORT = 8000
+
+    async def create_gateway(self, configuration, token: str):
+        from dstack_tpu.core.models.gateways import GatewayProvisioningData
+
+        conf = configuration
+        zone = self._region_zone(conf.region)
+        name = f"dstack-gw-{uuid.uuid4().hex[:8]}"
+        # The appliance is pure python+aiohttp: install the wheel and run the
+        # module (gateway/app.py). gateway_wheel_url mirrors runner_url.
+        startup = f"""#!/bin/bash
+set -x
+apt-get update -qq && apt-get install -y -qq python3-pip || true
+pip3 install --no-input '{self.gateway_wheel_url}' aiohttp pydantic || true
+cat > /etc/systemd/system/dstack-tpu-gateway.service <<'UNIT'
+[Unit]
+Description=dstack-tpu gateway appliance
+After=network-online.target
+[Service]
+ExecStart=/usr/bin/python3 -m dstack_tpu.gateway --port {self.GATEWAY_PORT} --token {token}
+Restart=always
+RestartSec=2
+[Install]
+WantedBy=multi-user.target
+UNIT
+systemctl daemon-reload
+systemctl enable --now dstack-tpu-gateway.service
+"""
+        body = {
+            "name": name,
+            "machineType": f"zones/{zone}/machineTypes/e2-small",
+            "disks": [
+                {
+                    "boot": True,
+                    "autoDelete": True,
+                    "initializeParams": {
+                        "sourceImage": "projects/debian-cloud/global/images/family/debian-12",
+                        "diskSizeGb": "20",
+                    },
+                }
+            ],
+            "networkInterfaces": [
+                {
+                    **({"network": self.network} if self.network else {"network": "global/networks/default"}),
+                    **({"subnetwork": self.subnetwork} if self.subnetwork else {}),
+                    **(
+                        {"accessConfigs": [{"type": "ONE_TO_ONE_NAT", "name": "External NAT"}]}
+                        if conf.public_ip
+                        else {}
+                    ),
+                }
+            ],
+            "metadata": {"items": [{"key": "startup-script", "value": startup}]},
+            "labels": {"owner": "dstack-tpu", "dstack_gateway": "true"},
+        }
+        try:
+            await self.client.insert_instance(zone, body)
+            info = await self.client.get_instance(zone, name)
+        except GcpApiError as e:
+            raise ComputeError(f"creating gateway VM: {e}") from e
+        nic = (info.get("networkInterfaces") or [{}])[0]
+        access = (nic.get("accessConfigs") or [{}])[0]
+        ip = access.get("natIP") or nic.get("networkIP")
+        return GatewayProvisioningData(
+            instance_id=name,
+            ip_address=ip,
+            region=conf.region,
+            availability_zone=zone,
+            backend_data=json.dumps({"zone": zone, "port": self.GATEWAY_PORT}),
+        )
+
+    async def terminate_gateway(self, instance_id: str, region: str, backend_data=None) -> None:
+        zone = None
+        if backend_data:
+            try:
+                zone = json.loads(backend_data).get("zone")
+            except ValueError:
+                pass
+        zone = zone or self._region_zone(region)
+        try:
+            await self.client.delete_instance(zone, instance_id)
+        except GcpApiError as e:
+            if e.status != 404:
+                raise ComputeError(str(e)) from e
+
+    def _region_zone(self, region: str) -> str:
+        zones = sorted(
+            {z for regions in TPU_ZONES.values() for z in regions.get(region, [])}
+        )
+        return zones[0] if zones else f"{region}-a"
 
     @staticmethod
     def _slice_spec(offer: InstanceOffer) -> TpuSliceSpec:

@@ -227,3 +227,59 @@ class LocalCompute(Compute):
         pd = volume.provisioning_data
         if pd is not None and pd.volume_id and os.path.isdir(pd.volume_id):
             shutil.rmtree(pd.volume_id, ignore_errors=True)
+
+    # -- gateway: the appliance runs as a local subprocess (dev parity) ----------------
+
+    async def create_gateway(self, configuration, token: str):
+        import sys
+
+        from dstack_tpu.core.models.gateways import GatewayProvisioningData
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dstack_tpu.gateway",
+             "--host", "127.0.0.1", "--port", "0", "--token", token],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        loop = asyncio.get_running_loop()
+
+        def _read_port() -> int:
+            assert proc.stdout is not None
+            for _ in range(40):
+                line = proc.stdout.readline().decode(errors="replace")
+                if not line:
+                    break
+                m = re.search(r"listening on [\d.]+:(\d+)", line)
+                if m:
+                    return int(m.group(1))
+            raise ComputeError("gateway appliance did not report a port")
+
+        try:
+            port = await asyncio.wait_for(loop.run_in_executor(None, _read_port), timeout=20)
+        except (asyncio.TimeoutError, ComputeError):
+            proc.kill()
+            raise ComputeError("gateway appliance failed to start")
+        self._procs[f"local-gw-{proc.pid}"] = proc
+        return GatewayProvisioningData(
+            instance_id=f"local-gw-{proc.pid}",
+            ip_address="127.0.0.1",
+            region="local",
+            backend_data=json.dumps({"pid": proc.pid, "port": port}),
+        )
+
+    async def terminate_gateway(self, instance_id: str, region: str, backend_data=None) -> None:
+        proc = self._procs.pop(instance_id, None)
+        if proc is not None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            await asyncio.get_running_loop().run_in_executor(None, proc.wait)
+        elif backend_data:
+            try:
+                pid = json.loads(backend_data).get("pid")
+                if pid:
+                    os.killpg(pid, signal.SIGTERM)
+            except (ValueError, ProcessLookupError, PermissionError):
+                pass

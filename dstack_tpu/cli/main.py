@@ -127,7 +127,9 @@ def cmd_apply(args) -> None:
         print(f"volume {vol.name} {vol.status.value}")
         return
     if conf.type == "gateway":
-        raise DstackTpuError("gateway apply is handled by the gateways milestone")
+        gw = client.gateways.create(data)
+        print(f"gateway {gw.name} {gw.status.value}")
+        return
 
     # Run configurations (task/service/dev-environment).
     run_spec: dict = {"configuration": data, "configuration_path": str(path)}
@@ -338,6 +340,20 @@ def cmd_offer(args) -> None:
     print(f"{result['total']} offers total")
 
 
+def cmd_gateway(args) -> None:
+    client = _client()
+    if args.action == "list":
+        rows = [
+            [g.name, g.status.value, g.ip_address or "-", g.hostname or "-",
+             "yes" if g.default else ""]
+            for g in client.gateways.list()
+        ]
+        print(_table(["GATEWAY", "STATUS", "IP", "DOMAIN", "DEFAULT"], rows))
+    elif args.action == "delete":
+        client.gateways.delete(args.names)
+        print(f"deleted {len(args.names)} gateway(s)")
+
+
 def cmd_fleet(args) -> None:
     client = _client()
     if args.action == "list":
@@ -490,6 +506,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("action", choices=["list", "delete"])
     s.add_argument("names", nargs="*")
     s.set_defaults(func=cmd_fleet)
+
+    s = sub.add_parser("gateway", help="manage gateways")
+    s.add_argument("action", choices=["list", "delete"])
+    s.add_argument("names", nargs="*")
+    s.set_defaults(func=cmd_gateway)
 
     s = sub.add_parser("volume", help="manage volumes")
     s.add_argument("action", choices=["list", "delete"])

@@ -1,0 +1,63 @@
+"""One-request HTTP reverse-proxy forwarding, shared by the in-server service
+proxy (server/services/proxy.py) and the gateway appliance (gateway/app.py).
+
+Streams the upstream response chunk-by-chunk, so SSE/chunked inference output
+(the OpenAI-compatible streaming path) flows through unbuffered."""
+
+from __future__ import annotations
+
+import logging
+
+import aiohttp
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+# Hop-by-hop headers never forwarded (RFC 9110 §7.6.1).
+HOP_HEADERS = {
+    "connection",
+    "keep-alive",
+    "proxy-authenticate",
+    "proxy-authorization",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "host",
+    "content-length",
+}
+
+
+async def forward(
+    request: web.Request,
+    host: str,
+    port: int,
+    tail: str,
+    timeout_total: float = 300.0,
+    body: bytes = None,
+) -> web.StreamResponse:
+    """Forward `request` to http://host:port/<tail> (+query), streaming back."""
+    url = f"http://{host}:{port}/{tail.lstrip('/')}"
+    if request.query_string:
+        url += f"?{request.query_string}"
+    headers = {k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS}
+    if body is None:
+        body = await request.read()
+    try:
+        timeout = aiohttp.ClientTimeout(total=timeout_total)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.request(
+                request.method, url, headers=headers, data=body, allow_redirects=False
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_chunked(64 * 1024):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+    except (aiohttp.ClientError, OSError) as e:
+        logger.warning("forward to %s:%s failed: %s", host, port, e)
+        raise web.HTTPBadGateway(text="upstream request failed")

@@ -1,0 +1,1 @@
+"""Gateway appliance package; `python -m dstack_tpu.gateway` runs it."""

@@ -1,0 +1,230 @@
+"""Gateway appliance: the standalone ingress VM the control plane provisions.
+
+Parity: reference proxy/gateway/app.py + gateway/services/nginx.py:75-110
+(per-service nginx server blocks) + gateway/services/registry.py:34-373 (the
+OpenAI-compatible model registry). TPU re-design: one aiohttp process replaces
+the nginx+python pair — aiohttp streams SSE/chunked inference output fine,
+needs no config-file reloads (the registry is in-process, updated over the
+control plane's sync API), and ships as a single module the startup script can
+launch (`python -m dstack_tpu.gateway`). TLS terminates at a fronting LB or
+host certs (``certificate`` config) — the appliance itself speaks HTTP.
+
+Routing surface:
+  - path:   /services/{project}/{run}/...       (always available)
+  - domain: Host == service domain -> /...      (when a domain is registered)
+  - model:  POST /models/{project}/v1/chat/completions (+ /completions,
+            /models/{project}/v1/models to list) routed by body["model"]
+Control surface (Bearer ``--token``):
+  - POST /api/registry/register    {project, run_name, domain?, model?, replicas}
+  - POST /api/registry/unregister  {project, run_name}
+  - GET  /api/registry/services
+  - GET  /healthcheck              (unauthenticated)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from dstack_tpu.core.services.http_forward import forward
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceEntry:
+    def __init__(self, data: dict) -> None:
+        self.project: str = data["project"]
+        self.run_name: str = data["run_name"]
+        self.domain: Optional[str] = data.get("domain")
+        model = data.get("model") or {}
+        self.model_name: Optional[str] = model.get("name")
+        self.model_prefix: str = (model.get("prefix") or "/v1").rstrip("/")
+        self.replicas: List[Tuple[str, int]] = [
+            (r["host"], int(r["port"])) for r in data.get("replicas", [])
+        ]
+        self._rr = 0
+
+    def pick_replica(self) -> Tuple[str, int]:
+        replica = self.replicas[self._rr % len(self.replicas)]
+        self._rr += 1
+        return replica
+
+    def to_dict(self) -> dict:
+        return {
+            "project": self.project,
+            "run_name": self.run_name,
+            "domain": self.domain,
+            "model": (
+                {"name": self.model_name, "prefix": self.model_prefix}
+                if self.model_name
+                else None
+            ),
+            "replicas": [{"host": h, "port": p} for h, p in self.replicas],
+        }
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._services: Dict[Tuple[str, str], ServiceEntry] = {}
+
+    def register(self, data: dict) -> ServiceEntry:
+        entry = ServiceEntry(data)
+        self._services[(entry.project, entry.run_name)] = entry
+        return entry
+
+    def unregister(self, project: str, run_name: str) -> bool:
+        return self._services.pop((project, run_name), None) is not None
+
+    def get(self, project: str, run_name: str) -> Optional[ServiceEntry]:
+        return self._services.get((project, run_name))
+
+    def by_domain(self, host: str) -> Optional[ServiceEntry]:
+        host = host.split(":")[0].lower()
+        for entry in self._services.values():
+            if entry.domain and entry.domain.lower() == host:
+                return entry
+        return None
+
+    def by_model(self, project: str, model_name: str) -> Optional[ServiceEntry]:
+        for entry in self._services.values():
+            if entry.project == project and entry.model_name == model_name:
+                return entry
+        return None
+
+    def models(self, project: str) -> List[ServiceEntry]:
+        return [
+            e for e in self._services.values() if e.project == project and e.model_name
+        ]
+
+    def all(self) -> List[ServiceEntry]:
+        return list(self._services.values())
+
+
+def create_app(token: str) -> web.Application:
+    registry = Registry()
+    app = web.Application()
+    app["registry"] = registry
+
+    def _auth(request: web.Request) -> None:
+        header = request.headers.get("Authorization", "")
+        if not token or header != f"Bearer {token}":
+            raise web.HTTPUnauthorized(text="bad gateway token")
+
+    async def healthcheck(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "service": "dstack-tpu-gateway", "services": len(registry.all())}
+        )
+
+    async def register(request: web.Request) -> web.Response:
+        _auth(request)
+        entry = registry.register(await request.json())
+        logger.info(
+            "registered %s/%s: %d replica(s)%s",
+            entry.project, entry.run_name, len(entry.replicas),
+            f", model {entry.model_name}" if entry.model_name else "",
+        )
+        return web.json_response(entry.to_dict())
+
+    async def unregister(request: web.Request) -> web.Response:
+        _auth(request)
+        body = await request.json()
+        removed = registry.unregister(body["project"], body["run_name"])
+        return web.json_response({"removed": removed})
+
+    async def list_services(request: web.Request) -> web.Response:
+        _auth(request)
+        return web.json_response([e.to_dict() for e in registry.all()])
+
+    async def route_service(request: web.Request) -> web.StreamResponse:
+        entry = registry.get(
+            request.match_info["project"], request.match_info["run_name"]
+        )
+        if entry is None:
+            raise web.HTTPNotFound(text="unknown service")
+        if not entry.replicas:
+            raise web.HTTPServiceUnavailable(text="service has no replicas")
+        host, port = entry.pick_replica()
+        return await forward(request, host, port, request.match_info.get("tail", ""))
+
+    async def route_model(request: web.Request) -> web.StreamResponse:
+        project = request.match_info["project"]
+        tail = request.match_info.get("tail", "")
+        if request.method == "GET" and tail == "models":
+            return web.json_response(
+                {
+                    "object": "list",
+                    "data": [
+                        {"id": e.model_name, "object": "model", "owned_by": e.project}
+                        for e in registry.models(project)
+                    ],
+                }
+            )
+        body = await request.read()
+        try:
+            model_name = json.loads(body).get("model")
+        except (ValueError, AttributeError):
+            model_name = None
+        if not model_name:
+            raise web.HTTPBadRequest(text="request body must name a model")
+        entry = registry.by_model(project, model_name)
+        if entry is None:
+            raise web.HTTPNotFound(text=f"no service serves model {model_name}")
+        if not entry.replicas:
+            raise web.HTTPServiceUnavailable(text="service has no replicas")
+        host, port = entry.pick_replica()
+        return await forward(
+            request, host, port, f"{entry.model_prefix}/{tail}", body=body
+        )
+
+    async def route_domain(request: web.Request) -> web.StreamResponse:
+        entry = registry.by_domain(request.headers.get("Host", ""))
+        if entry is None:
+            raise web.HTTPNotFound(text="unknown host")
+        if not entry.replicas:
+            raise web.HTTPServiceUnavailable(text="service has no replicas")
+        host, port = entry.pick_replica()
+        return await forward(request, host, port, request.match_info.get("tail", ""))
+
+    app.router.add_get("/healthcheck", healthcheck)
+    app.router.add_post("/api/registry/register", register)
+    app.router.add_post("/api/registry/unregister", unregister)
+    app.router.add_get("/api/registry/services", list_services)
+    app.router.add_route("*", "/services/{project}/{run_name}/{tail:.*}", route_service)
+    app.router.add_route("*", "/models/{project}/v1/{tail:.*}", route_model)
+    # Domain-based routing is the catch-all: anything not matching the fixed
+    # prefixes is tried against registered domains.
+    app.router.add_route("*", "/{tail:.*}", route_domain)
+    return app
+
+
+async def serve(host: str, port: int, token: str) -> None:
+    import asyncio
+
+    runner = web.AppRunner(create_app(token))
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual = site._server.sockets[0].getsockname()[1]  # port 0 -> ephemeral
+    print(f"dstack-tpu-gateway listening on {host}:{actual}", flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main() -> None:
+    import asyncio
+
+    parser = argparse.ArgumentParser(prog="dstack-tpu-gateway")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--token", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(serve(args.host, args.port, args.token))
+
+
+if __name__ == "__main__":
+    main()

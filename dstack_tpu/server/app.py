@@ -19,6 +19,7 @@ from dstack_tpu.server.routers import backends as backends_router
 from dstack_tpu.server.routers import fleets as fleets_router
 from dstack_tpu.server.routers import instances as instances_router
 from dstack_tpu.server.routers import logs as logs_router
+from dstack_tpu.server.routers import gateways as gateways_router
 from dstack_tpu.server.routers import metrics as metrics_router
 from dstack_tpu.server.routers import proxy as proxy_router
 from dstack_tpu.server.routers import offers as offers_router
@@ -124,6 +125,7 @@ def create_app(
     app.add_routes(instances_router.routes)
     app.add_routes(metrics_router.routes)
     app.add_routes(proxy_router.routes)
+    app.add_routes(gateways_router.routes)
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
     return app

@@ -84,4 +84,9 @@ def start_background_tasks(app: web.Application) -> BackgroundScheduler:
         settings.PROCESS_VOLUMES_INTERVAL,
         "process_volumes",
     )
+    sched.add_periodic(
+        lambda: tasks.process_gateways(db),
+        settings.PROCESS_GATEWAYS_INTERVAL,
+        "process_gateways",
+    )
     return sched

@@ -1524,3 +1524,76 @@ async def process_volumes(db: Database, batch: Optional[int] = None) -> None:
             await volumes_service.delete_volumes(db, project_row, [row["name"]])
         except Exception as e:
             logger.warning("volume %s auto-cleanup failed: %s", row["name"], e)
+
+
+# =====================================================================================
+# process_gateways (parity: reference process_gateways.py — provision the ingress
+# appliance, then keep its service registry in sync every pass)
+
+
+async def process_gateways(db: Database, batch: Optional[int] = None) -> None:
+    from dstack_tpu.core.models.configurations import GatewayConfiguration
+    from dstack_tpu.core.models.gateways import GatewayStatus
+    from dstack_tpu.server.services import gateways as gateways_service
+
+    rows = await db.fetchall(
+        "SELECT * FROM gateways WHERE status IN ('submitted', 'provisioning') LIMIT ?",
+        (batch or settings.PROCESS_BATCH_SIZE,),
+    )
+    for row in rows:
+        project_row = await db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
+        token = new_id()
+        try:
+            compute = await backends_service.get_compute(db, project_row, conf.backend)
+            create = getattr(compute, "create_gateway", None)
+            if create is None:
+                raise BackendError(f"backend {conf.backend} has no gateway support")
+            pd = await create(conf, token)
+        except Exception as e:
+            logger.warning("gateway %s provisioning failed: %s", row["name"], e)
+            await db.execute(
+                "UPDATE gateways SET status = 'failed', status_message = ? WHERE id = ?",
+                (str(e)[:500], row["id"]),
+            )
+            continue
+        backend_port = 8000
+        if pd.backend_data:
+            try:
+                backend_port = json.loads(pd.backend_data).get("port", 8000)
+            except ValueError:
+                pass
+        await db.execute(
+            "UPDATE gateways SET status = ?, ip_address = ?, hostname = ?,"
+            " provisioning_data = ?, last_processed_at = ? WHERE id = ?",
+            (
+                GatewayStatus.RUNNING.value,
+                pd.ip_address,
+                conf.domain,
+                json.dumps(
+                    {
+                        "instance_id": pd.instance_id,
+                        "token": token,
+                        "port": backend_port,
+                        "backend_data": pd.backend_data,
+                    }
+                ),
+                to_iso(now_utc()),
+                row["id"],
+            ),
+        )
+        logger.info("gateway %s running at %s:%s", row["name"], pd.ip_address, backend_port)
+
+    # Sync running services into every running gateway's registry.
+    running = await db.fetchall("SELECT * FROM gateways WHERE status = 'running'")
+    for row in running:
+        project_row = await db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        await gateways_service.sync_services_to_gateway(db, project_row, row)
+        await db.execute(
+            "UPDATE gateways SET last_processed_at = ? WHERE id = ?",
+            (to_iso(now_utc()), row["id"]),
+        )

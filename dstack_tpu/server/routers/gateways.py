@@ -1,0 +1,37 @@
+"""Gateways API (parity: reference routers/gateways.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.models.configurations import GatewayConfiguration
+from dstack_tpu.server.routers._common import auth_project, body_dict, model_response
+from dstack_tpu.server.services import gateways as gateways_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/project/{project_name}/gateways/list")
+async def list_gateways(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    gateways = await gateways_service.list_gateways(request.app["db"], project_row)
+    return model_response(gateways)
+
+
+@routes.post("/api/project/{project_name}/gateways/create")
+async def create_gateway(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    conf = GatewayConfiguration.model_validate(body["configuration"])
+    gateway = await gateways_service.create_gateway(request.app["db"], project_row, conf)
+    return model_response(gateway)
+
+
+@routes.post("/api/project/{project_name}/gateways/delete")
+async def delete_gateways(request: web.Request) -> web.Response:
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    await gateways_service.delete_gateways(
+        request.app["db"], project_row, body.get("names") or []
+    )
+    return web.json_response({})

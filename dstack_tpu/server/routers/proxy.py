@@ -47,6 +47,62 @@ async def _handle(request: web.Request) -> web.StreamResponse:
 routes.route("*", "/proxy/services/{project_name}/{run_name}/{tail:.*}")(_handle)
 
 
+@routes.route("*", "/proxy/models/{project_name}/v1/{tail:.*}")
+async def model_route(request: web.Request) -> web.StreamResponse:
+    """In-server OpenAI-compatible model routing: requests name a model in the
+    body; the run whose service registered that model serves it (parity:
+    reference gateway/services/registry.py:34-373, in-server flavor)."""
+    import json as _json
+
+    from dstack_tpu.core.models.services import ServiceSpec
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    db = request.app["db"]
+    project_name = request.match_info["project_name"]
+    tail = request.match_info.get("tail", "")
+    project_row = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project_row is None:
+        raise web.HTTPNotFound(text=f"no project {project_name}")
+    await auth_project(request)
+
+    run_rows = await db.fetchall(
+        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0"
+        " AND service_spec IS NOT NULL AND status IN ('running', 'provisioning')",
+        (project_row["id"],),
+    )
+    models = {}
+    for row in run_rows:
+        spec = ServiceSpec.model_validate(loads(row["service_spec"]))
+        if spec.model is not None:
+            models[spec.model.name] = (row, spec.model)
+
+    if request.method == "GET" and tail == "models":
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": name, "object": "model", "owned_by": project_name}
+                    for name in sorted(models)
+                ],
+            }
+        )
+
+    body = await request.read()
+    try:
+        model_name = _json.loads(body).get("model")
+    except (ValueError, AttributeError):
+        model_name = None
+    if not model_name or model_name not in models:
+        raise web.HTTPNotFound(text=f"no service serves model {model_name!r}")
+    run_row, model = models[model_name]
+    prefix = (model.prefix or "/v1").strip("/")
+    return await proxy_service.proxy_request(
+        request, db, project_row, run_row["run_name"], f"{prefix}/{tail}", body=body
+    )
+
+
 @routes.get("/api/project/{project_name}/runs/{run_name}/attach/{port}")
 async def attach_ws(request: web.Request) -> web.StreamResponse:
     """TCP-over-WebSocket port forward to a run's worker (services/attach.py)."""

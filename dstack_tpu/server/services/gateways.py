@@ -1,0 +1,196 @@
+"""Gateways service: CRUD + provisioning FSM + service sync.
+
+Parity: reference server/services/gateways.py + background process_gateways.
+The appliance itself is dstack_tpu/gateway/app.py (replaces the reference's
+nginx+python gateway pair); this module provisions it through the backend
+(a GCE VM on gcp, a subprocess on local — same pattern as runner agents) and
+pushes every running service's replica endpoints to its registry each pass.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import uuid as uuid_mod
+from typing import List, Optional
+
+import aiohttp
+
+from dstack_tpu.core.errors import (
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerClientError,
+)
+from dstack_tpu.core.models.configurations import GatewayConfiguration
+from dstack_tpu.core.models.gateways import (
+    Gateway,
+    GatewayProvisioningData,
+    GatewayStatus,
+)
+from dstack_tpu.server.db import Database, loads, new_id
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+
+def row_to_gateway(row, project_name: str = "") -> Gateway:
+    pd = loads(row["provisioning_data"])
+    return Gateway(
+        id=uuid_mod.UUID(row["id"]),
+        name=row["name"],
+        project_name=project_name,
+        configuration=GatewayConfiguration.model_validate(loads(row["configuration"])),
+        created_at=from_iso(row["created_at"]),
+        status=GatewayStatus(row["status"]),
+        status_message=row["status_message"],
+        ip_address=row["ip_address"],
+        hostname=row["hostname"],
+        default=bool(row["is_default"]),
+    )
+
+
+async def get_gateway_row(db: Database, project_id: str, name: str):
+    return await db.fetchone(
+        "SELECT * FROM gateways WHERE project_id = ? AND name = ?", (project_id, name)
+    )
+
+
+async def list_gateways(db: Database, project_row) -> List[Gateway]:
+    rows = await db.fetchall(
+        "SELECT * FROM gateways WHERE project_id = ? ORDER BY created_at",
+        (project_row["id"],),
+    )
+    return [row_to_gateway(r, project_row["name"]) for r in rows]
+
+
+async def create_gateway(
+    db: Database, project_row, conf: GatewayConfiguration
+) -> Gateway:
+    name = conf.name or f"gateway-{new_id()[:8]}"
+    if await get_gateway_row(db, project_row["id"], name) is not None:
+        raise ResourceExistsError(f"gateway {name} already exists")
+    first = await db.fetchone(
+        "SELECT COUNT(*) AS n FROM gateways WHERE project_id = ?", (project_row["id"],)
+    )
+    await db.execute(
+        "INSERT INTO gateways (id, project_id, name, status, configuration, created_at,"
+        " is_default) VALUES (?, ?, ?, ?, ?, ?, ?)",
+        (
+            new_id(),
+            project_row["id"],
+            name,
+            GatewayStatus.SUBMITTED.value,
+            conf.model_dump_json(),
+            to_iso(now_utc()),
+            1 if first["n"] == 0 else 0,  # first gateway becomes the default
+        ),
+    )
+    row = await get_gateway_row(db, project_row["id"], name)
+    return row_to_gateway(row, project_row["name"])
+
+
+async def delete_gateways(db: Database, project_row, names: List[str]) -> None:
+    from dstack_tpu.server.services import backends as backends_service
+
+    for name in names:
+        row = await get_gateway_row(db, project_row["id"], name)
+        if row is None:
+            raise ResourceNotExistsError(f"gateway {name} not found")
+        pd = loads(row["provisioning_data"])
+        if pd:
+            conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
+            try:
+                compute = await backends_service.get_compute(db, project_row, conf.backend)
+                terminate = getattr(compute, "terminate_gateway", None)
+                if terminate is not None:
+                    await terminate(pd.get("instance_id"), conf.region, pd.get("backend_data"))
+            except ResourceNotExistsError:
+                pass  # backend no longer configured; forget the row
+        await db.execute("DELETE FROM gateways WHERE id = ?", (row["id"],))
+
+
+def gateway_token(row) -> Optional[str]:
+    pd = loads(row["provisioning_data"])
+    return (pd or {}).get("token")
+
+
+def gateway_endpoint(row) -> Optional[str]:
+    pd = loads(row["provisioning_data"]) or {}
+    ip = row["ip_address"]
+    port = pd.get("port", 8000)
+    if not ip:
+        return None
+    return f"http://{ip}:{port}"
+
+
+async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> None:
+    """Push every running service's replica endpoints to the appliance registry;
+    unregister services that no longer run. Idempotent per pass."""
+    from dstack_tpu.core.models.runs import RunSpec
+    from dstack_tpu.core.models.services import ServiceSpec
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    endpoint = gateway_endpoint(gateway_row)
+    token = gateway_token(gateway_row)
+    if endpoint is None or token is None:
+        return
+    conf = GatewayConfiguration.model_validate(loads(gateway_row["configuration"]))
+
+    run_rows = await db.fetchall(
+        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0"
+        " AND service_spec IS NOT NULL AND status IN ('running', 'provisioning')",
+        (project_row["id"],),
+    )
+    desired = {}
+    for run_row in run_rows:
+        run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+        service_conf = run_spec.configuration
+        if getattr(service_conf, "gateway", None) is False:
+            continue  # explicitly in-server-proxy only
+        service_spec = ServiceSpec.model_validate(loads(run_row["service_spec"]))
+        replicas = await proxy_service.list_service_replicas(
+            db, project_row["id"], run_row["run_name"]
+        )
+        entry = {
+            "project": project_row["name"],
+            "run_name": run_row["run_name"],
+            "domain": (
+                f"{run_row['run_name']}.{conf.domain}" if conf.domain else None
+            ),
+            "model": (
+                service_spec.model.model_dump(mode="json") if service_spec.model else None
+            ),
+            "replicas": [
+                {"host": jpd.internal_ip or jpd.hostname, "port": port}
+                for _, jpd, _, port in replicas
+            ],
+        }
+        desired[run_row["run_name"]] = entry
+
+    headers = {"Authorization": f"Bearer {token}"}
+    timeout = aiohttp.ClientTimeout(total=10)
+    try:
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(
+                f"{endpoint}/api/registry/services", headers=headers
+            ) as resp:
+                current = {
+                    e["run_name"]: e
+                    for e in await resp.json()
+                    if e["project"] == project_row["name"]
+                }
+            for run_name, entry in desired.items():
+                if current.get(run_name) != entry:
+                    async with session.post(
+                        f"{endpoint}/api/registry/register", json=entry, headers=headers
+                    ) as resp:
+                        resp.raise_for_status()
+            for run_name in set(current) - set(desired):
+                async with session.post(
+                    f"{endpoint}/api/registry/unregister",
+                    json={"project": project_row["name"], "run_name": run_name},
+                    headers=headers,
+                ) as resp:
+                    resp.raise_for_status()
+    except (aiohttp.ClientError, OSError) as e:
+        logger.warning("gateway %s sync failed: %s", gateway_row["name"], e)

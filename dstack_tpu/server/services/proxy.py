@@ -17,7 +17,6 @@ import logging
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
-import aiohttp
 from aiohttp import web
 
 from dstack_tpu.core.models.runs import JobProvisioningData, JobRuntimeData
@@ -65,21 +64,6 @@ stats = ServiceStats()
 # Round-robin cursor per run.
 _rr: Dict[str, int] = {}
 
-# Hop-by-hop headers never forwarded (RFC 9110 §7.6.1).
-_HOP_HEADERS = {
-    "connection",
-    "keep-alive",
-    "proxy-authenticate",
-    "proxy-authorization",
-    "te",
-    "trailers",
-    "transfer-encoding",
-    "upgrade",
-    "host",
-    "content-length",
-}
-
-
 async def list_service_replicas(
     db: Database, project_id: str, run_name: str
 ) -> List[Tuple[dict, JobProvisioningData, Optional[JobRuntimeData], int]]:
@@ -116,7 +100,12 @@ async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, in
 
 
 async def proxy_request(
-    request: web.Request, db: Database, project_row, run_name: str, tail: str
+    request: web.Request,
+    db: Database,
+    project_row,
+    run_name: str,
+    tail: str,
+    body: bytes = None,
 ) -> web.StreamResponse:
     """Forward one HTTP request to a replica; records the request for autoscaling
     (recorded even when no replica is up, so scale-from-zero sees demand)."""
@@ -143,28 +132,6 @@ async def proxy_request(
         logger.warning("proxy: tunnel to %s failed: %s", jpd.hostname, e)
         raise web.HTTPBadGateway(text="replica unreachable")
 
-    url = f"http://{host}:{local_port}/{tail}"
-    if request.query_string:
-        url += f"?{request.query_string}"
-    headers = {
-        k: v for k, v in request.headers.items() if k.lower() not in _HOP_HEADERS
-    }
-    body = await request.read()
-    try:
-        timeout = aiohttp.ClientTimeout(total=300)
-        async with aiohttp.ClientSession(timeout=timeout) as session:
-            async with session.request(
-                request.method, url, headers=headers, data=body, allow_redirects=False
-            ) as upstream:
-                resp = web.StreamResponse(status=upstream.status)
-                for k, v in upstream.headers.items():
-                    if k.lower() not in _HOP_HEADERS:
-                        resp.headers[k] = v
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_chunked(64 * 1024):
-                    await resp.write(chunk)
-                await resp.write_eof()
-                return resp
-    except (aiohttp.ClientError, OSError) as e:
-        logger.warning("proxy: request to replica %s failed: %s", jpd.hostname, e)
-        raise web.HTTPBadGateway(text="replica request failed")
+    from dstack_tpu.core.services.http_forward import forward
+
+    return await forward(request, host, local_port, tail, body=body)

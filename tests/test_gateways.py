@@ -1,0 +1,249 @@
+"""Gateway subsystem: appliance routing, provisioning loop, service sync,
+OpenAI model routing (both in-server and on the appliance).
+
+Parity: reference proxy/gateway/app.py, gateway/services/nginx.py:75-110,
+registry.py:34-373, process_gateways.py. The appliance is a real process
+(`python -m dstack_tpu.gateway`) provisioned by the local backend exactly like
+runner agents; on gcp it is a GCE VM (scripted-transport test)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.gateway.app import create_app
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.server.services import proxy as proxy_service
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from tests.common import api_server
+
+
+async def _echo_app_server(marker: str):
+    """A tiny upstream that echoes path + marker (stands in for a model server)."""
+
+    async def handler(request):
+        body = await request.read()
+        return web.json_response(
+            {"marker": marker, "path": request.path_qs, "body": body.decode() or None}
+        )
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port
+
+
+class TestApplianceRouting:
+    async def test_register_route_model_and_domain(self):
+        up1, port1 = await _echo_app_server("r1")
+        up2, port2 = await _echo_app_server("r2")
+        gw_client = TestClient(TestServer(create_app("tok")))
+        await gw_client.start_server()
+        auth = {"Authorization": "Bearer tok"}
+        try:
+            # Registry requires the token.
+            resp = await gw_client.post("/api/registry/register", json={})
+            assert resp.status == 401
+
+            entry = {
+                "project": "main",
+                "run_name": "llama",
+                "domain": "llama.example.com",
+                "model": {"name": "llama-70b", "prefix": "/v1"},
+                "replicas": [
+                    {"host": "127.0.0.1", "port": port1},
+                    {"host": "127.0.0.1", "port": port2},
+                ],
+            }
+            resp = await gw_client.post("/api/registry/register", json=entry, headers=auth)
+            assert resp.status == 200
+
+            # Path routing round-robins both replicas.
+            markers = set()
+            for _ in range(4):
+                resp = await gw_client.get("/services/main/llama/generate?x=1")
+                assert resp.status == 200
+                data = await resp.json()
+                markers.add(data["marker"])
+                assert data["path"] == "/generate?x=1"
+            assert markers == {"r1", "r2"}
+
+            # OpenAI model routing: body["model"] selects the service, the
+            # request lands on the model prefix.
+            resp = await gw_client.post(
+                "/models/main/v1/chat/completions",
+                json={"model": "llama-70b", "messages": []},
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["path"] == "/v1/chat/completions"
+            assert json.loads(data["body"])["model"] == "llama-70b"
+
+            resp = await gw_client.get("/models/main/v1/models")
+            listing = await resp.json()
+            assert [m["id"] for m in listing["data"]] == ["llama-70b"]
+
+            resp = await gw_client.post(
+                "/models/main/v1/chat/completions", json={"model": "ghost"}
+            )
+            assert resp.status == 404
+
+            # Domain routing via the Host header.
+            resp = await gw_client.get("/infer", headers={"Host": "llama.example.com"})
+            assert (await resp.json())["path"] == "/infer"
+            resp = await gw_client.get("/infer", headers={"Host": "other.example.com"})
+            assert resp.status == 404
+
+            # Unregister removes the routes.
+            await gw_client.post(
+                "/api/registry/unregister",
+                json={"project": "main", "run_name": "llama"},
+                headers=auth,
+            )
+            resp = await gw_client.get("/services/main/llama/x")
+            assert resp.status == 404
+        finally:
+            await gw_client.close()
+            await up1.cleanup()
+            await up2.cleanup()
+
+
+@pytest.mark.skipif(find_runner_binary() is None, reason="native runner binary unavailable")
+class TestGatewayE2E:
+    async def test_provision_sync_and_route(self, tmp_path):
+        """Full path: create a gateway (local backend spawns the real appliance),
+        run a service with a registered model, process_gateways syncs it, traffic
+        routes THROUGH the appliance to the service replica; the in-server
+        /proxy/models route serves the same model."""
+        from tests.test_services import _APP, _drive_until_replicas, _stop_run
+
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        proxy_service.stats.reset()
+        try:
+            async with api_server() as api:
+                gw = await api.post(
+                    "/api/project/main/gateways/create",
+                    {"configuration": {"type": "gateway", "backend": "local", "region": "local", "name": "gw"}},
+                )
+                assert gw["status"] == "submitted"
+                await tasks.process_gateways(api.db)
+                gws = await api.post("/api/project/main/gateways/list")
+                assert gws[0]["status"] == "running"
+                assert gws[0]["ip_address"] == "127.0.0.1"
+                assert gws[0]["default"] is True
+
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "msvc",
+                            "configuration": {
+                                "type": "service",
+                                "commands": [_APP],
+                                "port": 8000,
+                                "model": "pong-model",
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "msvc", 1)
+                await tasks.process_gateways(api.db)  # sync pass
+
+                row = await api.db.fetchone("SELECT * FROM gateways")
+                pd = json.loads(row["provisioning_data"])
+                endpoint = f"http://127.0.0.1:{pd['port']}"
+                async with aiohttp.ClientSession() as session:
+                    # Wait for the service socket, then route through the appliance.
+                    body = None
+                    for _ in range(50):
+                        try:
+                            async with session.get(
+                                f"{endpoint}/services/main/msvc/ping"
+                            ) as resp:
+                                if resp.status == 200:
+                                    body = await resp.text()
+                                    break
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.2)
+                    assert body == "pong:/ping"
+
+                    # The model is served through the appliance's OpenAI surface.
+                    async with session.get(f"{endpoint}/models/main/v1/models") as resp:
+                        listing = await resp.json()
+                    assert [m["id"] for m in listing["data"]] == ["pong-model"]
+
+                # ... and through the in-server model route.
+                resp = await api.client.post(
+                    "/proxy/models/main/v1/chat/completions",
+                    json={"model": "pong-model"},
+                    headers={"Authorization": f"Bearer {api.token}"},
+                )
+                assert resp.status == 200
+                assert (await resp.text()).startswith("pong:/v1/chat/completions")
+
+                resp = await api.client.get(
+                    "/proxy/models/main/v1/models",
+                    headers={"Authorization": f"Bearer {api.token}"},
+                )
+                assert [m["id"] for m in (await resp.json())["data"]] == ["pong-model"]
+
+                # Stop the run; the next sync unregisters it from the appliance.
+                await _stop_run(api, "msvc")
+                await tasks.process_gateways(api.db)
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(
+                        f"{endpoint}/services/main/msvc/ping"
+                    ) as resp:
+                        assert resp.status == 404
+
+                # Delete the gateway: the appliance process dies.
+                await api.post("/api/project/main/gateways/delete", {"names": ["gw"]})
+                await asyncio.sleep(0.3)
+                async with aiohttp.ClientSession() as session:
+                    with pytest.raises(aiohttp.ClientError):
+                        async with session.get(f"{endpoint}/healthcheck"):
+                            pass
+        finally:
+            logs_service.set_log_storage(None)
+
+
+class TestGcpGatewayProvisioning:
+    async def test_create_gateway_vm_via_rest(self):
+        from dstack_tpu.core.models.configurations import GatewayConfiguration
+        from tests.test_gcp_backend import FakeTransport, make_gcp
+
+        t = FakeTransport()
+        t.on(
+            "GET",
+            "/instances/",
+            {
+                "networkInterfaces": [
+                    {"networkIP": "10.0.0.5", "accessConfigs": [{"natIP": "34.1.2.3"}]}
+                ]
+            },
+        )
+        gcp = make_gcp(t)
+        conf = GatewayConfiguration(type="gateway", backend="gcp", region="us-east5")
+        pd = await gcp.create_gateway(conf, "gw-token")
+        assert pd.ip_address == "34.1.2.3"
+        assert json.loads(pd.backend_data)["zone"].startswith("us-east5-")
+        [(method, url, body, _)] = [
+            r for r in t.requests if r[0] == "POST" and "/instances" in r[1]
+        ]
+        assert "compute.googleapis.com" in url
+        assert body["machineType"].endswith("e2-small")
+        script = body["metadata"]["items"][0]["value"]
+        assert "dstack_tpu.gateway" in script and "gw-token" in script
+        assert body["labels"]["dstack_gateway"] == "true"
+
+        await gcp.terminate_gateway(pd.instance_id, "us-east5", pd.backend_data)
+        assert any(r[0] == "DELETE" and "/instances/" in r[1] for r in t.requests)

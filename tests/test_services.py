@@ -35,6 +35,7 @@ _APP = (
     "        self.wfile.write(body)\n"
     "    def log_message(self, *a):\n"
     "        pass\n"
+    "    do_POST = do_GET\n"
     "http.server.HTTPServer(('127.0.0.1', int(os.environ['DSTACK_SERVICE_PORT'])), H).serve_forever()\n"
     "\""
 )
