@@ -1,0 +1,50 @@
+# dstack-tpu build/test/release entry points.
+#
+# Parity: the reference ships its runner binaries + server wheel through CI to an
+# artifact bucket (base/compute.py:612-628 downloads them). Same shape here:
+# `make release` produces everything `gcp` startup scripts fetch — the runner
+# binary (runner_url) and the wheel (gateway_wheel_url) — and `make publish`
+# pushes them to the artifact bucket with gsutil when available.
+
+ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
+DIST := dist
+
+.PHONY: all runner wheel image test test-native test-python release publish clean
+
+all: runner wheel
+
+runner:
+	$(MAKE) -C runner
+
+wheel:
+	python -m pip wheel --no-deps --no-build-isolation -w $(DIST) . \
+	  || python setup.py bdist_wheel -d $(DIST) 2>/dev/null \
+	  || python -m build --wheel -o $(DIST) -n
+
+# The docker/tpu base image (libtpu + JAX + sshd) — the default job image.
+image:
+	docker build -t dstack-tpu/base:latest docker/tpu
+
+test: test-native test-python
+
+test-native:
+	$(MAKE) -C runner test
+
+test-python:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+release: runner wheel
+	@mkdir -p $(DIST)
+	cp runner/build/dstack-tpu-runner $(DIST)/
+	@echo "artifacts in $(DIST)/: $$(ls $(DIST))"
+
+publish: release
+	gsutil cp $(DIST)/dstack-tpu-runner $(ARTIFACT_BUCKET)/dstack-tpu-runner
+	gsutil cp $(DIST)/dstack_tpu-*.whl $(ARTIFACT_BUCKET)/dstack_tpu-latest-py3-none-any.whl
+
+clean:
+	rm -rf $(DIST)
+	$(MAKE) -C runner clean

@@ -170,8 +170,11 @@ class TaskConfiguration(BaseRunConfiguration):
 
     @model_validator(mode="after")
     def _check(self):
-        if not self.commands and self.entrypoint is None:
-            raise ValueError("task requires `commands` (or an image `entrypoint`)")
+        if not self.commands and self.entrypoint is None and self.image is None:
+            raise ValueError(
+                "task requires `commands` (or `entrypoint`, or an `image` whose own"
+                " entrypoint runs the job)"
+            )
         return self
 
 
@@ -202,8 +205,11 @@ class ServiceConfiguration(BaseRunConfiguration):
             self.replicas.max = self.replicas.min
         if self.replicas.min != self.replicas.max and self.scaling is None:
             raise ValueError("autoscaling range of replicas requires `scaling` to be set")
-        if not self.commands and self.entrypoint is None:
-            raise ValueError("service requires `commands` (or an image `entrypoint`)")
+        if not self.commands and self.entrypoint is None and self.image is None:
+            raise ValueError(
+                "service requires `commands` (or `entrypoint`, or an `image` whose own"
+                " entrypoint serves the port)"
+            )
         return self
 
 

@@ -144,6 +144,11 @@ def _build_commands(conf) -> List[str]:
             ' exec python3 -m http.server "$DSTACK_SERVICE_PORT" --bind 127.0.0.1;'
             " fi",
         ]
+    if conf.entrypoint:
+        # An explicit entrypoint overrides image defaults; commands become its body.
+        return [conf.entrypoint, *conf.commands]
+    # Empty commands with an image: the agent runs the image's own entrypoint
+    # (no Cmd override in the container create).
     return list(conf.commands)
 
 

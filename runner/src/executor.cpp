@@ -419,13 +419,17 @@ void Executor::exec_container(uint64_t generation) {
       std::string repo_dir = extract_code();
       dj::Json cfg = dj::Json::object();
       cfg.set("Image", image);
-      dj::Json entry = dj::Json::array();
-      entry.push_back("/bin/sh");
-      entry.push_back("-c");
-      cfg.set("Entrypoint", std::move(entry));
-      dj::Json cmd = dj::Json::array();
-      cmd.push_back(build_script());
-      cfg.set("Cmd", std::move(cmd));
+      // No commands => the image's own ENTRYPOINT/CMD runs the job (reference
+      // honors image defaults the same way, docker.go DockerShellCommands).
+      if (!job_spec_["commands"].as_array().empty()) {
+        dj::Json entry = dj::Json::array();
+        entry.push_back("/bin/sh");
+        entry.push_back("-c");
+        cfg.set("Entrypoint", std::move(entry));
+        dj::Json cmd = dj::Json::array();
+        cmd.push_back(build_script());
+        cfg.set("Cmd", std::move(cmd));
+      }
       dj::Json env = dj::Json::array();
       for (auto& kv : job_env("/workflow")) env.push_back(kv);
       env.push_back("PJRT_DEVICE=TPU");

@@ -43,6 +43,9 @@ class FakeDockerDaemon:
     def __init__(self, socket_path: str, images: Optional[List[str]] = None) -> None:
         self.socket_path = socket_path
         self.images = set(images or [])
+        # image -> argv used when a container config carries no Entrypoint/Cmd
+        # (the engine falls back to the image's baked-in ENTRYPOINT/CMD).
+        self.image_defaults: Dict[str, List[str]] = {}
         self.pulls: List[dict] = []  # {"image", "tag", "auth": decoded-or-None}
         self.pull_error: Optional[str] = None  # set to make pulls fail
         self.creates: List[dict] = []  # every container config passed to create
@@ -155,6 +158,8 @@ class FakeDockerDaemon:
         if c.proc is not None:
             return web.Response(status=304)
         argv = list(c.config.get("Entrypoint") or []) + list(c.config.get("Cmd") or [])
+        if not argv:
+            argv = list(self.image_defaults.get(c.config.get("Image", ""), ["/bin/true"]))
         env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
         for kv in c.config.get("Env") or []:
             k, _, v = kv.partition("=")

@@ -300,6 +300,26 @@ class TestContainerPath:
             runner.kill()
             await daemon.stop()
 
+    async def test_empty_commands_run_image_entrypoint(self, tmp_path):
+        """A job with an image and no commands runs the image's own entrypoint —
+        the create request carries no Entrypoint/Cmd override."""
+        sock = str(tmp_path / "docker.sock")
+        daemon = FakeDockerDaemon(sock, images=["entry/img:1"])
+        daemon.image_defaults["entry/img:1"] = ["/bin/sh", "-c", "echo image-default-ran"]
+        await daemon.start()
+        runner = spawn_runner("always", sock)
+        try:
+            await runner.client.submit(_job_spec([], image="entry/img:1"), ClusterInfo())
+            await runner.client.run_job()
+            final = await _pull_until_terminal(runner.client)
+            assert final["state"] == "done", final
+            assert "image-default-ran" in final["all_logs"]
+            [cfg] = daemon.creates
+            assert "Entrypoint" not in cfg and "Cmd" not in cfg
+        finally:
+            runner.kill()
+            await daemon.stop()
+
     async def test_auto_mode_without_engine_runs_on_host(self, tmp_path):
         runner = spawn_runner("auto", str(tmp_path / "nonexistent.sock"))
         try:
