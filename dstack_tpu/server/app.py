@@ -99,6 +99,15 @@ async def healthcheck(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok", "version": dstack_tpu.__version__})
 
 
+async def dashboard(request: web.Request) -> web.Response:
+    """Read-only admin dashboard (the reference serves a React SPA from
+    server/statics, app.py:292-295; this is the small no-build equivalent)."""
+    from pathlib import Path
+
+    path = Path(__file__).parent / "statics" / "index.html"
+    return web.Response(text=path.read_text(), content_type="text/html")
+
+
 def create_app(
     db_path: Optional[str] = None,
     run_background_tasks: bool = True,
@@ -112,6 +121,7 @@ def create_app(
     app["db"] = Database(db_path if db_path is not None else settings.DB_PATH)
     app["run_background_tasks"] = run_background_tasks
     app.router.add_get("/healthcheck", healthcheck)
+    app.router.add_get("/", dashboard)
     app.add_routes(users_router.routes)
     app.add_routes(projects_router.routes)
     app.add_routes(runs_router.routes)

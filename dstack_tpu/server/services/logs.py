@@ -1,8 +1,10 @@
-"""Log storage: pluggable, file-tree backed by default.
+"""Log storage: pluggable — file tree by default, Cloud Logging as the cloud sink.
 
 Parity: reference server/services/logs/ (base ABC logs/base.py:47, FileLogStorage
-logs/filelog.py). Layout: <LOGS_DIR>/<project_id>/<run_name>/<job id>.jsonl — one JSON
-line per log event, append-only, so polling readers can seek by line offset."""
+logs/filelog.py, GCPLogStorage logs/gcp.py:165). File layout:
+<LOGS_DIR>/<project_id>/<run_name>/<job id>.jsonl — one JSON line per log event,
+append-only, so polling readers can seek by line offset. Select the sink with
+DSTACK_TPU_LOG_STORAGE (unset/`file` | `gcp:<gcp-project-id>`)."""
 
 from __future__ import annotations
 
@@ -71,13 +73,125 @@ class FileLogStorage(LogStorage):
         return out
 
 
+class GcpLogStorage(LogStorage):
+    """Cloud Logging sink over the JSON API (entries.write / entries.list) —
+    SDK-free like the gcp backend; ``request`` is injectable for tests
+    (sync (method, url, json) -> (status, dict)). Log name:
+    projects/<p>/logs/dstack-tpu-run-logs; labels carry project/run/job plus a
+    per-event line number so polling stays offset-based like the file sink."""
+
+    LOG_ID = "dstack-tpu-run-logs"
+    API = "https://logging.googleapis.com/v2"
+
+    def __init__(self, gcp_project: str, request=None) -> None:
+        self.gcp_project = gcp_project
+        self._request = request or self._requests_request
+        self._tokens = None
+        # Next line number per stream (restart => re-derived from a list call).
+        self._lines: dict = {}
+
+    def _requests_request(self, method: str, url: str, payload: dict):
+        import requests as _requests
+
+        if self._tokens is None:
+            from dstack_tpu.backends.gcp.auth import token_provider_from_creds
+
+            self._tokens = token_provider_from_creds(None)
+        import asyncio
+
+        token = asyncio.run(self._tokens.get_token())
+        resp = _requests.request(
+            method, url, json=payload,
+            headers={"Authorization": f"Bearer {token}"}, timeout=30,
+        )
+        try:
+            return resp.status_code, resp.json()
+        except ValueError:
+            return resp.status_code, {}
+
+    def _stream_key(self, project_id: str, run_name: str, job_id: str) -> str:
+        return f"{project_id}/{run_name}/{job_id}"
+
+    def write_logs(self, project_id: str, run_name: str, job_id: str, events: List[LogEvent]) -> None:
+        if not events:
+            return
+        key = self._stream_key(project_id, run_name, job_id)
+        next_line = self._lines.get(key, 0)
+        entries = []
+        for i, ev in enumerate(events):
+            entries.append(
+                {
+                    "logName": f"projects/{self.gcp_project}/logs/{self.LOG_ID}",
+                    "resource": {"type": "global"},
+                    "timestamp": ev.timestamp.isoformat() if ev.timestamp else None,
+                    "labels": {
+                        "project_id": project_id,
+                        "run_name": run_name,
+                        "job_id": job_id,
+                        "line": str(next_line + i),
+                    },
+                    "jsonPayload": {"message": ev.message, "source": ev.log_source.value},
+                }
+            )
+        status, body = self._request(
+            "POST", f"{self.API}/entries:write", {"entries": entries}
+        )
+        if status >= 400:
+            raise RuntimeError(f"Cloud Logging write failed: HTTP {status}: {body}")
+        self._lines[key] = next_line + len(events)
+
+    def poll_logs(
+        self,
+        project_id: str,
+        run_name: str,
+        job_id: str,
+        start_line: int = 0,
+        limit: int = 1000,
+    ) -> List[LogEvent]:
+        flt = (
+            f'logName="projects/{self.gcp_project}/logs/{self.LOG_ID}"'
+            f' AND labels.project_id="{project_id}"'
+            f' AND labels.run_name="{run_name}" AND labels.job_id="{job_id}"'
+        )
+        status, body = self._request(
+            "POST",
+            f"{self.API}/entries:list",
+            {
+                "resourceNames": [f"projects/{self.gcp_project}"],
+                "filter": flt,
+                "orderBy": "timestamp asc",
+                "pageSize": min(start_line + limit, 1000),
+            },
+        )
+        if status >= 400:
+            raise RuntimeError(f"Cloud Logging list failed: HTTP {status}: {body}")
+        out: List[LogEvent] = []
+        for entry in body.get("entries", []):
+            line = int(entry.get("labels", {}).get("line", 0))
+            if line < start_line or len(out) >= limit:
+                continue
+            payload = entry.get("jsonPayload", {})
+            out.append(
+                LogEvent(
+                    timestamp=entry.get("timestamp"),
+                    message=payload.get("message", ""),
+                    log_source=payload.get("source") or "stdout",
+                )
+            )
+        return out
+
+
 _storage: Optional[LogStorage] = None
 
 
 def get_log_storage() -> LogStorage:
     global _storage
     if _storage is None:
-        _storage = FileLogStorage()
+        spec = os.getenv("DSTACK_TPU_LOG_STORAGE", "")
+        if spec.startswith("gcp:"):
+            _storage = GcpLogStorage(spec.split(":", 1)[1])
+        else:
+            _storage = FileLogStorage()
     return _storage
 
 

@@ -194,3 +194,55 @@ class TestStorage:
                 }
             )
             assert await _get_code(api.db, proj["id"], spec) == blob
+
+
+class FakeLoggingRequest:
+    """Scripted Cloud Logging API: stores entries, answers list with a filter."""
+
+    def __init__(self):
+        self.entries = []
+
+    def __call__(self, method, url, payload):
+        if url.endswith("entries:write"):
+            self.entries.extend(payload["entries"])
+            return 200, {}
+        if url.endswith("entries:list"):
+            import re
+
+            flt = payload["filter"]
+            want = dict(re.findall(r'labels\.(\w+)="([^"]+)"', flt))
+            matched = [
+                e
+                for e in self.entries
+                if all(e["labels"].get(k) == v for k, v in want.items())
+            ]
+            return 200, {"entries": matched}
+        return 404, {}
+
+
+class TestGcpLogStorage:
+    def test_write_poll_offsets(self):
+        from dstack_tpu.core.models.logs import LogEvent
+        from dstack_tpu.server.services.logs import GcpLogStorage
+
+        req = FakeLoggingRequest()
+        store = GcpLogStorage("my-gcp-proj", request=req)
+        evs = [
+            LogEvent(timestamp="2026-01-01T00:00:00+00:00", message=f"line-{i}\n")
+            for i in range(5)
+        ]
+        store.write_logs("p1", "run1", "j1", evs[:3])
+        store.write_logs("p1", "run1", "j1", evs[3:])
+        store.write_logs("p1", "other", "j2", evs[:1])
+
+        got = store.poll_logs("p1", "run1", "j1")
+        assert [e.message for e in got] == [f"line-{i}\n" for i in range(5)]
+        # Offset-based resume skips already-read lines.
+        got = store.poll_logs("p1", "run1", "j1", start_line=3)
+        assert [e.message for e in got] == ["line-3\n", "line-4\n"]
+        # Other streams are isolated.
+        got = store.poll_logs("p1", "other", "j2")
+        assert len(got) == 1
+        # The write carried the log name + labels contract.
+        assert req.entries[0]["logName"] == "projects/my-gcp-proj/logs/dstack-tpu-run-logs"
+        assert req.entries[0]["labels"]["line"] == "0"

@@ -293,3 +293,14 @@ class TestOffersCatalog:
             assert prices == sorted(prices)
             # spot offers cheaper than on-demand
             assert any(o["spot"] for o in plan["offers"])
+
+
+class TestDashboard:
+    async def test_dashboard_served_at_root(self):
+        from tests.common import api_server
+
+        async with api_server() as api:
+            resp = await api.client.get("/")
+            assert resp.status == 200
+            text = await resp.text()
+            assert "dstack-tpu" in text and "Runs" in text
