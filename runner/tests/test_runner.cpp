@@ -89,7 +89,9 @@ struct RunResult {
   bool saw_has_more = false;
 };
 
-RunResult pump_until_terminal(drunner::Executor& ex, int timeout_ms = 15000,
+// Generous default deadline: the suite may run on a heavily-loaded 1-CPU box
+// (the full pytest run spawns servers and agents concurrently).
+RunResult pump_until_terminal(drunner::Executor& ex, int timeout_ms = 90000,
                               int64_t start_offset = 0) {
   RunResult r;
   int64_t offset = start_offset;
@@ -214,7 +216,7 @@ void test_pull_pagination() {
   // > kMaxEvents (5000) lines forces paging.
   ex.submit(make_submit("j7", {"for i in $(seq 1 6000); do echo line-$i; done"}));
   ex.run();
-  RunResult r = pump_until_terminal(ex, 30000);
+  RunResult r = pump_until_terminal(ex, 120000);
   CHECK_EQ(r.state, std::string("done"));
   CHECK(r.saw_has_more);
   CHECK(r.logs.find("line-1\r\n") != std::string::npos || r.logs.find("line-1\n") != std::string::npos);

@@ -18,7 +18,7 @@ def test_native_runner_unit_tests():
         ["make", "-C", str(RUNNER_DIR), "test"],
         capture_output=True,
         text=True,
-        timeout=300,
+        timeout=600,
     )
     assert result.returncode == 0, f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert "OK:" in result.stdout
