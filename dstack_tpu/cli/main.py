@@ -340,6 +340,21 @@ def cmd_offer(args) -> None:
     print(f"{result['total']} offers total")
 
 
+_SUBCOMMANDS = (
+    "server config init apply attach metrics ps stop delete logs offer fleet"
+    " gateway volume secret backend instance completion"
+)
+
+
+def cmd_completion(args) -> None:
+    """Emit a shell completion script (parity: reference `dstack completion`)."""
+    if args.shell == "bash":
+        print(f'complete -W "{_SUBCOMMANDS}" dstack-tpu')
+    else:  # zsh
+        print("autoload -Uz compinit && compinit")
+        print(f'compdef "_arguments \'1:command:({_SUBCOMMANDS})\'" dstack-tpu')
+
+
 def cmd_gateway(args) -> None:
     client = _client()
     if args.action == "list":
@@ -506,6 +521,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("action", choices=["list", "delete"])
     s.add_argument("names", nargs="*")
     s.set_defaults(func=cmd_fleet)
+
+    s = sub.add_parser("completion", help="print a shell completion script")
+    s.add_argument("shell", choices=["bash", "zsh"])
+    s.set_defaults(func=cmd_completion)
 
     s = sub.add_parser("gateway", help="manage gateways")
     s.add_argument("action", choices=["list", "delete"])

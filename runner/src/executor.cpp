@@ -644,8 +644,12 @@ void Executor::exec_host(uint64_t generation) {
   while (true) {
     pollfd pfd{master_fd, POLLIN, 0};
     int pr = poll(&pfd, 1, 200);
+    if (pr < 0 && errno != EINTR) break;
     if (pr > 0) {
       ssize_t n = read(master_fd, buf, sizeof(buf));
+      // EINTR/EAGAIN are not EOF: treating them as one silently drops the rest
+      // of the job's output (seen under sanitizers, possible with any signal).
+      if (n < 0 && (errno == EINTR || errno == EAGAIN)) continue;
       if (n <= 0) break;
       partial.append(buf, static_cast<size_t>(n));
       size_t nl;
@@ -657,10 +661,22 @@ void Executor::exec_host(uint64_t generation) {
     int status;
     pid_t done = waitpid(pid, &status, WNOHANG);
     if (done == pid) {
-      // Drain remaining pty output (non-blocking).
+      // Drain remaining pty output (non-blocking; retry EINTR, stop on EAGAIN/EOF).
       fcntl(master_fd, F_SETFL, O_NONBLOCK);
-      ssize_t n;
-      while ((n = read(master_fd, buf, sizeof(buf))) > 0) partial.append(buf, static_cast<size_t>(n));
+      while (true) {
+        ssize_t n = read(master_fd, buf, sizeof(buf));
+        if (n > 0) {
+          partial.append(buf, static_cast<size_t>(n));
+          continue;
+        }
+        if (n < 0 && errno == EINTR) continue;
+        break;
+      }
+      size_t nl;
+      while ((nl = partial.find('\n')) != std::string::npos) {
+        add_log(partial.substr(0, nl + 1));
+        partial.erase(0, nl + 1);
+      }
       if (!partial.empty()) add_log(partial);
       close(master_fd);
       child_pid_ = 0;

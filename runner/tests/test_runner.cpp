@@ -216,6 +216,16 @@ void test_pull_pagination() {
   // > kMaxEvents (5000) lines forces paging.
   ex.submit(make_submit("j7", {"for i in $(seq 1 6000); do echo line-$i; done"}));
   ex.run();
+  // Wait for the terminal state WITHOUT consuming the stream (pull from past the
+  // end reports state only), so the full 6000-line backlog is buffered and the
+  // subsequent pump must page — deterministic regardless of host speed.
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(120);
+  while (std::chrono::steady_clock::now() < deadline) {
+    dj::Json probe = ex.pull((int64_t)1 << 60);
+    const std::string& st = probe["state"].as_string();
+    if (st == "done" || st == "failed") break;
+    usleep(100 * 1000);
+  }
   RunResult r = pump_until_terminal(ex, 120000);
   CHECK_EQ(r.state, std::string("done"));
   CHECK(r.saw_has_more);
