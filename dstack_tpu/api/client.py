@@ -48,8 +48,11 @@ class Client:
         self.token = token
         self.project = project
         self.timeout = timeout
+        from dstack_tpu.core.compatibility import API_VERSION, API_VERSION_HEADER
+
         self._session = requests.Session()
         self._session.headers["Authorization"] = f"Bearer {token}"
+        self._session.headers[API_VERSION_HEADER] = API_VERSION
         self.runs = RunsApi(self)
         self.fleets = FleetsApi(self)
         self.volumes = VolumesApi(self)

@@ -1,0 +1,39 @@
+"""Client/server API compatibility (parity: reference core/compatibility/ +
+check_client_server_compatibility, app.py:273-286).
+
+The wire protocol is versioned by major: clients send ``x-api-version``; the
+server rejects a different MAJOR with a clear error and ignores minor/patch
+drift (pydantic models tolerate unknown fields on input and clients must treat
+unknown response fields the same way — that IS the minor-version contract).
+Requests without the header (curl, browsers, probes) pass."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+API_VERSION = "1.0"
+API_VERSION_HEADER = "x-api-version"
+
+
+def parse_version(v: str) -> Optional[Tuple[int, int]]:
+    parts = v.strip().split(".")
+    try:
+        return int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+    except (ValueError, IndexError):
+        return None
+
+
+def check_client_version(client_version: Optional[str]) -> Optional[str]:
+    """None when compatible; an error message otherwise."""
+    if not client_version:
+        return None
+    client = parse_version(client_version)
+    if client is None:
+        return f"unparsable {API_VERSION_HEADER}: {client_version!r}"
+    server = parse_version(API_VERSION)
+    if client[0] != server[0]:
+        return (
+            f"client API version {client_version} is incompatible with server"
+            f" API version {API_VERSION}; upgrade the older side"
+        )
+    return None

@@ -695,7 +695,7 @@ async def _touch(db: Database, job_row) -> None:
 
 
 async def _resolve_job_secrets(db: Database, project_id: str, spec: JobSpec):
-    """Interpolate ``${{ secrets.X }}`` references in the job env.
+    """Interpolate ``${{ secrets.X }}`` references in the job env and registry auth.
 
     Only secrets the run configuration explicitly references are resolved — never the
     whole project store (any member could otherwise exfiltrate every project secret by
@@ -707,7 +707,9 @@ async def _resolve_job_secrets(db: Database, project_id: str, spec: JobSpec):
     from dstack_tpu.utils.interpolator import extract_references, interpolate_env
 
     env = dict(spec.env or {})
-    referenced = extract_references(env.values(), "secrets")
+    auth = spec.registry_auth
+    auth_values = [auth.username or "", auth.password or ""] if auth else []
+    referenced = extract_references([*env.values(), *auth_values], "secrets")
     if not referenced:
         return spec, {}
     store = await secrets_service.get_secrets(db, project_id)
@@ -716,7 +718,17 @@ async def _resolve_job_secrets(db: Database, project_id: str, spec: JobSpec):
     if missing:
         logger.warning("job references unknown secrets: %s", ", ".join(sorted(missing)))
     env = interpolate_env(env, {"secrets": available}, missing_ok=True)
-    return spec.model_copy(update={"env": env}), {}
+    update: dict = {"env": env}
+    if auth is not None and any("${{" in v for v in auth_values):
+        # Registry credentials are the most common secret consumer (reference
+        # interpolates registry_auth the same way).
+        interpolated = interpolate_env(
+            {"username": auth.username or "", "password": auth.password or ""},
+            {"secrets": available},
+            missing_ok=True,
+        )
+        update["registry_auth"] = type(auth)(**interpolated)
+    return spec.model_copy(update=update), {}
 
 
 async def _get_code(db: Database, project_id: str, run_spec: RunSpec) -> Optional[bytes]:

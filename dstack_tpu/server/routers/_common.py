@@ -36,6 +36,14 @@ _ERROR_STATUS = {
 
 @web.middleware
 async def error_middleware(request: web.Request, handler):
+    from dstack_tpu.core.compatibility import API_VERSION_HEADER, check_client_version
+
+    problem = check_client_version(request.headers.get(API_VERSION_HEADER))
+    if problem is not None:
+        return web.json_response(
+            {"detail": [{"msg": problem, "code": "incompatible_api_version"}]},
+            status=400,
+        )
     try:
         return await handler(request)
     except web.HTTPException:

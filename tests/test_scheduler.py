@@ -459,3 +459,36 @@ class TestTransactionalPlacement:
             await drive(api.db)
             run = await api.post("/api/project/main/runs/get", {"run_name": "pool2"})
             assert run["status"] == "done"
+
+
+class TestRegistryAuthSecrets:
+    async def test_registry_auth_secret_interpolation(self, monkeypatch):
+        """${{ secrets.X }} in registry_auth resolves at submit time (the most
+        common secret consumer; reference interpolates it the same way)."""
+        from dstack_tpu.server.background import tasks as _tasks
+
+        monkeypatch.setattr(_tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+        FakeRunnerClient.reset()
+        backends_service.reset_compute_cache()
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/secrets/set",
+                {"name": "REG_TOKEN", "value": "sekrit-pull-token"},
+            )
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "regauth", "v5e-8",
+                    image="private.io/img:1",
+                    registry_auth={"username": "bot", "password": "${{ secrets.REG_TOKEN }}"},
+                ),
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "regauth"})
+            assert run["status"] == "done"
+            [fake] = FakeRunnerClient.registry.values()
+            assert fake.submitted.registry_auth.password == "sekrit-pull-token"
+            # The stored job spec keeps the placeholder, not the secret.
+            row = await api.db.fetchone("SELECT job_spec FROM jobs LIMIT 1")
+            assert "sekrit" not in row["job_spec"]

@@ -304,3 +304,29 @@ class TestDashboard:
             assert resp.status == 200
             text = await resp.text()
             assert "dstack-tpu" in text and "Runs" in text
+
+
+class TestApiCompatibility:
+    async def test_version_header_enforced_by_major(self):
+        from tests.common import api_server
+
+        async with api_server() as api:
+            headers = {"Authorization": f"Bearer {api.token}"}
+            # Same major: fine (any minor).
+            resp = await api.client.post(
+                "/api/project/main/runs/list", json={},
+                headers={**headers, "x-api-version": "1.7"},
+            )
+            assert resp.status == 200
+            # Different major: clear rejection.
+            resp = await api.client.post(
+                "/api/project/main/runs/list", json={},
+                headers={**headers, "x-api-version": "2.0"},
+            )
+            assert resp.status == 400
+            assert "incompatible" in await resp.text()
+            # No header (curl/probes): passes.
+            resp = await api.client.post(
+                "/api/project/main/runs/list", json={}, headers=headers
+            )
+            assert resp.status == 200
