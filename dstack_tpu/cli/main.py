@@ -106,6 +106,41 @@ def _pack_code(root: Path, max_size: int) -> Optional[bytes]:
     return data if len(data) <= max_size else None
 
 
+def _detect_git(root: Path):
+    """(clone_url, commit) when the tree is a git clone whose HEAD exists on a
+    remote; None otherwise (falls back to tarball upload)."""
+    import subprocess
+
+    def _git(*a):
+        r = subprocess.run(
+            ["git", "-C", str(root), *a], capture_output=True, text=True, timeout=20
+        )
+        return r.stdout.strip() if r.returncode == 0 else None
+
+    url = _git("remote", "get-url", "origin")
+    commit = _git("rev-parse", "HEAD")
+    if not url or not commit:
+        return None
+    # The worker can only check the commit out if some remote ref contains it.
+    if _git("branch", "-r", "--contains", commit) in (None, ""):
+        return None
+    return url, commit
+
+
+def _pack_diff(root: Path, max_size: int) -> Optional[bytes]:
+    """`git diff HEAD --binary` (staged + unstaged, tracked files); None when git
+    fails or the diff exceeds the cap. An empty tree diffs to b""."""
+    import subprocess
+
+    r = subprocess.run(
+        ["git", "-C", str(root), "diff", "HEAD", "--binary"],
+        capture_output=True, timeout=60,
+    )
+    if r.returncode != 0 or len(r.stdout) > max_size:
+        return None
+    return r.stdout
+
+
 def cmd_apply(args) -> None:
     path = Path(args.file)
     data = yaml.safe_load(path.read_text())
@@ -151,15 +186,29 @@ def cmd_apply(args) -> None:
 
     run_spec["run_name"] = name
     if not args.no_repo:
-        code = _pack_code(Path.cwd(), server_settings.MAX_CODE_SIZE)
-        if code is None:
-            print("warning: working tree exceeds the code size limit; running without code")
-        else:
-            repo = _repo_name()
-            client.repos.init(repo)
-            code_hash = client.repos.upload_code(repo, code)
+        cwd = Path.cwd()
+        repo = _repo_name()
+        git = _detect_git(cwd)
+        diff = _pack_diff(cwd, server_settings.MAX_CODE_SIZE) if git else None
+        if git is not None and diff is not None:
+            # Git mode: workers clone + checkout; only the working-tree diff
+            # travels, so repo size never hits the upload cap.
+            clone_url, commit = git
+            client.repos.init(repo, repo_info={"clone_url": clone_url})
+            repo_data = {"mode": "git", "clone_url": clone_url, "commit": commit}
+            if diff:
+                repo_data["code_hash"] = client.repos.upload_code(repo, diff)
             run_spec["repo_id"] = repo
-            run_spec["repo_data"] = {"code_hash": code_hash}
+            run_spec["repo_data"] = repo_data
+        else:
+            code = _pack_code(cwd, server_settings.MAX_CODE_SIZE)
+            if code is None:
+                print("warning: working tree exceeds the code size limit; running without code")
+            else:
+                client.repos.init(repo)
+                code_hash = client.repos.upload_code(repo, code)
+                run_spec["repo_id"] = repo
+                run_spec["repo_data"] = {"code_hash": code_hash}
 
     if plan.action == "update":
         run = client.runs.update(run_spec)

@@ -76,6 +76,7 @@ dj::Json Executor::submit(const dj::Json& body) {
   }
   job_spec_ = body["job_spec"];
   cluster_info_ = body["cluster_info"];
+  repo_data_ = body["run_spec"]["repo_data"];
   secrets_ = body["secrets"];
   has_job_ = true;
   job_started_ = false;
@@ -255,6 +256,32 @@ void Executor::trim_events_locked() {
 
 std::string Executor::extract_code() {
   std::string repo_dir = base_dir_ + "/repo";
+  // Git mode (reference executor/repo.go + repo/{manager,diff}.go): clone the
+  // named remote, check out the pinned commit, apply the uploaded working-tree
+  // diff. The blob channel carries the DIFF instead of a tarball, so huge repos
+  // never hit the code-size cap.
+  if (repo_data_["mode"].as_string() == "git" &&
+      !repo_data_["clone_url"].as_string().empty()) {
+    const std::string& url = repo_data_["clone_url"].as_string();
+    const std::string& commit = repo_data_["commit"].as_string();
+    std::string cmd = "rm -rf '" + repo_dir + "' && git clone -q '" + url + "' '" +
+                      repo_dir + "' 2>&1";
+    if (!commit.empty()) {
+      cmd += " && git -C '" + repo_dir + "' checkout -q '" + commit + "' 2>&1";
+    }
+    if (system(cmd.c_str()) == 0) {
+      add_log("checked out " + url + (commit.empty() ? "" : " @ " + commit.substr(0, 12)) + "\n");
+      if (!code_path_.empty()) {
+        std::string apply = "git -C '" + repo_dir + "' apply --whitespace=nowarn '" +
+                            code_path_ + "' 2>&1";
+        if (system(apply.c_str()) != 0) {
+          add_log("warning: applying the working-tree diff failed\n");
+        }
+      }
+      return repo_dir;
+    }
+    add_log("warning: git clone/checkout failed; falling back to the code archive\n");
+  }
   mkdir(repo_dir.c_str(), 0755);
   if (!code_path_.empty()) {
     std::string cmd = "tar -xzf '" + code_path_ + "' -C '" + repo_dir + "' 2>/dev/null";

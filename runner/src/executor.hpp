@@ -65,6 +65,7 @@ class Executor {
   std::string base_dir_;
   std::string docker_mode_;
   std::string docker_socket_;
+  dj::Json repo_data_;  // run_spec.repo_data: git clone/checkout/diff contract
   dj::Json job_spec_;
   dj::Json cluster_info_;
   dj::Json secrets_;
