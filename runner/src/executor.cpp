@@ -519,6 +519,16 @@ void Executor::exec_container(uint64_t generation) {
       }
       host.set("Devices", std::move(devices));
       host.set("ShmSize", static_cast<int64_t>(1) << 30);
+      // Resource caps from the job's requirements (reference shim/docker.go:825
+      // NanoCPUs/Memory): upper bound when a range max is set, else the floor.
+      const dj::Json& res = job_spec_["requirements"]["resources"];
+      double cpus = res["cpu"]["count"]["max"].as_number(
+          res["cpu"]["count"]["min"].as_number(0));
+      if (cpus > 0) host.set("NanoCpus", static_cast<int64_t>(cpus * 1e9));
+      double mem_gb = res["memory"]["max"].as_number(res["memory"]["min"].as_number(0));
+      if (mem_gb > 0) {
+        host.set("Memory", static_cast<int64_t>(mem_gb * 1024.0 * 1024.0 * 1024.0));
+      }
       cfg.set("HostConfig", std::move(host));
 
       try {

@@ -150,6 +150,10 @@ class TestContainerPath:
             assert isinstance(host["Devices"], list)
             for d in host["Devices"]:
                 assert d["CgroupPermissions"] == "rwm"
+            # Resource caps derived from the requirements floor (default cpu>=2,
+            # memory>=8GB).
+            assert host["NanoCpus"] == 2_000_000_000
+            assert host["Memory"] == 8 * 1024**3
         finally:
             runner.kill()
             await daemon.stop()
