@@ -173,6 +173,14 @@ class FakeDockerDaemon:
             if dst and workdir.startswith(dst) and os.path.isdir(src):
                 cwd = src + workdir[len(dst):]
                 break
+        # Simulate other binds (volume mounts) for the host-process "container":
+        # symlink the target to the source, but only under /tmp — the fake must
+        # never touch real system paths.
+        for bind in host_config.get("Binds") or []:
+            src, _, dst = bind.partition(":")
+            if dst.startswith("/tmp/") and os.path.exists(src) and not os.path.exists(dst):
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                os.symlink(src, dst)
         c.proc = await asyncio.create_subprocess_exec(
             *argv,
             stdout=asyncio.subprocess.PIPE,
