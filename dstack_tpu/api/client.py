@@ -63,6 +63,7 @@ class Client:
         self.logs = LogsApi(self)
         self.metrics = MetricsApi(self)
         self.gateways = GatewaysApi(self)
+        self.projects = ProjectsApi(self)
         self.instances = InstancesApi(self)
 
     def post(self, path: str, body: Optional[dict] = None, data: Optional[bytes] = None) -> Any:
@@ -245,6 +246,20 @@ class InstancesApi:
     def list(self) -> List[Instance]:
         data = self._c.post(self._c._p("/instances/list"))
         return [Instance.model_validate(i) for i in data]
+
+
+class ProjectsApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def list(self) -> List[dict]:
+        return self._c.post("/api/projects/list")
+
+    def create(self, name: str) -> dict:
+        return self._c.post("/api/projects/create", {"project_name": name})
+
+    def delete(self, names: List[str]) -> None:
+        self._c.post("/api/projects/delete", {"projects_names": names})
 
 
 class GatewaysApi:

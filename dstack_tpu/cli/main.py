@@ -391,7 +391,7 @@ def cmd_offer(args) -> None:
 
 _SUBCOMMANDS = (
     "server config init apply attach metrics ps stop delete logs offer fleet"
-    " gateway volume secret backend instance completion"
+    " gateway volume secret backend instance project completion"
 )
 
 
@@ -416,6 +416,27 @@ def cmd_gateway(args) -> None:
     elif args.action == "delete":
         client.gateways.delete(args.names)
         print(f"deleted {len(args.names)} gateway(s)")
+
+
+def cmd_project(args) -> None:
+    client = _client()
+    if args.action == "list":
+        rows = [
+            [
+                p["project_name"],
+                (p.get("owner") or {}).get("username", "-"),
+                str(len(p.get("members") or [])),
+            ]
+            for p in client.projects.list()
+        ]
+        print(_table(["PROJECT", "OWNER", "MEMBERS"], rows))
+    elif args.action == "create":
+        for name in args.names:
+            client.projects.create(name)
+            print(f"created project {name}")
+    elif args.action == "delete":
+        client.projects.delete(args.names)
+        print(f"deleted {len(args.names)} project(s)")
 
 
 def cmd_fleet(args) -> None:
@@ -570,6 +591,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("action", choices=["list", "delete"])
     s.add_argument("names", nargs="*")
     s.set_defaults(func=cmd_fleet)
+
+    s = sub.add_parser("project", help="manage projects")
+    s.add_argument("action", choices=["list", "create", "delete"])
+    s.add_argument("names", nargs="*")
+    s.set_defaults(func=cmd_project)
 
     s = sub.add_parser("completion", help="print a shell completion script")
     s.add_argument("shell", choices=["bash", "zsh"])

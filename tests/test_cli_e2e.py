@@ -152,3 +152,17 @@ class TestCliE2E:
         )
         assert result.returncode == 1
         assert "failed" in result.stderr
+
+
+class TestProjectCommand:
+    def test_project_list_create_delete(self, server, tmp_path):
+        base = server
+        _cli(["config", "--url", base, "--token", TOKEN, "--project", "main"], tmp_path, tmp_path)
+        out = _cli(["project", "list"], tmp_path, tmp_path)
+        assert "main" in out.stdout and "admin" in out.stdout
+        _cli(["project", "create", "research"], tmp_path, tmp_path)
+        out = _cli(["project", "list"], tmp_path, tmp_path)
+        assert "research" in out.stdout
+        _cli(["project", "delete", "research"], tmp_path, tmp_path)
+        out = _cli(["project", "list"], tmp_path, tmp_path)
+        assert "research" not in out.stdout
