@@ -90,6 +90,13 @@ async def _on_cleanup(app: web.Application) -> None:
     bg = app.get("background")
     if bg is not None:
         await bg.stop()
+    # Reap every SSH tunnel child; orphaned ssh -N processes outlive us otherwise.
+    try:
+        from dstack_tpu.server.services.runner import ssh as runner_ssh
+
+        await runner_ssh.close_all_tunnels()
+    except Exception:
+        logger.exception("closing tunnels during shutdown failed")
     await app["db"].close()
 
 
