@@ -1,0 +1,54 @@
+"""Token-bucket rate limiting for service ingress.
+
+Parity: reference gateway nginx ``limit_req`` zones generated per service
+prefix (gateway/services/nginx.py) + RateLimit config (configurations.py:112).
+One bucket per (service, prefix); rps refills, burst is the bucket depth."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class TokenBucket:
+    def __init__(self, rps: float, burst: int) -> None:
+        self.rps = rps
+        self.capacity = max(1, burst)
+        self.tokens = float(self.capacity)
+        self.updated = time.monotonic()
+
+    def allow(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.capacity, self.tokens + (now - self.updated) * self.rps)
+        self.updated = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class RateLimiter:
+    """Buckets keyed by (scope, prefix); limits matched longest-prefix-first."""
+
+    def __init__(self) -> None:
+        self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
+
+    def check(self, scope: str, path: str, limits: List[dict]) -> bool:
+        """True = allowed. `limits` rows: {prefix, rps, burst}."""
+        matched: Optional[dict] = None
+        for lim in sorted(limits, key=lambda l: -len(l.get("prefix", "/"))):
+            if path.startswith(lim.get("prefix", "/")):
+                matched = lim
+                break
+        if matched is None:
+            return True
+        key = (scope, matched.get("prefix", "/"))
+        bucket = self._buckets.get(key)
+        if bucket is None or bucket.rps != matched["rps"]:
+            bucket = self._buckets[key] = TokenBucket(
+                float(matched["rps"]), int(matched.get("burst", 1))
+            )
+        return bucket.allow()
+
+    def reset(self) -> None:
+        self._buckets.clear()

@@ -46,6 +46,7 @@ class ServiceEntry:
         self.replicas: List[Tuple[str, int]] = [
             (r["host"], int(r["port"])) for r in data.get("replicas", [])
         ]
+        self.rate_limits: List[dict] = data.get("rate_limits") or []
         self._rr = 0
 
     def pick_replica(self) -> Tuple[str, int]:
@@ -64,6 +65,7 @@ class ServiceEntry:
                 else None
             ),
             "replicas": [{"host": h, "port": p} for h, p in self.replicas],
+            "rate_limits": self.rate_limits,
         }
 
 
@@ -105,9 +107,18 @@ class Registry:
 
 
 def create_app(token: str) -> web.Application:
+    from dstack_tpu.core.services.rate_limit import RateLimiter
+
     registry = Registry()
+    limiter = RateLimiter()
     app = web.Application()
     app["registry"] = registry
+
+    def _rate_check(entry: ServiceEntry, path: str) -> None:
+        if entry.rate_limits and not limiter.check(
+            f"{entry.project}/{entry.run_name}", path, entry.rate_limits
+        ):
+            raise web.HTTPTooManyRequests(text="rate limit exceeded")
 
     def _auth(request: web.Request) -> None:
         header = request.headers.get("Authorization", "")
@@ -147,6 +158,7 @@ def create_app(token: str) -> web.Application:
             raise web.HTTPNotFound(text="unknown service")
         if not entry.replicas:
             raise web.HTTPServiceUnavailable(text="service has no replicas")
+        _rate_check(entry, "/" + request.match_info.get("tail", ""))
         host, port = entry.pick_replica()
         return await forward(request, host, port, request.match_info.get("tail", ""))
 
@@ -186,6 +198,7 @@ def create_app(token: str) -> web.Application:
             raise web.HTTPNotFound(text="unknown host")
         if not entry.replicas:
             raise web.HTTPServiceUnavailable(text="service has no replicas")
+        _rate_check(entry, request.path)
         host, port = entry.pick_replica()
         return await forward(request, host, port, request.match_info.get("tail", ""))
 

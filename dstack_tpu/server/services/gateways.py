@@ -164,6 +164,10 @@ async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> No
                 {"host": jpd.internal_ip or jpd.hostname, "port": port}
                 for _, jpd, _, port in replicas
             ],
+            "rate_limits": [
+                l.model_dump(mode="json")
+                for l in getattr(service_conf, "rate_limits", []) or []
+            ],
         }
         desired[run_row["run_name"]] = entry
 

@@ -61,6 +61,10 @@ class ServiceStats:
 
 stats = ServiceStats()
 
+from dstack_tpu.core.services.rate_limit import RateLimiter
+
+rate_limiter = RateLimiter()
+
 # Round-robin cursor per run.
 _rr: Dict[str, int] = {}
 
@@ -116,6 +120,16 @@ async def proxy_request(
     if run_row is None:
         raise web.HTTPNotFound(text=f"no service run {run_name}")
     stats.record(run_row["id"])
+
+    # rate_limits: token buckets per configured prefix (reference nginx limit_req).
+    from dstack_tpu.core.models.runs import RunSpec
+
+    conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
+    limits = [
+        l.model_dump(mode="json") for l in getattr(conf, "rate_limits", []) or []
+    ]
+    if limits and not rate_limiter.check(run_row["id"], "/" + tail, limits):
+        raise web.HTTPTooManyRequests(text="rate limit exceeded")
 
     replicas = await list_service_replicas(db, project_row["id"], run_name)
     if not replicas:

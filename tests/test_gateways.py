@@ -247,3 +247,68 @@ class TestGcpGatewayProvisioning:
 
         await gcp.terminate_gateway(pd.instance_id, "us-east5", pd.backend_data)
         assert any(r[0] == "DELETE" and "/instances/" in r[1] for r in t.requests)
+
+
+class TestRateLimits:
+    async def test_rate_limit_enforced_on_appliance(self):
+        """rate_limits buckets requests per prefix (reference nginx limit_req)."""
+        up, port = await _echo_app_server("rl")
+        gw_client = TestClient(TestServer(create_app("tok")))
+        await gw_client.start_server()
+        try:
+            await gw_client.post(
+                "/api/registry/register",
+                json={
+                    "project": "main",
+                    "run_name": "limited",
+                    "replicas": [{"host": "127.0.0.1", "port": port}],
+                    "rate_limits": [{"prefix": "/", "rps": 1, "burst": 2}],
+                },
+                headers={"Authorization": "Bearer tok"},
+            )
+            statuses = []
+            for _ in range(5):
+                resp = await gw_client.get("/services/main/limited/x")
+                statuses.append(resp.status)
+            # burst of 2 passes, the rest are throttled.
+            assert statuses[:2] == [200, 200]
+            assert 429 in statuses[2:]
+        finally:
+            await gw_client.close()
+            await up.cleanup()
+
+    async def test_in_server_proxy_rate_limit(self, tmp_path):
+        from dstack_tpu.server.services import logs as logs_service
+        from dstack_tpu.server.services.proxy import rate_limiter
+        from tests.test_services import _APP, _drive_until_replicas, _stop_run
+
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        rate_limiter.reset()
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "rlsvc",
+                            "configuration": {
+                                "type": "service",
+                                "commands": [_APP],
+                                "port": 8000,
+                                "rate_limits": [{"prefix": "/", "rps": 1, "burst": 2}],
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "rlsvc", 1)
+                headers = {"Authorization": f"Bearer {api.token}"}
+                statuses = []
+                for _ in range(5):
+                    resp = await api.client.get(
+                        "/proxy/services/main/rlsvc/ping", headers=headers
+                    )
+                    statuses.append(resp.status)
+                assert 429 in statuses
+                await _stop_run(api, "rlsvc")
+        finally:
+            logs_service.set_log_storage(None)
