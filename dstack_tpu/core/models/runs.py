@@ -246,6 +246,9 @@ class JobRuntimeData(CoreModel):
     pull_offset: int = 0
     started_at: Optional[datetime.datetime] = None  # first observed RUNNING transition
     ports_mapping: Dict[int, int] = Field(default_factory=dict)
+    # Service replicas: last readiness-probe outcome (TCP connect to the app
+    # socket, process_services); the proxy prefers ready replicas.
+    probe_ready: Optional[bool] = None
     volume_names: List[str] = Field(default_factory=list)
 
 

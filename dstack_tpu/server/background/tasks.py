@@ -1416,7 +1416,14 @@ async def process_services(db: Database, batch: Optional[int] = None) -> None:
     for run_row in rows:
         run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
         conf = run_spec.configuration
-        if getattr(conf, "type", None) != "service" or conf.scaling is None:
+        if getattr(conf, "type", None) != "service":
+            continue
+        # Readiness probes for every service (reference service probes): the
+        # proxy and gateway route only to replicas whose socket answers.
+        await proxy_service.probe_service_replicas(
+            db, run_row["project_id"], run_row["run_name"]
+        )
+        if conf.scaling is None:
             continue
         async with get_locker().lock(f"run:{run_row['id']}"):
             job_rows = await db.fetchall(

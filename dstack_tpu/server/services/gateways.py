@@ -149,7 +149,7 @@ async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> No
             continue  # explicitly in-server-proxy only
         service_spec = ServiceSpec.model_validate(loads(run_row["service_spec"]))
         replicas = await proxy_service.list_service_replicas(
-            db, project_row["id"], run_row["run_name"]
+            db, project_row["id"], run_row["run_name"], ready_only=True
         )
         entry = {
             "project": project_row["name"],

@@ -69,12 +69,14 @@ rate_limiter = RateLimiter()
 _rr: Dict[str, int] = {}
 
 async def list_service_replicas(
-    db: Database, project_id: str, run_name: str
+    db: Database, project_id: str, run_name: str, ready_only: bool = False
 ) -> List[Tuple[dict, JobProvisioningData, Optional[JobRuntimeData], int]]:
     """(job_row, jpd, jrd, effective_port) for every RUNNING replica of a service.
 
     The service socket lives on job 0 of each replica (the slice's worker 0 for
-    multi-host services)."""
+    multi-host services). With ready_only, replicas whose last readiness probe
+    failed are dropped — but an un-probed replica (probe_ready None) stays in,
+    so traffic flows before the first probe pass."""
     rows = await db.fetchall(
         "SELECT j.* FROM jobs j JOIN runs r ON r.id = j.run_id"
         " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
@@ -90,11 +92,42 @@ async def list_service_replicas(
         if jpd is None or jpd.hostname is None:
             continue
         jrd = job_jrd(row)
+        if ready_only and jrd is not None and jrd.probe_ready is False:
+            continue
         port = spec.service_port
         if jrd is not None and jrd.ports_mapping:
             port = jrd.ports_mapping.get(spec.service_port, port)
         out.append((row, jpd, jrd, port))
     return out
+
+
+async def probe_service_replicas(db: Database, project_id: str, run_name: str) -> None:
+    """TCP-connect readiness probe per replica socket; outcome lands in
+    job_runtime_data.probe_ready (reference service probes/nginx health checks)."""
+    import asyncio
+
+    for row, jpd, jrd, port in await list_service_replicas(db, project_id, run_name):
+        ready = False
+        try:
+            host, eport = await replica_endpoint(jpd, port)
+            _, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, eport), timeout=2.0
+            )
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            ready = True
+        except Exception:
+            ready = False  # tunnel failures, refused/timed-out connects alike
+        jrd = jrd or JobRuntimeData()
+        if jrd.probe_ready != ready:
+            jrd.probe_ready = ready
+            await db.execute(
+                "UPDATE jobs SET job_runtime_data = ? WHERE id = ?",
+                (jrd.model_dump_json(), row["id"]),
+            )
 
 
 async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, int]:
@@ -131,10 +164,17 @@ async def proxy_request(
     if limits and not rate_limiter.check(run_row["id"], "/" + tail, limits):
         raise web.HTTPTooManyRequests(text="rate limit exceeded")
 
-    replicas = await list_service_replicas(db, project_row["id"], run_name)
+    replicas = await list_service_replicas(
+        db, project_row["id"], run_name, ready_only=True
+    )
     if not replicas:
+        any_replicas = await list_service_replicas(db, project_row["id"], run_name)
         raise web.HTTPServiceUnavailable(
-            text=f"service {run_name} has no running replicas"
+            text=(
+                f"service {run_name} replicas are starting (readiness probe pending)"
+                if any_replicas
+                else f"service {run_name} has no running replicas"
+            )
         )
     cursor = _rr.get(run_row["id"], 0)
     _rr[run_row["id"]] = cursor + 1

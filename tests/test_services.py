@@ -205,3 +205,60 @@ class TestAutoscaler:
                 await _stop_run(api, "asvc")
         finally:
             logs_service.set_log_storage(None)
+
+
+class TestReadinessProbes:
+    async def test_unready_replica_excluded_until_socket_answers(self, tmp_path):
+        """A replica whose app socket is not yet up fails the probe and is dropped
+        from routing; once the socket answers, a later probe readmits it."""
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        proxy_service.stats.reset()
+        try:
+            async with api_server() as api:
+                # The app sleeps before binding, so the first probe must fail.
+                slow_app = _APP.replace(
+                    "import http.server, os\n", "import http.server, os, time\ntime.sleep(2)\n"
+                )
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "probe-svc",
+                            "configuration": {
+                                "type": "service",
+                                "commands": [slow_app],
+                                "port": 8000,
+                            },
+                        }
+                    },
+                )
+                await _drive_until_replicas(api, "probe-svc", 1)
+                await tasks.process_services(api.db)  # first probe: socket not up
+                replicas = await proxy_service.list_service_replicas(
+                    api.db, (await api.db.fetchone("SELECT * FROM projects"))["id"],
+                    "probe-svc", ready_only=True,
+                )
+                assert replicas == []
+                resp = await api.client.get(
+                    "/proxy/services/main/probe-svc/ping",
+                    headers={"Authorization": f"Bearer {api.token}"},
+                )
+                assert resp.status == 503
+                assert "starting" in await resp.text()
+
+                # Socket comes up; a later probe readmits the replica.
+                ok = False
+                for _ in range(40):
+                    await asyncio.sleep(0.3)
+                    await tasks.process_services(api.db)
+                    resp = await api.client.get(
+                        "/proxy/services/main/probe-svc/ping",
+                        headers={"Authorization": f"Bearer {api.token}"},
+                    )
+                    if resp.status == 200:
+                        ok = True
+                        break
+                assert ok
+                await _stop_run(api, "probe-svc")
+        finally:
+            logs_service.set_log_storage(None)
