@@ -44,10 +44,10 @@ class RateLimiter:
             return True
         key = (scope, matched.get("prefix", "/"))
         bucket = self._buckets.get(key)
-        if bucket is None or bucket.rps != matched["rps"]:
-            bucket = self._buckets[key] = TokenBucket(
-                float(matched["rps"]), int(matched.get("burst", 1))
-            )
+        burst = int(matched.get("burst", 1))
+        # Recreate on ANY config change (rps or burst) so updates apply live.
+        if bucket is None or bucket.rps != float(matched["rps"]) or bucket.capacity != max(1, burst):
+            bucket = self._buckets[key] = TokenBucket(float(matched["rps"]), burst)
         return bucket.allow()
 
     def reset(self) -> None:

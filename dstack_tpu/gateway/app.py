@@ -187,6 +187,8 @@ def create_app(token: str) -> web.Application:
             raise web.HTTPNotFound(text=f"no service serves model {model_name}")
         if not entry.replicas:
             raise web.HTTPServiceUnavailable(text="service has no replicas")
+        # Limits match the upstream path the request lands on, same as /services/.
+        _rate_check(entry, f"{entry.model_prefix}/{tail}")
         host, port = entry.pick_replica()
         return await forward(
             request, host, port, f"{entry.model_prefix}/{tail}", body=body

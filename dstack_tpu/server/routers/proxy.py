@@ -41,7 +41,9 @@ async def _handle(request: web.Request) -> web.StreamResponse:
     if getattr(conf, "auth", True):
         await auth_project(request)
 
-    return await proxy_service.proxy_request(request, db, project_row, run_name, tail)
+    return await proxy_service.proxy_request(
+        request, db, project_row, run_name, tail, conf=conf
+    )
 
 
 routes.route("*", "/proxy/services/{project_name}/{run_name}/{tail:.*}")(_handle)
@@ -98,8 +100,10 @@ async def model_route(request: web.Request) -> web.StreamResponse:
         raise web.HTTPNotFound(text=f"no service serves model {model_name!r}")
     run_row, model = models[model_name]
     prefix = (model.prefix or "/v1").strip("/")
+    serving_conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
     return await proxy_service.proxy_request(
-        request, db, project_row, run_row["run_name"], f"{prefix}/{tail}", body=body
+        request, db, project_row, run_row["run_name"], f"{prefix}/{tail}",
+        body=body, conf=serving_conf,
     )
 
 

@@ -102,32 +102,61 @@ async def list_service_replicas(
 
 
 async def probe_service_replicas(db: Database, project_id: str, run_name: str) -> None:
-    """TCP-connect readiness probe per replica socket; outcome lands in
-    job_runtime_data.probe_ready (reference service probes/nginx health checks)."""
+    """Readiness probe per replica socket; outcome lands in
+    job_runtime_data.probe_ready (reference service probes/nginx health checks).
+
+    Probes run concurrently (one slow replica must not stall the pass), bound by
+    one deadline that covers tunnel establishment too. An `ssh -L` forward
+    accepts locally even when the remote connect fails and then closes the
+    channel — so after connecting we read briefly: immediate EOF = not ready,
+    open-and-quiet (or data) = ready. Writes re-read the row under the run lock
+    and change ONLY probe_ready, so they never clobber the pull loop's
+    concurrent jrd updates."""
     import asyncio
 
-    for row, jpd, jrd, port in await list_service_replicas(db, project_id, run_name):
-        ready = False
-        try:
+    from dstack_tpu.server.services.locking import get_locker
+
+    replicas = await list_service_replicas(db, project_id, run_name)
+    if not replicas:
+        return
+
+    async def _probe_one(jpd: JobProvisioningData, port: int) -> bool:
+        async def _connect_and_check() -> bool:
             host, eport = await replica_endpoint(jpd, port)
-            _, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, eport), timeout=2.0
-            )
-            writer.close()
+            reader, writer = await asyncio.open_connection(host, eport)
             try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
-            ready = True
+                try:
+                    data = await asyncio.wait_for(reader.read(1), timeout=0.5)
+                except asyncio.TimeoutError:
+                    return True  # open and quiet: a listening app socket
+                return bool(data)  # data = alive; EOF = tunnel-relayed refusal
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionError, OSError):
+                    pass
+
+        try:
+            return await asyncio.wait_for(_connect_and_check(), timeout=5.0)
         except Exception:
-            ready = False  # tunnel failures, refused/timed-out connects alike
-        jrd = jrd or JobRuntimeData()
-        if jrd.probe_ready != ready:
-            jrd.probe_ready = ready
-            await db.execute(
-                "UPDATE jobs SET job_runtime_data = ? WHERE id = ?",
-                (jrd.model_dump_json(), row["id"]),
-            )
+            return False  # tunnel failures, refused/timed-out connects alike
+
+    outcomes = await asyncio.gather(
+        *(_probe_one(jpd, port) for _, jpd, _, port in replicas)
+    )
+    for (row, _, _, _), ready in zip(replicas, outcomes):
+        async with get_locker().lock(f"run:{row['run_id']}"):
+            fresh = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (row["id"],))
+            if fresh is None:
+                continue
+            jrd = job_jrd(fresh) or JobRuntimeData()
+            if jrd.probe_ready != ready:
+                jrd.probe_ready = ready
+                await db.execute(
+                    "UPDATE jobs SET job_runtime_data = ? WHERE id = ?",
+                    (jrd.model_dump_json(), fresh["id"]),
+                )
 
 
 async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, int]:
@@ -143,26 +172,32 @@ async def proxy_request(
     run_name: str,
     tail: str,
     body: bytes = None,
+    conf=None,
 ) -> web.StreamResponse:
-    """Forward one HTTP request to a replica; records the request for autoscaling
-    (recorded even when no replica is up, so scale-from-zero sees demand)."""
+    """Forward one HTTP request to a replica; admitted requests are recorded for
+    autoscaling (even when no replica is up, so scale-from-zero sees demand).
+    `conf` is the already-parsed run configuration when the caller has it —
+    the hot path must not re-validate the spec per request."""
     run_row = await db.fetchone(
         "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
         (project_row["id"], run_name),
     )
     if run_row is None:
         raise web.HTTPNotFound(text=f"no service run {run_name}")
-    stats.record(run_row["id"])
 
-    # rate_limits: token buckets per configured prefix (reference nginx limit_req).
-    from dstack_tpu.core.models.runs import RunSpec
+    # rate_limits: token buckets per configured prefix (reference nginx
+    # limit_req). Throttled requests are rejected BEFORE autoscaler accounting —
+    # throttled demand must not drive scale-up it can never reach.
+    if conf is None:
+        from dstack_tpu.core.models.runs import RunSpec
 
-    conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
+        conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
     limits = [
         l.model_dump(mode="json") for l in getattr(conf, "rate_limits", []) or []
     ]
     if limits and not rate_limiter.check(run_row["id"], "/" + tail, limits):
         raise web.HTTPTooManyRequests(text="rate limit exceeded")
+    stats.record(run_row["id"])
 
     replicas = await list_service_replicas(
         db, project_row["id"], run_name, ready_only=True
