@@ -344,26 +344,35 @@ def cmd_logs(args) -> None:
 
 def cmd_metrics(args) -> None:
     client = _client()
-    m = client.metrics.get_job(
-        args.run_name, replica_num=args.replica, job_num=args.job, limit=args.limit
-    )
-    if not m.points:
-        print("no metrics collected yet (the job may have just started)")
-        return
-    rows = []
-    for p in m.points:
-        rows.append(
-            [
-                p.timestamp.strftime("%H:%M:%S"),
-                f"{p.cpu_usage_percent:.1f}%",
-                f"{p.memory_usage_bytes / (1024 ** 2):.0f}MB",
-                f"{p.tpu_duty_cycle_percent:.0f}%" if p.tpu_duty_cycle_percent is not None else "-",
-                f"{p.tpu_hbm_usage_bytes / (1024 ** 3):.1f}GB"
-                if p.tpu_hbm_usage_bytes is not None
-                else "-",
-            ]
+    while True:
+        m = client.metrics.get_job(
+            args.run_name, replica_num=args.replica, job_num=args.job, limit=args.limit
         )
-    print(_table(["TIME", "CPU", "MEM", "TPU DUTY", "HBM"], rows))
+        if not m.points and not args.watch:
+            print("no metrics collected yet (the job may have just started)")
+            return
+        rows = []
+        for p in m.points:
+            rows.append(
+                [
+                    p.timestamp.strftime("%H:%M:%S"),
+                    f"{p.cpu_usage_percent:.1f}%",
+                    f"{p.memory_usage_bytes / (1024 ** 2):.0f}MB",
+                    f"{p.tpu_duty_cycle_percent:.0f}%" if p.tpu_duty_cycle_percent is not None else "-",
+                    f"{p.tpu_hbm_usage_bytes / (1024 ** 3):.1f}GB"
+                    if p.tpu_hbm_usage_bytes is not None
+                    else "-",
+                ]
+            )
+        if args.watch:
+            sys.stdout.write("\033[2J\033[H")  # clear + home, top(1)-style
+        print(_table(["TIME", "CPU", "MEM", "TPU DUTY", "HBM"], rows))
+        if not args.watch:
+            return
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
 
 
 def cmd_offer(args) -> None:
@@ -391,7 +400,7 @@ def cmd_offer(args) -> None:
 
 _SUBCOMMANDS = (
     "server config init apply attach metrics ps stop delete logs offer fleet"
-    " gateway volume secret backend instance project completion"
+    " gateway volume secret backend instance project stats completion"
 )
 
 
@@ -555,12 +564,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.set_defaults(func=cmd_attach)
 
-    s = sub.add_parser("metrics", help="show a run's resource metrics")
-    s.add_argument("run_name")
-    s.add_argument("--replica", type=int, default=0)
-    s.add_argument("--job", type=int, default=0)
-    s.add_argument("--limit", type=int, default=20)
-    s.set_defaults(func=cmd_metrics)
+    for alias in ("metrics", "stats"):
+        s = sub.add_parser(alias, help="show a run's resource metrics")
+        s.add_argument("run_name")
+        s.add_argument("--replica", type=int, default=0)
+        s.add_argument("--job", type=int, default=0)
+        s.add_argument("--limit", type=int, default=20)
+        s.add_argument("-w", "--watch", action="store_true", help="refresh continuously")
+        s.add_argument("--interval", type=float, default=5.0)
+        s.set_defaults(func=cmd_metrics)
 
     s = sub.add_parser("ps", help="list runs")
     s.add_argument("-a", "--all", action="store_true")
