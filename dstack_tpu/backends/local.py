@@ -203,6 +203,18 @@ class LocalCompute(Compute):
                     proc.wait(timeout=5)
 
             await loop.run_in_executor(None, _reap)
+        # Don't leave per-slice workdirs accreting in /tmp across dev runs.
+        if backend_data:
+            try:
+                base_dir = json.loads(backend_data).get("base_dir")
+            except ValueError:
+                base_dir = None
+            if base_dir and base_dir.startswith(tempfile.gettempdir()):
+                import shutil
+
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: shutil.rmtree(base_dir, ignore_errors=True)
+                )
 
     # -- volumes: a "disk" is a host directory (dev parity for the data-disk path) ----
 
