@@ -16,6 +16,7 @@ import re
 import signal
 import subprocess
 import tempfile
+import threading
 from typing import List, Optional
 
 from dstack_tpu.backends.base import Compute
@@ -272,6 +273,14 @@ class LocalCompute(Compute):
         except (asyncio.TimeoutError, ComputeError):
             proc.kill()
             raise ComputeError("gateway appliance failed to start")
+        # Keep draining the pipe for the gateway's lifetime: aiohttp access/INFO
+        # logging would otherwise fill the 64KiB pipe buffer and block the
+        # appliance the first time it takes sustained traffic.
+        def _drain(stream=proc.stdout):
+            for _ in iter(stream.readline, b""):
+                pass
+
+        threading.Thread(target=_drain, name=f"gw-drain-{proc.pid}", daemon=True).start()
         self._procs[f"local-gw-{proc.pid}"] = proc
         return GatewayProvisioningData(
             instance_id=f"local-gw-{proc.pid}",

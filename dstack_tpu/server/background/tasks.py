@@ -234,13 +234,17 @@ async def _slice_has_volumes(db: Database, workers: List, volumes: List) -> bool
     return True
 
 
-def _volume_attachment_data(volume) -> dict:
-    """How the host exposes the disk (device path / host dir), per backend."""
+def _volume_attachment_data(volume, index: int = 0) -> dict:
+    """How the host exposes the disk (device path / host dir), per backend.
+
+    ``index`` is the volume's 0-based position in the dataDisks list passed at
+    slice create. The TPU API cannot assign device names to data disks, so they
+    surface as ``google-persistent-disk-<n>`` with the boot disk at n=0 and data
+    disks following in list order (reference gcp/compute.py:710)."""
     pd = volume.provisioning_data
     backend = pd.backend if pd else None
     if backend == "gcp":
-        # GCE guarantees stable by-id naming for attached persistent disks.
-        return {"device_name": f"/dev/disk/by-id/google-{pd.volume_id}"}
+        return {"device_name": f"/dev/disk/by-id/google-persistent-disk-{index + 1}"}
     if backend == "local":
         data = json.loads(pd.backend_data) if pd.backend_data else {}
         return {"host_dir": data.get("host_dir")}
@@ -302,8 +306,8 @@ async def _provision_slice(
                 _assign_job_tx(conn, j_row, iid, json.loads(jpd.model_dump_json()))
             # Volumes attached at create time: record one attachment per
             # (volume, worker) — a TPU data disk reaches every host of the slice.
-            for vol in volumes or []:
-                data = json.dumps(_volume_attachment_data(vol))
+            for vol_index, vol in enumerate(volumes or []):
+                data = json.dumps(_volume_attachment_data(vol, vol_index))
                 for iid in ids:
                     conn.execute(
                         "INSERT OR REPLACE INTO volume_attachments"

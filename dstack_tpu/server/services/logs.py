@@ -128,7 +128,9 @@ class GcpLogStorage(LogStorage):
                         "project_id": project_id,
                         "run_name": run_name,
                         "job_id": job_id,
-                        "line": str(next_line + i),
+                        # Zero-padded so the poller can range-filter server-side:
+                        # label comparisons are lexicographic strings.
+                        "line": f"{next_line + i:012d}",
                     },
                     "jsonPayload": {"message": ev.message, "source": ev.log_source.value},
                 }
@@ -152,32 +154,43 @@ class GcpLogStorage(LogStorage):
             f'logName="projects/{self.gcp_project}/logs/{self.LOG_ID}"'
             f' AND labels.project_id="{project_id}"'
             f' AND labels.run_name="{run_name}" AND labels.job_id="{job_id}"'
+            # Lexicographic >= on the zero-padded line label skips already-read
+            # entries server-side, keeping a tail-poll O(new lines) instead of
+            # re-paging the whole stream every call.
+            f' AND labels.line>="{start_line:012d}"'
         )
-        status, body = self._request(
-            "POST",
-            f"{self.API}/entries:list",
-            {
+        out: List[LogEvent] = []
+        page_token: Optional[str] = None
+        # Follow nextPageToken until the window is filled or the sink is
+        # exhausted (a single page caps at 1000, which would permanently stall
+        # polling for jobs past 1000 lines).
+        while len(out) < limit:
+            req = {
                 "resourceNames": [f"projects/{self.gcp_project}"],
                 "filter": flt,
                 "orderBy": "timestamp asc",
-                "pageSize": min(start_line + limit, 1000),
-            },
-        )
-        if status >= 400:
-            raise RuntimeError(f"Cloud Logging list failed: HTTP {status}: {body}")
-        out: List[LogEvent] = []
-        for entry in body.get("entries", []):
-            line = int(entry.get("labels", {}).get("line", 0))
-            if line < start_line or len(out) >= limit:
-                continue
-            payload = entry.get("jsonPayload", {})
-            out.append(
-                LogEvent(
-                    timestamp=entry.get("timestamp"),
-                    message=payload.get("message", ""),
-                    log_source=payload.get("source") or "stdout",
+                "pageSize": 1000,
+            }
+            if page_token:
+                req["pageToken"] = page_token
+            status, body = self._request("POST", f"{self.API}/entries:list", req)
+            if status >= 400:
+                raise RuntimeError(f"Cloud Logging list failed: HTTP {status}: {body}")
+            for entry in body.get("entries", []):
+                line = int(entry.get("labels", {}).get("line", 0))
+                if line < start_line or len(out) >= limit:
+                    continue
+                payload = entry.get("jsonPayload", {})
+                out.append(
+                    LogEvent(
+                        timestamp=entry.get("timestamp"),
+                        message=payload.get("message", ""),
+                        log_source=payload.get("source") or "stdout",
+                    )
                 )
-            )
+            page_token = body.get("nextPageToken")
+            if not page_token:
+                break
         return out
 
 

@@ -36,7 +36,10 @@ std::string url_escape(const std::string& s) {
   return out;
 }
 
-static const char kB64[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+// URL-safe alphabet: the engine decodes X-Registry-Auth as base64url (the Go
+// daemon uses base64.URLEncoding), so +/ would corrupt credentials whose JSON
+// happens to encode to those positions.
+static const char kB64[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
 
 static std::string b64encode(const std::string& in) {
   std::string out;

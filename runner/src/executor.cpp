@@ -19,6 +19,7 @@
 #include <ctime>
 #include <fstream>
 #include <sstream>
+#include <stdexcept>
 #include <vector>
 
 namespace drunner {
@@ -32,6 +33,45 @@ static std::string iso_now() {
   size_t n = strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%S", &tmv);
   snprintf(buf + n, sizeof(buf) - n, ".%06ld+00:00", ts.tv_nsec / 1000);
   return buf;
+}
+
+// Run a command via fork/execvp with an argv — no shell, so spec-derived strings
+// (clone URLs, volume names, device paths) can never be interpreted as shell
+// syntax. Combined stdout+stderr is captured into *output when non-null.
+// Returns the exit code, or -1 on fork/exec/signal failure.
+int run_argv(const std::vector<std::string>& argv, std::string* output) {
+  if (argv.empty()) return -1;
+  int fds[2];
+  if (pipe(fds) != 0) return -1;
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(fds[0]);
+    close(fds[1]);
+    return -1;
+  }
+  if (pid == 0) {
+    close(fds[0]);
+    dup2(fds[1], 1);
+    dup2(fds[1], 2);
+    if (fds[1] > 2) close(fds[1]);
+    std::vector<char*> cargv;
+    cargv.reserve(argv.size() + 1);
+    for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+    cargv.push_back(nullptr);
+    execvp(cargv[0], cargv.data());
+    _exit(127);
+  }
+  close(fds[1]);
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fds[0], buf, sizeof(buf))) > 0) {
+    if (output) output->append(buf, static_cast<size_t>(n));
+  }
+  close(fds[0]);
+  int status = 0;
+  if (waitpid(pid, &status, 0) < 0) return -1;
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  return -1;
 }
 
 Executor::Executor(std::string base_dir, std::string docker_mode, std::string docker_socket)
@@ -264,28 +304,39 @@ std::string Executor::extract_code() {
       !repo_data_["clone_url"].as_string().empty()) {
     const std::string& url = repo_data_["clone_url"].as_string();
     const std::string& commit = repo_data_["commit"].as_string();
-    std::string cmd = "rm -rf '" + repo_dir + "' && git clone -q '" + url + "' '" +
-                      repo_dir + "' 2>&1";
-    if (!commit.empty()) {
-      cmd += " && git -C '" + repo_dir + "' checkout -q '" + commit + "' 2>&1";
+    std::string out;
+    run_argv({"rm", "-rf", "--", repo_dir}, nullptr);
+    // `--` stops git/rm from parsing a hostile URL or path as an option. A
+    // revision sits BEFORE the `--`, so it cannot be protected that way — reject
+    // option-shaped commits outright instead of letting git parse them.
+    int rc;
+    if (!commit.empty() && commit[0] == '-') {
+      out = "invalid commit " + commit;
+      rc = 1;
+    } else {
+      rc = run_argv({"git", "clone", "-q", "--", url, repo_dir}, &out);
+      if (rc == 0 && !commit.empty()) {
+        rc = run_argv({"git", "-C", repo_dir, "checkout", "-q", commit, "--"}, &out);
+      }
     }
-    if (system(cmd.c_str()) == 0) {
+    if (rc == 0) {
       add_log("checked out " + url + (commit.empty() ? "" : " @ " + commit.substr(0, 12)) + "\n");
       if (!code_path_.empty()) {
-        std::string apply = "git -C '" + repo_dir + "' apply --whitespace=nowarn '" +
-                            code_path_ + "' 2>&1";
-        if (system(apply.c_str()) != 0) {
-          add_log("warning: applying the working-tree diff failed\n");
+        std::string apply_out;
+        if (run_argv({"git", "-C", repo_dir, "apply", "--whitespace=nowarn", "--",
+                      code_path_},
+                     &apply_out) != 0) {
+          add_log("warning: applying the working-tree diff failed: " + apply_out + "\n");
         }
       }
       return repo_dir;
     }
-    add_log("warning: git clone/checkout failed; falling back to the code archive\n");
+    add_log("warning: git clone/checkout failed (" + out +
+            "); falling back to the code archive\n");
   }
   mkdir(repo_dir.c_str(), 0755);
   if (!code_path_.empty()) {
-    std::string cmd = "tar -xzf '" + code_path_ + "' -C '" + repo_dir + "' 2>/dev/null";
-    if (system(cmd.c_str()) != 0) {
+    if (run_argv({"tar", "-xzf", code_path_, "-C", repo_dir}, nullptr) != 0) {
       add_log("warning: failed to extract code archive\n");
     }
   }
@@ -331,22 +382,54 @@ static std::vector<std::string> cluster_env(const dj::Json& ci) {
   return env;
 }
 
-// Shell snippet readying one volume on the host: format-if-empty + mount for
-// block devices (reference shim/docker.go:542 formatAndMountVolume), symlink for
-// host-dir volumes (local backend).
-static std::string volume_prep_cmds(const dj::Json& v, const std::string& mount_path) {
+// Ready one volume on the host: format-if-empty + mount for block devices
+// (reference shim/docker.go:542 formatAndMountVolume), symlink for host-dir
+// volumes (local backend). Shell-free — every step is a fork/exec argv, so
+// spec-derived names and device paths are never shell-parsed. Returns false
+// with *err set when the volume cannot be readied; callers MUST fail the job
+// (a missed mount would silently land the job's writes on the boot disk).
+static bool prepare_volume(const dj::Json& v, const std::string& mount_path, std::string* err) {
   const std::string& dev = v["device"].as_string();
   const std::string& host_dir = v["host_dir"].as_string();
-  std::string s;
   if (!dev.empty()) {
-    s += "if ! blkid '" + dev + "' >/dev/null 2>&1; then mkfs.ext4 -q '" + dev + "'; fi\n";
-    s += "mkdir -p '" + mount_path + "'\n";
-    s += "mountpoint -q '" + mount_path + "' || mount '" + dev + "' '" + mount_path + "'\n";
-  } else if (!host_dir.empty()) {
-    s += "mkdir -p \"$(dirname '" + mount_path + "')\" 2>/dev/null || true\n";
-    s += "[ -e '" + mount_path + "' ] || ln -sfn '" + host_dir + "' '" + mount_path + "'\n";
+    if (run_argv({"blkid", "--", dev}, nullptr) != 0) {
+      std::string out;
+      if (run_argv({"mkfs.ext4", "-q", "--", dev}, &out) != 0) {
+        *err = "mkfs.ext4 " + dev + " failed: " + out;
+        return false;
+      }
+    }
+    run_argv({"mkdir", "-p", "--", mount_path}, nullptr);
+    if (run_argv({"mountpoint", "-q", "--", mount_path}, nullptr) != 0) {
+      std::string out;
+      if (run_argv({"mount", "--", dev, mount_path}, &out) != 0) {
+        *err = "mount " + dev + " on " + mount_path + " failed: " + out;
+        return false;
+      }
+    }
+    return true;
   }
-  return s;
+  if (!host_dir.empty()) {
+    std::string parent = mount_path;
+    size_t slash = parent.find_last_of('/');
+    if (slash != std::string::npos && slash > 0) {
+      run_argv({"mkdir", "-p", "--", parent.substr(0, slash)}, nullptr);
+    }
+    // stat() follows symlinks: a dangling link from a recreated volume must be
+    // re-pointed, not treated as already-prepared.
+    struct stat st, lst;
+    if (stat(mount_path.c_str(), &st) != 0) {
+      if (lstat(mount_path.c_str(), &lst) == 0 && S_ISLNK(lst.st_mode)) {
+        unlink(mount_path.c_str());
+      }
+      if (symlink(host_dir.c_str(), mount_path.c_str()) != 0) {
+        *err = "symlink " + host_dir + " -> " + mount_path + ": " + strerror(errno);
+        return false;
+      }
+    }
+    return true;
+  }
+  return true;
 }
 
 std::string Executor::build_script() const {
@@ -493,10 +576,10 @@ void Executor::exec_container(uint64_t generation) {
           binds.push_back(host_dir + ":" + vpath);
         } else if (!v["device"].as_string().empty()) {
           std::string mnt = base_dir_ + "/mnt-" + v["name"].as_string();
-          std::string prep = volume_prep_cmds(v, mnt);
-          std::string cmd = "sh -c '" + prep + "'";
-          if (system(cmd.c_str()) != 0) {
-            add_log("warning: preparing volume " + v["name"].as_string() + " failed\n");
+          std::string err;
+          if (!prepare_volume(v, mnt, &err)) {
+            throw std::runtime_error("preparing volume " + v["name"].as_string() +
+                                     " failed: " + err);
           }
           binds.push_back(mnt + ":" + vpath);
         }
@@ -615,13 +698,18 @@ void Executor::exec_host(uint64_t generation) {
   add_state("running");
   std::string repo_dir = extract_code();
 
-  // Ready volume mounts before the user's commands (host path: mounts happen in
-  // the job shell itself, which runs as the host user).
-  std::string prep;
+  // Ready volume mounts before the user's commands run; a volume that cannot be
+  // mounted fails the job (writes to an unmounted path would land on the
+  // ephemeral boot disk and vanish with the slice).
   for (const auto& v : job_spec_["volumes"].as_array()) {
-    if (!v["path"].as_string().empty()) prep += volume_prep_cmds(v, v["path"].as_string());
+    if (v["path"].as_string().empty()) continue;
+    std::string err;
+    if (!prepare_volume(v, v["path"].as_string(), &err)) {
+      add_state("failed", -1, "preparing volume " + v["name"].as_string() + " failed: " + err);
+      return;
+    }
   }
-  std::string script = prep + build_script();
+  std::string script = build_script();
 
   std::string workdir = repo_dir;
   if (!job_spec_["working_dir"].is_null() && !job_spec_["working_dir"].as_string().empty()) {

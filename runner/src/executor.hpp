@@ -23,6 +23,10 @@
 
 namespace drunner {
 
+// fork/execvp with an argv (no shell). Captures combined stdout+stderr into
+// *output when non-null; returns the exit code or -1 on fork/exec failure.
+int run_argv(const std::vector<std::string>& argv, std::string* output);
+
 struct Event {
   int64_t seq;
   bool is_state;  // state transition vs log line

@@ -279,6 +279,10 @@ void test_docker_helpers() {
   std::string auth = ddocker::encode_registry_auth("u", "p");
   CHECK_EQ(auth, std::string("eyJwYXNzd29yZCI6InAiLCJ1c2VybmFtZSI6InUifQ=="));
   CHECK_EQ(ddocker::encode_registry_auth("", ""), std::string(""));
+  // The engine decodes X-Registry-Auth as base64url: a credential whose JSON
+  // hits the 62nd code point must encode with '-' (url alphabet), never '+'.
+  CHECK_EQ(ddocker::encode_registry_auth("u", "p>?~"),
+           std::string("eyJwYXNzd29yZCI6InA-P34iLCJ1c2VybmFtZSI6InUifQ=="));
 }
 
 void test_tpu_metrics_parse() {

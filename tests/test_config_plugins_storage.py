@@ -197,10 +197,15 @@ class TestStorage:
 
 
 class FakeLoggingRequest:
-    """Scripted Cloud Logging API: stores entries, answers list with a filter."""
+    """Scripted Cloud Logging API: stores entries, answers list with a filter.
 
-    def __init__(self):
+    ``page_size`` caps each list response and hands out nextPageToken like the
+    real API, so pagination bugs (stopping after one page) surface in tests."""
+
+    def __init__(self, page_size=None):
         self.entries = []
+        self.page_size = page_size
+        self.list_calls = 0
 
     def __call__(self, method, url, payload):
         if url.endswith("entries:write"):
@@ -209,14 +214,23 @@ class FakeLoggingRequest:
         if url.endswith("entries:list"):
             import re
 
+            self.list_calls += 1
             flt = payload["filter"]
             want = dict(re.findall(r'labels\.(\w+)="([^"]+)"', flt))
+            ranges = dict(re.findall(r'labels\.(\w+)>="([^"]+)"', flt))
             matched = [
                 e
                 for e in self.entries
                 if all(e["labels"].get(k) == v for k, v in want.items())
+                and all(e["labels"].get(k, "") >= v for k, v in ranges.items())
             ]
-            return 200, {"entries": matched}
+            start = int(payload.get("pageToken") or 0)
+            size = self.page_size or len(matched) or 1
+            page = matched[start : start + size]
+            resp = {"entries": page}
+            if start + size < len(matched):
+                resp["nextPageToken"] = str(start + size)
+            return 200, resp
         return 404, {}
 
 
@@ -245,4 +259,27 @@ class TestGcpLogStorage:
         assert len(got) == 1
         # The write carried the log name + labels contract.
         assert req.entries[0]["logName"] == "projects/my-gcp-proj/logs/dstack-tpu-run-logs"
-        assert req.entries[0]["labels"]["line"] == "0"
+        assert req.entries[0]["labels"]["line"] == "000000000000"
+
+    def test_poll_follows_pagination(self):
+        """Lines past the first page must still be reachable: the poller follows
+        nextPageToken instead of stopping at pageSize (a long job's lines >= 1000
+        would otherwise never be returned)."""
+        from dstack_tpu.core.models.logs import LogEvent
+        from dstack_tpu.server.services.logs import GcpLogStorage
+
+        req = FakeLoggingRequest(page_size=2)
+        store = GcpLogStorage("my-gcp-proj", request=req)
+        evs = [
+            LogEvent(timestamp="2026-01-01T00:00:00+00:00", message=f"line-{i}\n")
+            for i in range(7)
+        ]
+        store.write_logs("p1", "run1", "j1", evs)
+        got = store.poll_logs("p1", "run1", "j1", start_line=5)
+        assert [e.message for e in got] == ["line-5\n", "line-6\n"]
+        # The tail poll filtered server-side: one page, not a re-read of the stream.
+        assert req.list_calls == 1
+        # A full read spans every page by following nextPageToken.
+        got = store.poll_logs("p1", "run1", "j1")
+        assert len(got) == 7
+        assert req.list_calls - 1 > 1

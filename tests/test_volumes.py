@@ -104,6 +104,36 @@ class TestVolumeLifecycle:
             assert "gcp" in vol["status_message"]
 
 
+class TestAttachmentData:
+    def test_gcp_device_name_is_positional(self):
+        """The TPU API cannot name data disks: they surface as
+        google-persistent-disk-<n> with the boot disk at n=0, so the recorded
+        device must come from the disk's position in the dataDisks list — NOT
+        from the volume id (which would point at a nonexistent device and let
+        job writes silently land on the boot disk)."""
+        from dstack_tpu.core.models.volumes import Volume, VolumeProvisioningData, VolumeStatus
+        from dstack_tpu.server.background.tasks import _volume_attachment_data
+
+        def gcp_vol(name, vid):
+            import datetime
+            import uuid
+
+            return Volume(
+                id=uuid.uuid4(),
+                name=name,
+                project_name="main",
+                configuration={"name": name, "backend": "gcp", "region": "us", "size": 10},
+                created_at=datetime.datetime(2026, 1, 1),
+                status=VolumeStatus.ACTIVE,
+                provisioning_data=VolumeProvisioningData(backend="gcp", volume_id=vid),
+            )
+
+        first = _volume_attachment_data(gcp_vol("a", "disk-aaaa"), 0)
+        second = _volume_attachment_data(gcp_vol("b", "disk-bbbb"), 1)
+        assert first["device_name"] == "/dev/disk/by-id/google-persistent-disk-1"
+        assert second["device_name"] == "/dev/disk/by-id/google-persistent-disk-2"
+
+
 class TestVolumeScheduling:
     async def test_slice_run_mounts_volume_on_all_hosts(self, monkeypatch):
         monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
