@@ -137,6 +137,18 @@ def main() -> None:
         on_tpu = False
     result = bench_tpu_train() if on_tpu else bench_scheduler()
     print(json.dumps(result))
+    # Regression guard: the north-star floor is 50% MFU (vs_baseline >= 1.0);
+    # a workload/geometry change that slides below it must FAIL the bench, not
+    # silently record a lower number. The scheduler bench is exempt — its
+    # vs_baseline tracks host speed, not a code-regression floor.
+    if result["metric"] == "llama_train_step_mfu_1chip" and result["vs_baseline"] < 1.0:
+        print(
+            f"FAIL: {result['metric']} = {result['value']} {result['unit']} "
+            f"is below the baseline floor (vs_baseline "
+            f"{result['vs_baseline']} < 1.0)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -70,6 +70,57 @@ def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
 
 
+def _embed_lookup(
+    embed: jax.Array, tokens: jax.Array, mesh: Optional[Mesh], adt
+) -> jax.Array:
+    """Token embedding lookup, partition-aware.
+
+    Single-device: a plain gather. Under a mesh the table is vocab-sharded over
+    ``tp`` (sharding.PARAM_SPECS) and SPMD cannot partition a gather whose
+    operand is sharded on the indexed dim — it falls back to "involuntary full
+    rematerialization": an all-gather of the entire table in the hot path every
+    step. Do the partitioned lookup explicitly instead: all-gather the table's
+    D axis (the standard FSDP gather-on-use, same as every other weight), then
+    each tp shard masks-and-gathers its local vocab rows and the partial
+    results psum over tp — one [b,t,D] psum on ICI instead of a [V,D] table
+    all-gather."""
+    if mesh is None:
+        return embed.astype(adt)[tokens]
+    from jax.experimental.shard_map import shard_map
+
+    v = embed.shape[0]
+    tp = mesh.shape.get("tp", 1)
+    if tp == 1 or v % tp != 0:
+        # No vocab partition (or an indivisible one): replicate the table
+        # explicitly so SPMD never has to guess.
+        emb = jax.lax.with_sharding_constraint(
+            embed.astype(adt), NamedSharding(mesh, P(None, None))
+        )
+        x = emb[tokens]
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(("dp", "fsdp"), "sp", None))
+        )
+    v_loc = v // tp
+    emb = jax.lax.with_sharding_constraint(
+        embed.astype(adt), NamedSharding(mesh, P("tp", None))
+    )
+
+    def local_lookup(emb_block, tok_block):
+        lo = jax.lax.axis_index("tp") * v_loc
+        local = tok_block - lo
+        ok = (local >= 0) & (local < v_loc)
+        rows = emb_block[jnp.clip(local, 0, v_loc - 1)]
+        rows = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
+        return jax.lax.psum(rows, "tp")
+
+    return shard_map(
+        local_lookup,
+        mesh=mesh,
+        in_specs=(P("tp", None), P(("dp", "fsdp"), "sp")),
+        out_specs=P(("dp", "fsdp"), "sp", None),
+    )(emb, tokens)
+
+
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding; x [B,T,H,D], positions [T] (global, so sequence-parallel
     chunks rotate correctly)."""
@@ -104,7 +155,7 @@ def forward(
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
-    x = params["embed"].astype(adt)[tokens]  # [B,T,D]
+    x = _embed_lookup(params["embed"], tokens, mesh, adt)  # [B,T,D]
     x = act_constraint(x, P(("dp", "fsdp"), "sp", None))
     positions = jnp.arange(t)
 
