@@ -80,6 +80,14 @@ async def _on_startup(app: web.Application) -> None:
         await config_service.apply_config(db, admin_row, server_config)
     except Exception:
         logger.exception("applying server config failed; continuing with DB state")
+    # Re-prime the service autoscaler's RPS window from its persisted buckets
+    # so a restart doesn't zero a busy service's scaling knowledge.
+    try:
+        from dstack_tpu.server.services import proxy as proxy_service
+
+        await proxy_service.prime_stats(db)
+    except Exception:
+        logger.exception("priming service stats failed; starting with an empty window")
     if app["run_background_tasks"]:
         from dstack_tpu.server.background import start_background_tasks
 

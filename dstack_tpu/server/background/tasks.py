@@ -1411,6 +1411,10 @@ async def process_services(db: Database, batch: Optional[int] = None) -> None:
     from dstack_tpu.server.services import proxy as proxy_service
     from dstack_tpu.server.services.runs import classify_replicas, scale_run_replicas
 
+    # Checkpoint the RPS window so a restart re-primes the autoscaler instead
+    # of scaling on zero knowledge right after a deploy.
+    await proxy_service.persist_stats(db)
+
     rows = await db.fetchall(
         "SELECT * FROM runs WHERE deleted = 0 AND status IN"
         " ('submitted', 'provisioning', 'running')"

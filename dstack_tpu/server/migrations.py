@@ -212,6 +212,17 @@ MIGRATIONS: List[Tuple[int, str]] = [
         );
         """,
     ),
+    (
+        2,
+        """
+        CREATE TABLE service_stats (
+            run_id TEXT NOT NULL,
+            bucket INTEGER NOT NULL,
+            count INTEGER NOT NULL,
+            PRIMARY KEY (run_id, bucket)
+        );
+        """,
+    ),
 ]
 
 

@@ -29,12 +29,27 @@ logger = logging.getLogger(__name__)
 STATS_WINDOW = 600.0  # seconds of request history kept per service
 
 
+STATS_BUCKET = 10.0  # persistence granularity (seconds)
+
+
+def _wall_offset() -> float:
+    """monotonic + offset = wall clock; lets buckets survive process restarts."""
+    return time.time() - time.monotonic()
+
+
 class ServiceStats:
-    """Per-run request timestamps; in-memory (the reference keeps gateway stats
-    in-process too — a restart just resets the autoscaler's window)."""
+    """Per-run request timestamps. The hot path (record) is in-memory; the
+    window is periodically persisted as coarse wall-clock buckets
+    (``flush_rows``) and re-primed from them at startup (``prime``) so a server
+    restart does not zero the autoscaler's knowledge — the reference gets the
+    same durability by tailing nginx access logs
+    (proxy/gateway/services/stats.py:41-148)."""
 
     def __init__(self) -> None:
         self._requests: Dict[str, Deque[float]] = {}
+        # (run_id, bucket) -> count at last persist; lets each checkpoint write
+        # only buckets that changed instead of re-upserting the whole window.
+        self.persisted: Dict[Tuple[str, int], int] = {}
 
     def record(self, run_id: str, ts: Optional[float] = None) -> None:
         dq = self._requests.setdefault(run_id, collections.deque())
@@ -50,6 +65,40 @@ class ServiceStats:
         n = sum(1 for t in dq if t >= cutoff)
         return n / window
 
+    def flush_rows(self) -> List[Tuple[str, int, int]]:
+        """(run_id, bucket_epoch, count) rows for the retained window, bucketed
+        on the wall clock so another process can reconstruct them."""
+        off = _wall_offset()
+        out: List[Tuple[str, int, int]] = []
+        for run_id, dq in self._requests.items():
+            self._trim(dq)
+            counts: Dict[int, int] = {}
+            for t in dq:
+                b = int((t + off) // STATS_BUCKET) * int(STATS_BUCKET)
+                counts[b] = counts.get(b, 0) + 1
+            out.extend((run_id, b, c) for b, c in sorted(counts.items()))
+        return out
+
+    def prime(self, rows) -> None:
+        """Rebuild the window from persisted buckets (server restart). Buckets
+        older than the window are dropped; each deque is re-sorted so trimming
+        stays correct against requests recorded before the prime."""
+        off = _wall_offset()
+        cutoff = time.monotonic() - STATS_WINDOW
+        touched = set()
+        for run_id, bucket, count in rows:
+            # Mid-bucket placement: a boundary-exact timestamp would re-bucket
+            # one slot earlier under ms-scale wall/monotonic jitter, and the
+            # shifted row would duplicate the original on the next flush.
+            ts = float(bucket) + STATS_BUCKET / 2 - off  # wall -> our monotonic
+            if ts < cutoff:
+                continue
+            dq = self._requests.setdefault(run_id, collections.deque())
+            dq.extend([ts] * min(int(count), 100_000))
+            touched.add(run_id)
+        for run_id in touched:
+            self._requests[run_id] = collections.deque(sorted(self._requests[run_id]))
+
     def _trim(self, dq: Deque[float]) -> None:
         cutoff = time.monotonic() - STATS_WINDOW
         while dq and dq[0] < cutoff:
@@ -57,9 +106,42 @@ class ServiceStats:
 
     def reset(self) -> None:
         self._requests.clear()
+        self.persisted.clear()
 
 
 stats = ServiceStats()
+
+
+async def persist_stats(db: Database) -> None:
+    """Write the window's changed buckets; expired buckets are swept."""
+    rows = stats.flush_rows()
+    cutoff = int(time.time() - STATS_WINDOW)
+    changed = [(r, b, c) for r, b, c in rows if stats.persisted.get((r, b)) != c]
+    if not changed:
+        return
+
+    def _tx(conn) -> None:
+        conn.execute("DELETE FROM service_stats WHERE bucket < ?", (cutoff,))
+        conn.executemany(
+            "INSERT OR REPLACE INTO service_stats (run_id, bucket, count)"
+            " VALUES (?, ?, ?)",
+            changed,
+        )
+
+    await db.run(_tx)
+    for r, b, c in changed:
+        stats.persisted[(r, b)] = c
+    for key in [k for k in stats.persisted if k[1] < cutoff]:
+        del stats.persisted[key]
+
+
+async def prime_stats(db: Database) -> None:
+    """Load the persisted window into the in-process stats (server startup)."""
+    rows = await db.fetchall(
+        "SELECT run_id, bucket, count FROM service_stats WHERE bucket >= ?",
+        (int(time.time() - STATS_WINDOW),),
+    )
+    stats.prime([(r["run_id"], r["bucket"], r["count"]) for r in rows])
 
 from dstack_tpu.core.services.rate_limit import RateLimiter
 

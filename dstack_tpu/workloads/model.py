@@ -90,15 +90,22 @@ def _embed_lookup(
 
     v = embed.shape[0]
     tp = mesh.shape.get("tp", 1)
-    if tp == 1 or v % tp != 0:
-        # No vocab partition (or an indivisible one): replicate the table
-        # explicitly so SPMD never has to guess.
+    if tp == 1:
+        # Vocab unsharded: a plain gather partitions fine (only the [B,T,D]
+        # result moves), so constrain just the output.
+        return jax.lax.with_sharding_constraint(
+            embed.astype(adt)[tokens],
+            NamedSharding(mesh, P(("dp", "fsdp"), "sp", None)),
+        )
+    if v % tp != 0:
+        # tp-sharded but indivisible vocab: SPMD would replicate the table as a
+        # last resort anyway — do it explicitly so the cost is visible and the
+        # compiler never warns.
         emb = jax.lax.with_sharding_constraint(
             embed.astype(adt), NamedSharding(mesh, P(None, None))
         )
-        x = emb[tokens]
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(("dp", "fsdp"), "sp", None))
+            emb[tokens], NamedSharding(mesh, P(("dp", "fsdp"), "sp", None))
         )
     v_loc = v // tp
     emb = jax.lax.with_sharding_constraint(

@@ -45,8 +45,10 @@ class ApiClient:
 
 
 @contextlib.asynccontextmanager
-async def api_server(run_background_tasks: bool = False) -> AsyncIterator[ApiClient]:
-    app = create_app(db_path=":memory:", run_background_tasks=run_background_tasks)
+async def api_server(
+    run_background_tasks: bool = False, db_path: str = ":memory:"
+) -> AsyncIterator[ApiClient]:
+    app = create_app(db_path=db_path, run_background_tasks=run_background_tasks)
     server = TestServer(app)
     client = TestClient(server)
     await client.start_server()

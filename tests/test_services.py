@@ -207,6 +207,53 @@ class TestAutoscaler:
             logs_service.set_log_storage(None)
 
 
+class TestStatsPersistence:
+    async def test_rps_window_survives_server_restart(self, tmp_path):
+        """The autoscaler's request window is checkpointed to the DB and
+        re-primed at startup: after a restart, a busy service still reads a
+        warm RPS instead of scaling on zero knowledge."""
+        db_file = str(tmp_path / "server.db")
+        proxy_service.stats.reset()
+        try:
+            async with api_server(db_path=db_file) as api:
+                for _ in range(120):
+                    proxy_service.stats.record("run-abc")
+                assert proxy_service.stats.rps("run-abc") == pytest.approx(2.0)
+                # process_services checkpoints the window every pass.
+                await tasks.process_services(api.db)
+                rows = await api.db.fetchall("SELECT * FROM service_stats")
+                assert sum(r["count"] for r in rows) == 120
+
+            # "Restart": fresh process state, same DB file.
+            proxy_service.stats.reset()
+            assert proxy_service.stats.rps("run-abc") == 0.0
+            async with api_server(db_path=db_file) as api:
+                warm = proxy_service.stats.rps("run-abc")
+                assert warm == pytest.approx(2.0, rel=0.2)
+        finally:
+            proxy_service.stats.reset()
+
+    def test_flush_prime_roundtrip_drops_expired_buckets(self):
+        import time as time_mod
+
+        s = proxy_service.ServiceStats()
+        now = time_mod.monotonic()
+        s.record("r1", now - 300.0)
+        s.record("r1", now - 1.0)
+        s.record("r1", now - 1.0)
+        rows = s.flush_rows()
+        assert sum(c for _, _, c in rows) == 3
+        # An expired bucket (older than the window) never comes back.
+        old_bucket = int(time_mod.time() - proxy_service.STATS_WINDOW - 60)
+        rows.append(("r1", old_bucket, 50))
+        s2 = proxy_service.ServiceStats()
+        s2.prime(rows)
+        assert s2.rps("r1", window=60.0) == pytest.approx(2 / 60.0)
+        assert s2.rps("r1", window=proxy_service.STATS_WINDOW) == pytest.approx(
+            3 / proxy_service.STATS_WINDOW
+        )
+
+
 class TestReadinessProbes:
     async def test_unready_replica_excluded_until_socket_answers(self, tmp_path):
         """A replica whose app socket is not yet up fails the probe and is dropped
