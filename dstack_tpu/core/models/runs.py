@@ -358,6 +358,9 @@ class RunPlan(CoreModel):
     max_offer_price: Optional[float] = None
     current_resource: Optional[Run] = None
     action: str = "create"
+    # Plan-time registry introspection result (user/entrypoint/platform, or
+    # verified=False when the registry was unreachable from the server).
+    image_config: Optional[dict] = None
 
 
 class ApplyRunPlanInput(CoreModel):

@@ -1,0 +1,246 @@
+"""Plan-time container-image introspection against OCI/Docker registries.
+
+Parity: reference server/services/docker.py:34-70 — resolve the image's
+manifest + config (user, entrypoint, platform) with registry auth at plan time,
+so a bad `image:`/credential fails in the PLAN instead of after a slice is
+provisioned and the pull dies.
+
+SDK-free like the rest of the repo's cloud IO: the Docker Registry HTTP API v2
+token dance (WWW-Authenticate -> token endpoint -> Bearer retry) is a small,
+stable protocol. Failure policy for air-gapped control planes: a DEFINITIVE
+registry answer (404 manifest, 401/403 after the token dance) fails the plan;
+a network failure (DNS, refused, timeout) degrades to "unverified" — the
+registry may simply be unreachable from the server while reachable from hosts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional, Tuple
+
+from pydantic import Field
+
+from dstack_tpu.core.errors import ServerClientError
+from dstack_tpu.core.models.common import CoreModel
+
+DEFAULT_REGISTRY = "registry-1.docker.io"
+MANIFEST_ACCEPT = ", ".join(
+    [
+        "application/vnd.oci.image.index.v1+json",
+        "application/vnd.docker.distribution.manifest.list.v2+json",
+        "application/vnd.oci.image.manifest.v1+json",
+        "application/vnd.docker.distribution.manifest.v2+json",
+    ]
+)
+
+
+class ImageConfig(CoreModel):
+    """The subset of the OCI image config the scheduler cares about."""
+
+    image: str
+    user: Optional[str] = None
+    entrypoint: Optional[list] = None
+    cmd: Optional[list] = None
+    os: Optional[str] = None
+    architecture: Optional[str] = None
+    verified: bool = True  # False = registry unreachable, config unknown
+    note: Optional[str] = None
+
+
+def parse_image_ref(image: str) -> Tuple[str, str, str]:
+    """image -> (registry_host, repository, reference). Docker-style defaults:
+    bare names go to Docker Hub under library/."""
+    if not image or not re.match(r"^[\w.\-/:@]+$", image):
+        raise ServerClientError(f"invalid image reference: {image!r}")
+    digest = None
+    if "@" in image:
+        image, digest = image.split("@", 1)
+    host, _, rest = image.partition("/")
+    # A host segment has a dot, a colon (port), or is "localhost"; otherwise the
+    # whole string is a Docker Hub repository.
+    if rest and ("." in host or ":" in host or host == "localhost"):
+        registry = host
+        repo_tag = rest
+    else:
+        registry = DEFAULT_REGISTRY
+        repo_tag = image
+    if ":" in repo_tag.rsplit("/", 1)[-1]:
+        repo, _, tag = repo_tag.rpartition(":")
+    else:
+        repo, tag = repo_tag, "latest"
+    if registry == DEFAULT_REGISTRY and "/" not in repo:
+        repo = f"library/{repo}"
+    return registry, repo, digest or tag
+
+
+def _request(url: str, headers: dict, timeout: float = 10.0) -> Tuple[int, dict, bytes]:
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _bearer_challenge(headers: dict) -> Optional[dict]:
+    www = next((v for k, v in headers.items() if k.lower() == "www-authenticate"), "")
+    if not www.lower().startswith("bearer"):
+        return None
+    return dict(re.findall(r'(\w+)="([^"]*)"', www))
+
+
+def _fetch_token(challenge: dict, username: Optional[str], password: Optional[str]) -> Optional[str]:
+    realm = challenge.get("realm")
+    if not realm:
+        return None
+    params = {k: v for k, v in challenge.items() if k in ("service", "scope")}
+    url = realm + ("?" + urllib.parse.urlencode(params) if params else "")
+    headers = {}
+    if username:
+        basic = base64.b64encode(f"{username}:{password or ''}".encode()).decode()
+        headers["Authorization"] = f"Basic {basic}"
+    status, _, body = _request(url, headers)
+    if status != 200:
+        raise ServerClientError(
+            f"registry auth failed (HTTP {status} from token endpoint)"
+            + (" — check registry_auth credentials" if username else "")
+        )
+    data = json.loads(body)
+    return data.get("token") or data.get("access_token")
+
+
+def _get_with_auth(url: str, accept: str, auth_state: dict) -> Tuple[int, dict, bytes]:
+    headers = {"Accept": accept}
+    if auth_state.get("token"):
+        headers["Authorization"] = f"Bearer {auth_state['token']}"
+    status, hdrs, body = _request(url, headers)
+    if status == 401 and "token" not in auth_state:
+        challenge = _bearer_challenge(hdrs)
+        if challenge:
+            auth_state["token"] = _fetch_token(
+                challenge, auth_state.get("username"), auth_state.get("password")
+            )
+            headers["Authorization"] = f"Bearer {auth_state['token']}"
+            status, hdrs, body = _request(url, headers)
+    return status, hdrs, body
+
+
+def _scheme(registry: str, insecure: bool) -> str:
+    return "http" if insecure or registry.startswith(("127.", "localhost")) else "https"
+
+
+def get_image_config_sync(
+    image: str,
+    username: Optional[str] = None,
+    password: Optional[str] = None,
+    insecure: bool = False,
+) -> ImageConfig:
+    registry, repo, ref = parse_image_ref(image)
+    base = f"{_scheme(registry, insecure)}://{registry}/v2/{repo}"
+    auth: dict = {"username": username, "password": password}
+    try:
+        status, hdrs, body = _get_with_auth(f"{base}/manifests/{ref}", MANIFEST_ACCEPT, auth)
+    except (OSError, urllib.error.URLError) as e:
+        # Unreachable registry is NOT a bad image: the server may be air-gapped
+        # while the TPU hosts are not. Degrade to unverified.
+        return ImageConfig(image=image, verified=False, note=f"registry unreachable: {e}")
+    if status in (401, 403):
+        raise ServerClientError(
+            f"not authorized to pull {image} (HTTP {status}) — check registry_auth"
+        )
+    if status == 404:
+        raise ServerClientError(f"image not found in registry: {image}")
+    if status != 200:
+        raise ServerClientError(f"registry error for {image}: HTTP {status}")
+    manifest = json.loads(body)
+
+    # Manifest list / OCI index: prefer linux/amd64 (TPU VMs), else first entry.
+    if manifest.get("manifests"):
+        entries = manifest["manifests"]
+        chosen = next(
+            (
+                m for m in entries
+                if m.get("platform", {}).get("os") == "linux"
+                and m.get("platform", {}).get("architecture") == "amd64"
+            ),
+            entries[0],
+        )
+        status, _, body = _get_with_auth(
+            f"{base}/manifests/{chosen['digest']}", MANIFEST_ACCEPT, auth
+        )
+        if status != 200:
+            raise ServerClientError(f"registry error for {image}: HTTP {status}")
+        manifest = json.loads(body)
+
+    config_digest = (manifest.get("config") or {}).get("digest")
+    if not config_digest:
+        raise ServerClientError(f"unsupported manifest for {image} (no config digest)")
+    status, _, body = _get_with_auth(f"{base}/blobs/{config_digest}", "*/*", auth)
+    if status != 200:
+        raise ServerClientError(f"failed to fetch image config for {image}: HTTP {status}")
+    cfg = json.loads(body)
+    inner = cfg.get("config") or {}
+    return ImageConfig(
+        image=image,
+        user=inner.get("User") or None,
+        entrypoint=inner.get("Entrypoint"),
+        cmd=inner.get("Cmd"),
+        os=cfg.get("os"),
+        architecture=cfg.get("architecture"),
+    )
+
+
+async def get_image_config(
+    image: str,
+    username: Optional[str] = None,
+    password: Optional[str] = None,
+    insecure: bool = False,
+) -> ImageConfig:
+    """Async wrapper: the blocking HTTP dance runs in the default executor."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, lambda: get_image_config_sync(image, username, password, insecure)
+    )
+
+
+# (image, username) -> (monotonic_deadline, ImageConfig | ServerClientError).
+# Keeps repeated plans fast and avoids hammering registries; definitive errors
+# are cached too (a missing tag stays missing for the TTL).
+_cache: dict = {}
+_CACHE_TTL = 300.0
+
+
+async def get_image_config_cached(
+    image: str,
+    username: Optional[str] = None,
+    password: Optional[str] = None,
+    insecure: bool = False,
+) -> ImageConfig:
+    import time
+
+    key = (image, username)
+    hit = _cache.get(key)
+    if hit and hit[0] > time.monotonic():
+        if isinstance(hit[1], Exception):
+            raise hit[1]
+        return hit[1]
+    try:
+        result = await get_image_config(image, username, password, insecure)
+    except ServerClientError as e:
+        _cache[key] = (time.monotonic() + _CACHE_TTL, e)
+        raise
+    # Unverified (unreachable registry) results are not cached: the outage may
+    # be transient and the next plan should retry.
+    if result.verified:
+        _cache[key] = (time.monotonic() + _CACHE_TTL, result)
+    return result
+
+
+def clear_cache() -> None:
+    _cache.clear()

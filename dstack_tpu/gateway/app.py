@@ -6,8 +6,10 @@ OpenAI-compatible model registry). TPU re-design: one aiohttp process replaces
 the nginx+python pair — aiohttp streams SSE/chunked inference output fine,
 needs no config-file reloads (the registry is in-process, updated over the
 control plane's sync API), and ships as a single module the startup script can
-launch (`python -m dstack_tpu.gateway`). TLS terminates at a fronting LB or
-host certs (``certificate`` config) — the appliance itself speaks HTTP.
+launch (`python -m dstack_tpu.gateway`). TLS terminates IN the appliance:
+``--tls-port``/``--certs-dir`` serve HTTPS with per-domain SNI certs, and
+``--acme-directory`` auto-issues them over ACME http-01 when a service
+registers a domain (gateway/tls.py — the certbot+nginx equivalent).
 
 Routing surface:
   - path:   /services/{project}/{run}/...       (always available)
@@ -106,13 +108,17 @@ class Registry:
         return list(self._services.values())
 
 
-def create_app(token: str) -> web.Application:
+def create_app(token: str, tls_manager=None) -> web.Application:
+    """`tls_manager` (gateway.tls_manager.TlsManager) enables in-appliance TLS:
+    http-01 challenge serving on this HTTP app + auto-issuance for registered
+    domains (reference nginx.py:75-110 runs certbot for the same purpose)."""
     from dstack_tpu.core.services.rate_limit import RateLimiter
 
     registry = Registry()
     limiter = RateLimiter()
     app = web.Application()
     app["registry"] = registry
+    app["tls_manager"] = tls_manager
 
     def _rate_check(entry: ServiceEntry, path: str) -> None:
         if entry.rate_limits and not limiter.check(
@@ -138,6 +144,10 @@ def create_app(token: str) -> web.Application:
             entry.project, entry.run_name, len(entry.replicas),
             f", model {entry.model_name}" if entry.model_name else "",
         )
+        if entry.domain and tls_manager is not None:
+            # Issue (or load) the domain's certificate off the request path;
+            # the SNI callback picks it up the moment it lands in the store.
+            tls_manager.ensure_async(entry.domain)
         return web.json_response(entry.to_dict())
 
     async def unregister(request: web.Request) -> web.Response:
@@ -204,7 +214,16 @@ def create_app(token: str) -> web.Application:
         host, port = entry.pick_replica()
         return await forward(request, host, port, request.match_info.get("tail", ""))
 
+    async def acme_challenge(request: web.Request) -> web.Response:
+        body = None
+        if tls_manager is not None:
+            body = tls_manager.challenge_body(request.match_info["token"])
+        if body is None:
+            raise web.HTTPNotFound()
+        return web.Response(text=body)
+
     app.router.add_get("/healthcheck", healthcheck)
+    app.router.add_get("/.well-known/acme-challenge/{token}", acme_challenge)
     app.router.add_post("/api/registry/register", register)
     app.router.add_post("/api/registry/unregister", unregister)
     app.router.add_get("/api/registry/services", list_services)
@@ -216,15 +235,35 @@ def create_app(token: str) -> web.Application:
     return app
 
 
-async def serve(host: str, port: int, token: str) -> None:
+async def serve(
+    host: str,
+    port: int,
+    token: str,
+    tls_port: Optional[int] = None,
+    certs_dir: Optional[str] = None,
+    acme_directory: Optional[str] = None,
+    acme_contact: Optional[str] = None,
+) -> None:
     import asyncio
 
-    runner = web.AppRunner(create_app(token))
+    tls_manager = None
+    if certs_dir:
+        from dstack_tpu.gateway.tls_manager import TlsManager
+
+        tls_manager = TlsManager(certs_dir, acme_directory, acme_contact)
+    runner = web.AppRunner(create_app(token, tls_manager=tls_manager))
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()
     actual = site._server.sockets[0].getsockname()[1]  # port 0 -> ephemeral
     print(f"dstack-tpu-gateway listening on {host}:{actual}", flush=True)
+    if tls_manager is not None and tls_port is not None:
+        tls_site = web.TCPSite(
+            runner, host, tls_port, ssl_context=tls_manager.server_context()
+        )
+        await tls_site.start()
+        tls_actual = tls_site._server.sockets[0].getsockname()[1]
+        print(f"dstack-tpu-gateway tls on {host}:{tls_actual}", flush=True)
     while True:
         await asyncio.sleep(3600)
 
@@ -236,9 +275,18 @@ def main() -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--token", required=True)
+    parser.add_argument("--tls-port", type=int, default=None,
+                        help="HTTPS listener (SNI certs from --certs-dir)")
+    parser.add_argument("--certs-dir", default=None,
+                        help="per-domain cert store; enables TLS features")
+    parser.add_argument("--acme-directory", default=None,
+                        help="ACME v2 directory URL for auto-issuance (http-01)")
+    parser.add_argument("--acme-contact", default=None)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
-    asyncio.run(serve(args.host, args.port, args.token))
+    asyncio.run(serve(args.host, args.port, args.token, tls_port=args.tls_port,
+                      certs_dir=args.certs_dir, acme_directory=args.acme_directory,
+                      acme_contact=args.acme_contact))
 
 
 if __name__ == "__main__":

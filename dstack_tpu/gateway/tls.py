@@ -1,0 +1,360 @@
+"""Gateway TLS: SNI certificate store + a minimal ACME v2 (RFC 8555) client.
+
+Parity: reference proxy/gateway/services/nginx.py:75-110 — certbot provisions a
+certificate per service domain and nginx terminates TLS. TPU re-design: the
+aiohttp appliance terminates TLS itself via an SNI callback over a directory of
+per-domain certs, and issuance is a small ACME client speaking the REST flow
+directly (directory -> nonce -> account -> order -> http-01 -> finalize), the
+same SDK-free style as the repo's cloud clients. The `cryptography` primitives
+(EC keys, CSR, JWS signatures) are the only dependency — no certbot, no nginx.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import json
+import logging
+import os
+import ssl
+import threading
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def _b64u(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+# ---------------------------------------------------------------------------
+# Certificate store + SNI
+
+
+class CertStore:
+    """certs_dir/<domain>/{fullchain.pem,privkey.pem}; hands aiohttp one parent
+    SSLContext whose sni_callback swaps in the per-domain context."""
+
+    def __init__(self, certs_dir: str) -> None:
+        self.certs_dir = certs_dir
+        os.makedirs(certs_dir, exist_ok=True)
+        self._contexts: Dict[str, ssl.SSLContext] = {}
+        self._lock = threading.Lock()
+        self._load_all()
+
+    def _domain_dir(self, domain: str) -> str:
+        safe = domain.lower().strip(".")
+        if "/" in safe or safe.startswith("."):
+            raise ValueError(f"bad domain {domain!r}")
+        return os.path.join(self.certs_dir, safe)
+
+    def _load_all(self) -> None:
+        for name in os.listdir(self.certs_dir):
+            full = os.path.join(self.certs_dir, name, "fullchain.pem")
+            key = os.path.join(self.certs_dir, name, "privkey.pem")
+            if os.path.exists(full) and os.path.exists(key):
+                try:
+                    self._contexts[name] = self._make_ctx(full, key)
+                except ssl.SSLError:
+                    logger.exception("skipping unloadable cert for %s", name)
+
+    @staticmethod
+    def _make_ctx(fullchain: str, privkey: str) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(fullchain, privkey)
+        return ctx
+
+    def put(self, domain: str, fullchain_pem: str, privkey_pem: str) -> None:
+        d = self._domain_dir(domain)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "fullchain.pem"), "w") as f:
+            f.write(fullchain_pem)
+        key_path = os.path.join(d, "privkey.pem")
+        fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(privkey_pem)
+        with self._lock:
+            self._contexts[domain.lower()] = self._make_ctx(
+                os.path.join(d, "fullchain.pem"), key_path
+            )
+
+    def has(self, domain: str) -> bool:
+        return domain.lower() in self._contexts
+
+    def domains(self):
+        return sorted(self._contexts)
+
+    def server_context(self) -> ssl.SSLContext:
+        """Parent context: a self-signed placeholder cert (so non-SNI clients
+        still complete a handshake) + the SNI swap into per-domain contexts."""
+        placeholder_dir = os.path.join(self.certs_dir, ".placeholder")
+        full = os.path.join(placeholder_dir, "fullchain.pem")
+        key = os.path.join(placeholder_dir, "privkey.pem")
+        if not (os.path.exists(full) and os.path.exists(key)):
+            os.makedirs(placeholder_dir, exist_ok=True)
+            chain, priv = self_signed_cert("dstack-tpu-gateway.invalid")
+            with open(full, "w") as f:
+                f.write(chain)
+            fd = os.open(key, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(priv)
+        parent = self._make_ctx(full, key)
+
+        def sni(ssl_obj, server_name, _ctx):
+            if server_name:
+                with self._lock:
+                    per = self._contexts.get(server_name.lower())
+                if per is not None:
+                    ssl_obj.context = per
+            return None
+
+        parent.sni_callback = sni
+        return parent
+
+
+def self_signed_cert(cn: str, days: int = 3650) -> Tuple[str, str]:
+    """(cert_pem, key_pem) — placeholder/test certificates."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName([x509.DNSName(cn)]), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    return (
+        cert.public_bytes(serialization.Encoding.PEM).decode(),
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ).decode(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ACME v2 client (http-01)
+
+
+class AcmeError(RuntimeError):
+    pass
+
+
+class AcmeClient:
+    """Minimal RFC 8555 client: ES256 account key, http-01 only.
+
+    ``publish(token, key_authorization)`` / ``unpublish(token)`` hook the
+    challenge body into whatever serves
+    ``/.well-known/acme-challenge/{token}`` on port 80 (the gateway app).
+    """
+
+    def __init__(
+        self,
+        directory_url: str,
+        publish: Callable[[str, str], None],
+        unpublish: Callable[[str], None],
+        contact: Optional[str] = None,
+        timeout: float = 10.0,
+    ) -> None:
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        self.directory_url = directory_url
+        self.publish = publish
+        self.unpublish = unpublish
+        self.contact = contact
+        self.timeout = timeout
+        self.account_key = ec.generate_private_key(ec.SECP256R1())
+        self.kid: Optional[str] = None
+        self._nonce: Optional[str] = None
+        self._dir: Optional[dict] = None
+
+    # -- low-level JOSE/HTTP plumbing ------------------------------------
+
+    def _jwk(self) -> dict:
+        nums = self.account_key.public_key().public_numbers()
+        return {
+            "crv": "P-256",
+            "kty": "EC",
+            "x": _b64u(nums.x.to_bytes(32, "big")),
+            "y": _b64u(nums.y.to_bytes(32, "big")),
+        }
+
+    def thumbprint(self) -> str:
+        import hashlib
+
+        canonical = json.dumps(self._jwk(), separators=(",", ":"), sort_keys=True)
+        return _b64u(hashlib.sha256(canonical.encode()).digest())
+
+    def _sign(self, protected_b64: str, payload_b64: str) -> str:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec, utils
+
+        der = self.account_key.sign(
+            f"{protected_b64}.{payload_b64}".encode(), ec.ECDSA(hashes.SHA256())
+        )
+        r, s = utils.decode_dss_signature(der)
+        return _b64u(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+
+    def _http(self, method: str, url: str, data: Optional[bytes] = None,
+              headers: Optional[dict] = None) -> Tuple[int, dict, bytes]:
+        req = urllib.request.Request(url, data=data, headers=headers or {}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                hdrs = dict(resp.headers)
+                body = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            hdrs, body, status = dict(e.headers), e.read(), e.code
+        nonce = next((v for k, v in hdrs.items() if k.lower() == "replay-nonce"), None)
+        if nonce:
+            self._nonce = nonce
+        return status, hdrs, body
+
+    def _directory(self) -> dict:
+        if self._dir is None:
+            status, _, body = self._http("GET", self.directory_url)
+            if status != 200:
+                raise AcmeError(f"ACME directory fetch failed: HTTP {status}")
+            self._dir = json.loads(body)
+        return self._dir
+
+    def _fresh_nonce(self) -> str:
+        if self._nonce is None:
+            self._http("HEAD", self._directory()["newNonce"])
+        if self._nonce is None:
+            raise AcmeError("ACME server returned no Replay-Nonce")
+        nonce, self._nonce = self._nonce, None
+        return nonce
+
+    def _post(self, url: str, payload: Optional[dict]) -> Tuple[int, dict, bytes]:
+        protected: dict = {"alg": "ES256", "nonce": self._fresh_nonce(), "url": url}
+        if self.kid:
+            protected["kid"] = self.kid
+        else:
+            protected["jwk"] = self._jwk()
+        protected_b64 = _b64u(json.dumps(protected).encode())
+        payload_b64 = "" if payload is None else _b64u(json.dumps(payload).encode())
+        jws = {
+            "protected": protected_b64,
+            "payload": payload_b64,
+            "signature": self._sign(protected_b64, payload_b64),
+        }
+        return self._http(
+            "POST", url, json.dumps(jws).encode(),
+            {"Content-Type": "application/jose+json"},
+        )
+
+    # -- the issuance flow ------------------------------------------------
+
+    def obtain(self, domain: str) -> Tuple[str, str]:
+        """Blocking issuance: returns (fullchain_pem, privkey_pem)."""
+        import time
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+
+        d = self._directory()
+        # Account (idempotent: onlyReturnExisting is unnecessary, we keep kid).
+        if self.kid is None:
+            payload = {"termsOfServiceAgreed": True}
+            if self.contact:
+                payload["contact"] = [f"mailto:{self.contact}"]
+            status, hdrs, body = self._post(d["newAccount"], payload)
+            if status not in (200, 201):
+                raise AcmeError(f"newAccount failed: HTTP {status}: {body[:200]!r}")
+            self.kid = next(
+                (v for k, v in hdrs.items() if k.lower() == "location"), None
+            )
+            if not self.kid:
+                raise AcmeError("newAccount returned no Location (kid)")
+
+        status, hdrs, body = self._post(
+            d["newOrder"], {"identifiers": [{"type": "dns", "value": domain}]}
+        )
+        if status not in (200, 201):
+            raise AcmeError(f"newOrder failed: HTTP {status}: {body[:200]!r}")
+        order = json.loads(body)
+        order_url = next((v for k, v in hdrs.items() if k.lower() == "location"), "")
+
+        published = []
+        try:
+            for authz_url in order["authorizations"]:
+                status, _, body = self._post(authz_url, None)  # POST-as-GET
+                if status != 200:
+                    raise AcmeError(f"authz fetch failed: HTTP {status}")
+                authz = json.loads(body)
+                challenge = next(
+                    (c for c in authz["challenges"] if c["type"] == "http-01"), None
+                )
+                if challenge is None:
+                    raise AcmeError("server offered no http-01 challenge")
+                key_auth = f"{challenge['token']}.{self.thumbprint()}"
+                self.publish(challenge["token"], key_auth)
+                published.append(challenge["token"])
+                status, _, body = self._post(challenge["url"], {})
+                if status not in (200, 202):
+                    raise AcmeError(f"challenge answer failed: HTTP {status}")
+                # Poll the authorization until valid.
+                for _ in range(30):
+                    status, _, body = self._post(authz_url, None)
+                    state = json.loads(body).get("status")
+                    if state == "valid":
+                        break
+                    if state in ("invalid", "revoked", "expired"):
+                        raise AcmeError(f"authorization {state} for {domain}")
+                    time.sleep(0.5)
+                else:
+                    raise AcmeError(f"authorization pending past deadline for {domain}")
+
+            cert_key = ec.generate_private_key(ec.SECP256R1())
+            csr = (
+                x509.CertificateSigningRequestBuilder()
+                .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, domain)]))
+                .add_extension(
+                    x509.SubjectAlternativeName([x509.DNSName(domain)]), critical=False
+                )
+                .sign(cert_key, hashes.SHA256())
+            )
+            csr_b64 = _b64u(csr.public_bytes(serialization.Encoding.DER))
+            status, _, body = self._post(order["finalize"], {"csr": csr_b64})
+            if status != 200:
+                raise AcmeError(f"finalize failed: HTTP {status}: {body[:200]!r}")
+
+            cert_url = json.loads(body).get("certificate")
+            for _ in range(30):
+                if cert_url:
+                    break
+                status, _, body = self._post(order_url, None)
+                data = json.loads(body)
+                if data.get("status") == "invalid":
+                    raise AcmeError("order invalid after finalize")
+                cert_url = data.get("certificate")
+                time.sleep(0.5)
+            if not cert_url:
+                raise AcmeError("order never reached valid/certificate")
+            status, _, body = self._post(cert_url, None)
+            if status != 200:
+                raise AcmeError(f"certificate download failed: HTTP {status}")
+            key_pem = cert_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ).decode()
+            return body.decode(), key_pem
+        finally:
+            for token in published:
+                self.unpublish(token)

@@ -1,0 +1,94 @@
+"""Glue between the gateway app and gateway.tls: challenge hosting + issuance.
+
+One manager per appliance: owns the CertStore (SNI) and, when an ACME directory
+is configured, an AcmeClient whose http-01 bodies the HTTP app serves from
+``/.well-known/acme-challenge/``. Domains with operator-provisioned certs in
+the store never trigger issuance (the reference's `certificate` passthrough)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import ssl
+import threading
+from typing import Dict, Optional
+
+from dstack_tpu.gateway.tls import AcmeClient, CertStore
+
+logger = logging.getLogger(__name__)
+
+
+class TlsManager:
+    def __init__(
+        self,
+        certs_dir: str,
+        acme_directory: Optional[str] = None,
+        acme_contact: Optional[str] = None,
+    ) -> None:
+        self.store = CertStore(certs_dir)
+        self._challenges: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._inflight: set = set()
+        self.acme: Optional[AcmeClient] = None
+        if acme_directory:
+            self.acme = AcmeClient(
+                acme_directory,
+                publish=self._publish,
+                unpublish=self._unpublish,
+                contact=acme_contact,
+            )
+
+    # http-01 plumbing -----------------------------------------------------
+    def _publish(self, token: str, key_auth: str) -> None:
+        with self._lock:
+            self._challenges[token] = key_auth
+
+    def _unpublish(self, token: str) -> None:
+        with self._lock:
+            self._challenges.pop(token, None)
+
+    def challenge_body(self, token: str) -> Optional[str]:
+        with self._lock:
+            return self._challenges.get(token)
+
+    # issuance -------------------------------------------------------------
+    def ensure_async(self, domain: str) -> None:
+        """Fire-and-forget: issue the domain's cert unless present/in flight."""
+        domain = domain.lower()
+        if self.store.has(domain) or self.acme is None:
+            return
+        with self._lock:
+            if domain in self._inflight:
+                return
+            self._inflight.add(domain)
+
+        async def _run() -> None:
+            try:
+                chain, key = await asyncio.to_thread(self.acme.obtain, domain)
+                self.store.put(domain, chain, key)
+                logger.info("obtained certificate for %s", domain)
+            except Exception:
+                logger.exception("ACME issuance failed for %s", domain)
+            finally:
+                with self._lock:
+                    self._inflight.discard(domain)
+
+        asyncio.get_running_loop().create_task(_run())
+
+    async def ensure(self, domain: str) -> bool:
+        """Blocking variant (tests / eager callers): True when a cert exists."""
+        domain = domain.lower()
+        if self.store.has(domain):
+            return True
+        if self.acme is None:
+            return False
+        try:
+            chain, key = await asyncio.to_thread(self.acme.obtain, domain)
+        except Exception:
+            logger.exception("ACME issuance failed for %s", domain)
+            return False
+        self.store.put(domain, chain, key)
+        return True
+
+    def server_context(self) -> ssl.SSLContext:
+        return self.store.server_context()

@@ -115,8 +115,8 @@ async def healthcheck(request: web.Request) -> web.Response:
 
 
 async def dashboard(request: web.Request) -> web.Response:
-    """Read-only admin dashboard (the reference serves a React SPA from
-    server/statics, app.py:292-295; this is the small no-build equivalent)."""
+    """Admin SPA shell (the reference serves a React SPA from server/statics,
+    app.py:292-295; this serves the repo's build-less ES-module equivalent)."""
     from pathlib import Path
 
     path = Path(__file__).parent / "statics" / "index.html"
@@ -135,8 +135,11 @@ def create_app(
     )
     app["db"] = Database(db_path if db_path is not None else settings.DB_PATH)
     app["run_background_tasks"] = run_background_tasks
+    from pathlib import Path
+
     app.router.add_get("/healthcheck", healthcheck)
     app.router.add_get("/", dashboard)
+    app.router.add_static("/statics/", Path(__file__).parent / "statics")
     app.add_routes(users_router.routes)
     app.add_routes(projects_router.routes)
     app.add_routes(runs_router.routes)

@@ -29,6 +29,7 @@ from dstack_tpu.core.models.runs import (
     RunTerminationReason,
 )
 from dstack_tpu.core.models.services import ServiceSpec
+from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, dumps, loads, new_id
 from dstack_tpu.server.services.jobs.configurators import get_job_specs
 from dstack_tpu.utils.common import from_iso, now_utc, to_iso
@@ -174,6 +175,32 @@ async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -
         db, project_row, job_specs[0].requirements, profile
     )
 
+    # Plan-time image introspection (reference services/docker.py:34-70): a bad
+    # image or credential fails HERE, not after a slice is provisioned. The
+    # default TPU image is baked (never pulled from a registry) — skip it.
+    image_config = None
+    image = getattr(plan_spec.configuration, "image", None)
+    if image and settings.VALIDATE_IMAGES:
+        from dstack_tpu.core.services import docker_registry
+
+        username = password = None
+        auth = getattr(plan_spec.configuration, "registry_auth", None)
+        if auth is not None:
+            from dstack_tpu.server.services import secrets as secrets_service
+            from dstack_tpu.utils.interpolator import extract_references, interpolate_env
+
+            vals = {"username": auth.username or "", "password": auth.password or ""}
+            refs = extract_references(vals.values(), "secrets")
+            if refs:
+                store = await secrets_service.get_secrets(db, project_row["id"])
+                vals = interpolate_env(
+                    vals, {"secrets": {k: store[k] for k in refs if k in store}},
+                    missing_ok=True,
+                )
+            username, password = vals["username"] or None, vals["password"] or None
+        icfg = await docker_registry.get_image_config_cached(image, username, password)
+        image_config = icfg.model_dump(mode="json")
+
     current = None
     action = "create"
     existing = await db.fetchone(
@@ -202,6 +229,7 @@ async def get_run_plan(db: Database, project_row, user_row, run_spec: RunSpec) -
         max_offer_price=max((o.price for o in offer_list), default=None),
         current_resource=current,
         action=action,
+        image_config=image_config,
     )
 
 

@@ -62,6 +62,10 @@ SSH_TUNNELS_ENABLED = _env_bool("DSTACK_TPU_SSH_TUNNELS_ENABLED", True)
 SSH_IDENTITY_FILE = os.getenv("DSTACK_TPU_SSH_IDENTITY_FILE")
 ENABLE_PROMETHEUS_METRICS = _env_bool("DSTACK_TPU_ENABLE_PROMETHEUS_METRICS", True)
 
+# Plan-time registry image introspection (reference services/docker.py:34-70):
+# a bad image:/credential fails in the plan instead of after provisioning.
+VALIDATE_IMAGES = _env_bool("DSTACK_TPU_VALIDATE_IMAGES", True)
+
 MAX_CODE_SIZE = int(os.getenv("DSTACK_TPU_MAX_CODE_SIZE", str(2 * 1024 * 1024)))  # 2 MiB, ref settings.py:92
 
 SERVER_HOST = os.getenv("DSTACK_TPU_SERVER_HOST", "127.0.0.1")

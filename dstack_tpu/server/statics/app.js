@@ -1,0 +1,583 @@
+/* dstack-tpu admin SPA — build-less ES module, zero dependencies.
+   Parity target: the reference ships a React SPA from server statics
+   (ref: src/dstack/_internal/server/app.py:292-295, frontend/src/); this is the
+   TPU repo's equivalent over the same REST API the CLI/SDK use. */
+
+const $app = document.getElementById("app");
+const LS_TOKEN = "dstack_tpu_token";
+const LS_PROJECT = "dstack_tpu_project";
+
+let state = {
+  token: localStorage.getItem(LS_TOKEN) || "",
+  project: localStorage.getItem(LS_PROJECT) || "main",
+  projects: [],
+  user: null,
+};
+
+/* ---------------- tiny DOM + API helpers ---------------- */
+
+function h(tag, attrs = {}, ...children) {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "class") el.className = v;
+    else if (k.startsWith("on") && typeof v === "function") el.addEventListener(k.slice(2), v);
+    else if (v !== null && v !== undefined) el.setAttribute(k, v);
+  }
+  for (const c of children.flat(Infinity)) {
+    if (c === null || c === undefined || c === false) continue;
+    el.append(c.nodeType ? c : document.createTextNode(String(c)));
+  }
+  return el;
+}
+
+class ApiError extends Error {
+  constructor(status, detail) { super(detail || `HTTP ${status}`); this.status = status; }
+}
+
+async function api(path, body) {
+  const resp = await fetch(path, {
+    method: "POST",
+    headers: {
+      "Content-Type": "application/json",
+      ...(state.token ? { Authorization: `Bearer ${state.token}` } : {}),
+    },
+    body: JSON.stringify(body || {}),
+  });
+  if (resp.status === 401 || resp.status === 403) {
+    if (location.hash !== "#/login") { location.hash = "#/login"; }
+    throw new ApiError(resp.status, "unauthorized");
+  }
+  const text = await resp.text();
+  let data = null;
+  try { data = text ? JSON.parse(text) : null; } catch { data = { raw: text }; }
+  if (!resp.ok) throw new ApiError(resp.status, data && (data.detail || data.error) || text);
+  return data;
+}
+
+const P = () => encodeURIComponent(state.project);
+
+/* ---------------- formatting ---------------- */
+
+function ago(iso) {
+  if (!iso) return "—";
+  const s = (Date.now() - new Date(iso).getTime()) / 1000;
+  if (s < 0) return "now";
+  if (s < 60) return `${Math.floor(s)}s ago`;
+  if (s < 3600) return `${Math.floor(s / 60)}m ago`;
+  if (s < 86400) return `${Math.floor(s / 3600)}h ${Math.floor((s % 3600) / 60)}m ago`;
+  return `${Math.floor(s / 86400)}d ago`;
+}
+
+function bytes(n) {
+  if (n === null || n === undefined) return "—";
+  const u = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let i = 0;
+  while (n >= 1024 && i < u.length - 1) { n /= 1024; i++; }
+  return `${n.toFixed(n >= 10 || i === 0 ? 0 : 1)} ${u[i]}`;
+}
+
+const money = (x) => (x || x === 0 ? `$${Number(x).toFixed(Number(x) < 10 ? 3 : 2)}` : "—");
+
+/* Status → pill class. Status colors are reserved for state and always carry
+   the status text itself (never color alone). */
+const STATUS_CLASS = {
+  done: "good", running: "active", active: "good", idle: "good",
+  submitted: "warn", provisioning: "warn", pulling: "warn", starting: "warn",
+  creating: "warn", busy: "active",
+  failed: "critical", terminated: "serious", terminating: "warn", aborted: "serious",
+};
+const pill = (status) =>
+  h("span", { class: `pill ${STATUS_CLASS[status] || ""}` }, h("span", { class: "dot" }), status || "—");
+
+function confirmThen(msg, fn) {
+  return async (ev) => {
+    ev.preventDefault(); ev.stopPropagation();
+    if (window.confirm(msg)) { try { await fn(); } catch (e) { alert(e.message); } refresh(); }
+  };
+}
+
+/* ---------------- sparkline chart (single series, hover layer) ---------------- */
+
+let $tip = null;
+function tipShow(x, y, html) {
+  if (!$tip) { $tip = h("div", { class: "chart-tip" }); document.body.append($tip); }
+  $tip.innerHTML = html;
+  $tip.style.left = `${Math.min(x + 12, window.innerWidth - 160)}px`;
+  $tip.style.top = `${y + 12}px`;
+  $tip.style.display = "block";
+}
+function tipHide() { if ($tip) $tip.style.display = "none"; }
+
+function sparkline(points, { title, unit = "", fmt = (v) => v.toFixed(1), w = 300, hgt = 64 }) {
+  // One series per chart (one axis); the title names the series, so no legend.
+  const card = h("div", { class: "chart-card" });
+  card.append(h("div", { class: "title" }, title));
+  if (!points.length) { card.append(h("div", { class: "muted" }, "no data")); return card; }
+  const vals = points.map((p) => p.v);
+  const latest = vals[vals.length - 1];
+  card.append(h("div", { class: "latest" }, `${fmt(latest)}${unit}`));
+  const mn = 0, mx = Math.max(...vals, 1e-9);
+  const px = (i) => (points.length === 1 ? w / 2 : (i / (points.length - 1)) * (w - 8) + 4);
+  const py = (v) => hgt - 14 - ((v - mn) / (mx - mn || 1)) * (hgt - 22);
+  const d = points.map((p, i) => `${i ? "L" : "M"}${px(i).toFixed(1)},${py(p.v).toFixed(1)}`).join("");
+  const ns = "http://www.w3.org/2000/svg";
+  const svg = document.createElementNS(ns, "svg");
+  svg.setAttribute("viewBox", `0 0 ${w} ${hgt}`);
+  svg.setAttribute("height", hgt);
+  const mk = (tag, attrs) => {
+    const e = document.createElementNS(ns, tag);
+    for (const [k, v] of Object.entries(attrs)) e.setAttribute(k, v);
+    svg.append(e); return e;
+  };
+  mk("line", { x1: 4, x2: w - 4, y1: py(0), y2: py(0), stroke: "var(--border)", "stroke-width": 1 });
+  mk("path", { d, fill: "none", stroke: "var(--series-1)", "stroke-width": 2, "stroke-linejoin": "round" });
+  const axisMax = mk("text", { x: 4, y: 10, class: "axis" });
+  axisMax.textContent = `${fmt(mx)}${unit}`;
+  const cross = mk("line", { y1: 8, y2: hgt - 14, stroke: "var(--text-muted)", "stroke-width": 1, visibility: "hidden" });
+  const dot = mk("circle", { r: 3.5, fill: "var(--series-1)", stroke: "var(--surface-1)", "stroke-width": 2, visibility: "hidden" });
+  svg.addEventListener("mousemove", (ev) => {
+    const rect = svg.getBoundingClientRect();
+    const fx = ((ev.clientX - rect.left) / rect.width) * w;
+    let best = 0, bd = Infinity;
+    points.forEach((p, i) => { const dd = Math.abs(px(i) - fx); if (dd < bd) { bd = dd; best = i; } });
+    const p = points[best];
+    cross.setAttribute("x1", px(best)); cross.setAttribute("x2", px(best));
+    cross.setAttribute("visibility", "visible");
+    dot.setAttribute("cx", px(best)); dot.setAttribute("cy", py(p.v));
+    dot.setAttribute("visibility", "visible");
+    tipShow(ev.clientX, ev.clientY,
+      `<b>${fmt(p.v)}${unit}</b><br><span class="muted">${new Date(p.t).toLocaleTimeString()}</span>`);
+  });
+  svg.addEventListener("mouseleave", () => { cross.setAttribute("visibility", "hidden"); dot.setAttribute("visibility", "hidden"); tipHide(); });
+  card.append(svg);
+  return card;
+}
+
+/* ---------------- layout ---------------- */
+
+const NAV = [
+  ["runs", "Runs"], ["fleets", "Fleets"], ["instances", "Instances"],
+  ["volumes", "Volumes"], ["gateways", "Gateways"], ["offers", "Offers"],
+  ["secrets", "Secrets"],
+];
+
+function layout(section, content) {
+  const nav = h("nav", {},
+    NAV.map(([key, label]) =>
+      h("a", { href: `#/p/${P()}/${key}`, class: section === key ? "active" : "" }, label)),
+    h("a", { href: "#/projects", class: section === "projects" ? "active" : "" }, "Projects"),
+    state.user && state.user.global_role === "admin"
+      ? h("a", { href: "#/users", class: section === "users" ? "active" : "" }, "Users") : null,
+  );
+  const projSel = h("select", {
+    onchange: (ev) => {
+      state.project = ev.target.value;
+      localStorage.setItem(LS_PROJECT, state.project);
+      location.hash = `#/p/${P()}/${NAV.some(([k]) => k === section) ? section : "runs"}`;
+    },
+  }, state.projects.map((p) => h("option", { value: p.project_name, selected: p.project_name === state.project ? "" : null }, p.project_name)));
+  return [
+    h("header", { class: "top" },
+      h("span", { class: "logo" }, h("a", { href: "#/" }, "dstack-tpu")),
+      nav,
+      h("span", { class: "spacer" }),
+      projSel,
+      h("button", { class: "small", onclick: () => { localStorage.removeItem(LS_TOKEN); state.token = ""; location.hash = "#/login"; } }, "Sign out"),
+    ),
+    h("main", {}, content),
+  ];
+}
+
+function render(...children) {
+  $app.replaceChildren(...children.flat(Infinity).filter(Boolean));
+}
+
+function table(headers, rows, emptyMsg) {
+  if (!rows.length) return h("div", { class: "empty" }, emptyMsg || "nothing here yet");
+  return h("table", { class: "list" },
+    h("thead", {}, h("tr", {}, headers.map((hd) => h("th", {}, hd)))),
+    h("tbody", {}, rows));
+}
+
+/* ---------------- views ---------------- */
+
+async function viewLogin() {
+  stopTimers();
+  const input = h("input", { type: "password", placeholder: "admin token", autofocus: "" });
+  const err = h("div", { class: "err" });
+  const form = h("form", {
+    onsubmit: async (ev) => {
+      ev.preventDefault();
+      state.token = input.value.trim();
+      try {
+        state.user = await api("/api/users/get_my_user");
+        localStorage.setItem(LS_TOKEN, state.token);
+        location.hash = "#/";
+      } catch (e) { err.textContent = e.status === 401 || e.status === 403 ? "invalid token" : e.message; }
+    },
+  },
+    h("h1", {}, "dstack-tpu"),
+    h("div", { class: "muted" }, "Paste the server admin token (printed at server startup) or a user token."),
+    input, h("button", {}, "Sign in"), err);
+  render(h("div", { class: "login-box" }, form));
+}
+
+async function ensureSession() {
+  if (!state.token) { location.hash = "#/login"; return false; }
+  try {
+    if (!state.user) state.user = await api("/api/users/get_my_user");
+    state.projects = await api("/api/projects/list");
+    if (!state.projects.some((p) => p.project_name === state.project) && state.projects.length) {
+      state.project = state.projects[0].project_name;
+    }
+    return true;
+  } catch (e) {
+    if (e.status === 401 || e.status === 403) return false;
+    throw e;
+  }
+}
+
+async function viewRuns() {
+  const runs = await api(`/api/project/${P()}/runs/list`);
+  const rows = runs.map((r) => {
+    const name = r.run_spec.run_name;
+    const conf = r.run_spec.configuration || {};
+    return h("tr", {},
+      h("td", {}, h("a", { href: `#/p/${P()}/runs/${encodeURIComponent(name)}` }, name)),
+      h("td", {}, conf.type || "task"),
+      h("td", {}, pill(r.status)),
+      h("td", {}, ago(r.submitted_at)),
+      h("td", { class: "num" }, money(r.cost)),
+      h("td", {}, h("div", { class: "row-actions" },
+        ["done", "failed", "terminated"].includes(r.status)
+          ? h("button", { class: "small danger", onclick: confirmThen(`Delete run ${name}?`, () => api(`/api/project/${P()}/runs/delete`, { runs_names: [name] })) }, "delete")
+          : h("button", { class: "small", onclick: confirmThen(`Stop run ${name}?`, () => api(`/api/project/${P()}/runs/stop`, { runs_names: [name] })) }, "stop"))),
+    );
+  });
+  render(layout("runs", [
+    h("h1", {}, "Runs"),
+    table(["Name", "Type", "Status", "Submitted", "Cost", ""], rows, "no runs — submit one with `dstack-tpu apply`"),
+  ]));
+  autoRefresh(8000);
+}
+
+async function viewRunDetail(runName) {
+  const run = await api(`/api/project/${P()}/runs/get`, { run_name: runName });
+  const conf = run.run_spec.configuration || {};
+  const jobs = [];
+  for (const job of run.jobs || []) {
+    const sub = job.job_submissions[job.job_submissions.length - 1];
+    if (!sub) continue;
+    jobs.push(h("tr", {},
+      h("td", { class: "num" }, `${job.job_spec.replica_num ?? 0}/${job.job_spec.job_num ?? 0}`),
+      h("td", {}, job.job_spec.job_name || "—"),
+      h("td", {}, pill(sub.status)),
+      h("td", {}, sub.termination_reason || "—"),
+      h("td", { class: "num" }, sub.exit_status ?? "—"),
+      h("td", {}, sub.job_provisioning_data ? `${sub.job_provisioning_data.hostname || ""} (${sub.job_provisioning_data.instance_type?.name || "?"})` : "—"),
+      h("td", {}, ago(sub.submitted_at)),
+    ));
+  }
+  const actions = h("div", { class: "row-actions" },
+    ["done", "failed", "terminated"].includes(run.status)
+      ? h("button", { class: "danger", onclick: confirmThen(`Delete run ${runName}?`, async () => { await api(`/api/project/${P()}/runs/delete`, { runs_names: [runName] }); location.hash = `#/p/${P()}/runs`; }) }, "delete")
+      : h("button", { class: "danger", onclick: confirmThen(`Stop run ${runName}?`, () => api(`/api/project/${P()}/runs/stop`, { runs_names: [runName] })) }, "stop"));
+
+  const kv = h("dl", { class: "kv" },
+    h("dt", {}, "Status"), h("dd", {}, pill(run.status), run.status_message ? ` — ${run.status_message}` : ""),
+    h("dt", {}, "Type"), h("dd", {}, conf.type || "task"),
+    h("dt", {}, "User"), h("dd", {}, run.user || "—"),
+    h("dt", {}, "Submitted"), h("dd", {}, `${new Date(run.submitted_at).toLocaleString()} (${ago(run.submitted_at)})`),
+    h("dt", {}, "Cost"), h("dd", {}, money(run.cost)),
+    run.error ? h("dt", {}, "Error") : null, run.error ? h("dd", {}, run.error) : null,
+    conf.type === "service" ? h("dt", {}, "Endpoint") : null,
+    conf.type === "service" ? h("dd", {}, h("code", { class: "inlinecode" }, `/proxy/services/${state.project}/${runName}/`)) : null,
+  );
+
+  // Metrics: one small chart per measure (one axis each — never dual-axis).
+  const charts = h("div", { class: "charts" });
+  (async () => {
+    try {
+      const m = await api(`/api/project/${P()}/metrics/job`, { run_name: runName, limit: 120 });
+      const pts = (m.points || []).slice().reverse();
+      if (pts.length) {
+        const take = (f) => pts.map((p) => ({ t: p.timestamp, v: f(p) })).filter((p) => p.v !== null && p.v !== undefined);
+        charts.append(sparkline(take((p) => p.cpu_usage_percent), { title: "CPU", unit: "%" }));
+        charts.append(sparkline(take((p) => p.memory_working_set_bytes / 1024 ** 3), { title: "Memory (working set)", unit: " GiB", fmt: (v) => v.toFixed(2) }));
+        const duty = take((p) => p.tpu_duty_cycle_percent);
+        if (duty.length) charts.append(sparkline(duty, { title: "TPU duty cycle", unit: "%" }));
+        const hbm = take((p) => (p.tpu_hbm_usage_bytes ?? null) === null ? null : p.tpu_hbm_usage_bytes / 1024 ** 3);
+        if (hbm.length) charts.append(sparkline(hbm, { title: "TPU HBM", unit: " GiB", fmt: (v) => v.toFixed(1) }));
+      }
+    } catch { /* metrics are optional (job may not have started) */ }
+  })();
+
+  // Live log tail over the REST poll endpoint.
+  const logbox = h("div", { class: "logbox" }, "");
+  const follow = h("input", { type: "checkbox", checked: "" });
+  let logLine = 0;
+  const pollLogs = async () => {
+    try {
+      const batch = await api(`/api/project/${P()}/logs/poll`, { run_name: runName, start_line: logLine, limit: 1000 });
+      const evs = batch.logs || [];
+      if (evs.length) {
+        logLine += evs.length;
+        logbox.append(document.createTextNode(evs.map((e) => e.message).join("")));
+        if (follow.checked) logbox.scrollTop = logbox.scrollHeight;
+      }
+    } catch { /* run may have no logs yet */ }
+  };
+  pollLogs();
+  timers.push(setInterval(pollLogs, 2000));
+
+  render(layout("runs", [
+    h("h1", {}, h("a", { href: `#/p/${P()}/runs` }, "Runs"), " / ", runName, h("span", { class: "spacer", style: "flex:1" }), actions),
+    kv,
+    h("h2", {}, "Jobs"),
+    table(["Replica/Job", "Name", "Status", "Termination", "Exit", "Instance", "Submitted"], jobs),
+    h("h2", {}, "Metrics"),
+    charts,
+    h("h2", {}, "Logs"),
+    h("div", { class: "log-controls" }, h("label", {}, follow, " follow")),
+    logbox,
+  ]));
+  // No full-view auto-refresh here: it would reset the log scroll. Logs poll on
+  // their own timer; status/jobs update on manual navigation or reload.
+}
+
+async function viewFleets() {
+  const fleets = await api(`/api/project/${P()}/fleets/list`);
+  const rows = fleets.map((f) => h("tr", {},
+    h("td", {}, h("a", { href: `#/p/${P()}/fleets/${encodeURIComponent(f.name)}` }, f.name)),
+    h("td", {}, pill(f.status)),
+    h("td", { class: "num" }, (f.instances || []).length),
+    h("td", {}, ago(f.created_at)),
+    h("td", {}, h("div", { class: "row-actions" },
+      h("button", { class: "small danger", onclick: confirmThen(`Delete fleet ${f.name}?`, () => api(`/api/project/${P()}/fleets/delete`, { names: [f.name] })) }, "delete"))),
+  ));
+  render(layout("fleets", [h("h1", {}, "Fleets"), table(["Name", "Status", "Instances", "Created", ""], rows)]));
+  autoRefresh(8000);
+}
+
+async function viewFleetDetail(name) {
+  const f = await api(`/api/project/${P()}/fleets/get`, { name });
+  const rows = (f.instances || []).map((i) => h("tr", {},
+    h("td", { class: "num" }, i.instance_num),
+    h("td", {}, i.name || "—"),
+    h("td", {}, pill(i.status)),
+    h("td", {}, i.instance_type?.name || "—"),
+    h("td", {}, i.hostname || "—"),
+    h("td", { class: "num" }, i.price ? `${money(i.price)}/hr` : "—"),
+  ));
+  render(layout("fleets", [
+    h("h1", {}, h("a", { href: `#/p/${P()}/fleets` }, "Fleets"), " / ", name),
+    h("dl", { class: "kv" },
+      h("dt", {}, "Status"), h("dd", {}, pill(f.status)),
+      h("dt", {}, "Created"), h("dd", {}, ago(f.created_at))),
+    h("h2", {}, "Instances"),
+    table(["#", "Name", "Status", "Type", "Hostname", "Price"], rows),
+  ]));
+  autoRefresh(15000);
+}
+
+async function viewInstances() {
+  const instances = await api(`/api/project/${P()}/instances/list`);
+  const rows = instances.map((i) => h("tr", {},
+    h("td", {}, i.name || i.id),
+    h("td", {}, pill(i.status)),
+    h("td", {}, i.instance_type?.name || "—"),
+    h("td", {}, i.hostname || "—"),
+    h("td", {}, i.fleet_name || "—"),
+    h("td", { class: "num" }, i.price ? `${money(i.price)}/hr` : "—"),
+    h("td", {}, ago(i.created)),
+  ));
+  render(layout("instances", [h("h1", {}, "Instances"), table(["Name", "Status", "Type", "Hostname", "Fleet", "Price", "Created"], rows)]));
+  autoRefresh(8000);
+}
+
+async function viewVolumes() {
+  const volumes = await api(`/api/project/${P()}/volumes/list`);
+  const rows = volumes.map((v) => h("tr", {},
+    h("td", {}, v.name),
+    h("td", {}, pill(v.status)),
+    h("td", {}, v.configuration?.backend || "—"),
+    h("td", {}, v.configuration?.region || "—"),
+    h("td", { class: "num" }, v.configuration?.size ? `${v.configuration.size} GB` : "—"),
+    h("td", { class: "num" }, (v.attachments || []).length),
+    h("td", {}, ago(v.created_at)),
+    h("td", {}, h("div", { class: "row-actions" },
+      h("button", { class: "small danger", onclick: confirmThen(`Delete volume ${v.name}?`, () => api(`/api/project/${P()}/volumes/delete`, { names: [v.name] })) }, "delete"))),
+  ));
+  render(layout("volumes", [h("h1", {}, "Volumes"), table(["Name", "Status", "Backend", "Region", "Size", "Attached", "Created", ""], rows)]));
+  autoRefresh(10000);
+}
+
+async function viewGateways() {
+  const gws = await api(`/api/project/${P()}/gateways/list`);
+  const rows = gws.map((g) => h("tr", {},
+    h("td", {}, g.name),
+    h("td", {}, pill(g.status)),
+    h("td", {}, g.configuration?.backend || "—"),
+    h("td", {}, g.configuration?.region || "—"),
+    h("td", {}, g.ip_address || "—"),
+    h("td", {}, g.configuration?.domain || "—"),
+    h("td", {}, h("div", { class: "row-actions" },
+      h("button", { class: "small danger", onclick: confirmThen(`Delete gateway ${g.name}?`, () => api(`/api/project/${P()}/gateways/delete`, { names: [g.name] })) }, "delete"))),
+  ));
+  const name = h("input", { placeholder: "name" });
+  const backend = h("input", { placeholder: "backend (e.g. gcp)", value: "gcp" });
+  const region = h("input", { placeholder: "region" });
+  const domain = h("input", { placeholder: "domain (optional)" });
+  const createForm = h("form", {
+    class: "inline",
+    onsubmit: async (ev) => {
+      ev.preventDefault();
+      try {
+        await api(`/api/project/${P()}/gateways/create`, {
+          configuration: {
+            name: name.value.trim(), backend: backend.value.trim(),
+            region: region.value.trim(), ...(domain.value.trim() ? { domain: domain.value.trim() } : {}),
+          },
+        });
+        refresh();
+      } catch (e) { alert(e.message); }
+    },
+  }, name, backend, region, domain, h("button", {}, "Create gateway"));
+  render(layout("gateways", [h("h1", {}, "Gateways"), createForm, table(["Name", "Status", "Backend", "Region", "IP", "Domain", ""], rows)]));
+  autoRefresh(10000);
+}
+
+async function viewOffers() {
+  const resp = await api(`/api/project/${P()}/offers/list`, { limit: 200 });
+  const rows = (resp.offers || []).map((o) => h("tr", {},
+    h("td", {}, o.slice_name || o.instance?.name || "—"),
+    h("td", {}, o.backend),
+    h("td", {}, o.region),
+    h("td", { class: "num" }, `${money(o.price)}/hr`),
+    h("td", {}, o.availability),
+    h("td", {}, o.spot ? "spot" : "on-demand"),
+  ));
+  render(layout("offers", [
+    h("h1", {}, "Offers"),
+    h("div", { class: "muted" }, "TPU slice offers across configured backends, cheapest first."),
+    table(["Slice", "Backend", "Region", "Price", "Availability", "Tier"], rows),
+  ]));
+}
+
+async function viewSecrets() {
+  const secrets = await api(`/api/project/${P()}/secrets/list`);
+  const rows = secrets.map((s) => h("tr", {},
+    h("td", {}, h("code", { class: "inlinecode" }, s)),
+    h("td", {}, h("div", { class: "row-actions" },
+      h("button", { class: "small danger", onclick: confirmThen(`Delete secret ${s}?`, () => api(`/api/project/${P()}/secrets/delete`, { names: [s] })) }, "delete"))),
+  ));
+  const name = h("input", { placeholder: "NAME" });
+  const value = h("input", { placeholder: "value", type: "password" });
+  const form = h("form", {
+    class: "inline",
+    onsubmit: async (ev) => {
+      ev.preventDefault();
+      try { await api(`/api/project/${P()}/secrets/set`, { name: name.value.trim(), value: value.value }); refresh(); }
+      catch (e) { alert(e.message); }
+    },
+  }, name, value, h("button", {}, "Set secret"));
+  render(layout("secrets", [h("h1", {}, "Secrets"), form, table(["Name", ""], rows, "no secrets")]));
+}
+
+async function viewProjects() {
+  const projects = await api("/api/projects/list");
+  const rows = projects.map((p) => h("tr", {},
+    h("td", {}, p.project_name),
+    h("td", {}, p.owner?.username || "—"),
+    h("td", { class: "num" }, (p.members || []).length),
+    h("td", {}, h("div", { class: "row-actions" },
+      h("button", { class: "small", onclick: () => { state.project = p.project_name; localStorage.setItem(LS_PROJECT, state.project); location.hash = `#/p/${P()}/runs`; } }, "open"),
+      h("button", { class: "small danger", onclick: confirmThen(`Delete project ${p.project_name}?`, () => api("/api/projects/delete", { projects_names: [p.project_name] })) }, "delete"))),
+  ));
+  const name = h("input", { placeholder: "project name" });
+  const form = h("form", {
+    class: "inline",
+    onsubmit: async (ev) => {
+      ev.preventDefault();
+      try { await api("/api/projects/create", { project_name: name.value.trim() }); refresh(); }
+      catch (e) { alert(e.message); }
+    },
+  }, name, h("button", {}, "Create project"));
+  render(layout("projects", [h("h1", {}, "Projects"), form, table(["Name", "Owner", "Members", ""], rows)]));
+}
+
+async function viewUsers() {
+  const users = await api("/api/users/list");
+  const rows = users.map((u) => h("tr", {},
+    h("td", {}, u.username),
+    h("td", {}, u.global_role),
+    h("td", {}, u.email || "—"),
+    h("td", {}, h("div", { class: "row-actions" },
+      h("button", {
+        class: "small",
+        onclick: async () => {
+          const r = await api("/api/users/refresh_token", { username: u.username });
+          window.prompt(`New token for ${u.username}:`, r.creds?.token || r.token || "");
+        },
+      }, "new token"),
+      h("button", { class: "small danger", onclick: confirmThen(`Delete user ${u.username}?`, () => api("/api/users/delete", { users: [u.username] })) }, "delete"))),
+  ));
+  const name = h("input", { placeholder: "username" });
+  const role = h("select", {}, h("option", {}, "user"), h("option", {}, "admin"));
+  const form = h("form", {
+    class: "inline",
+    onsubmit: async (ev) => {
+      ev.preventDefault();
+      try {
+        const u = await api("/api/users/create", { username: name.value.trim(), global_role: role.value });
+        window.prompt(`Token for ${u.username}:`, u.creds?.token || "");
+        refresh();
+      } catch (e) { alert(e.message); }
+    },
+  }, name, role, h("button", {}, "Create user"));
+  render(layout("users", [h("h1", {}, "Users"), form, table(["Username", "Role", "Email", ""], rows)]));
+}
+
+/* ---------------- router ---------------- */
+
+let timers = [];
+function stopTimers() { timers.forEach(clearInterval); timers = []; }
+function autoRefresh(ms) {
+  // Periodic re-render of the current (list) view.
+  timers.push(setInterval(() => { route(true); }, ms));
+}
+function refresh() { route(true); }
+
+let routing = false;
+async function route(isRefresh = false) {
+  if (routing) return; routing = true;
+  try {
+    const hash = location.hash || "#/";
+    const parts = hash.slice(2).split("/").map(decodeURIComponent).filter((x) => x !== "");
+    stopTimers();
+    if (parts[0] === "login") return void await viewLogin();
+    if (!(await ensureSession())) return;
+    if (parts[0] === "projects") return void await viewProjects();
+    if (parts[0] === "users") return void await viewUsers();
+    if (parts[0] === "p" && parts.length >= 3) {
+      state.project = parts[1];
+      localStorage.setItem(LS_PROJECT, state.project);
+      const section = parts[2];
+      if (section === "runs" && parts[3]) return void await viewRunDetail(parts[3]);
+      const views = {
+        runs: viewRuns, fleets: parts[3] ? () => viewFleetDetail(parts[3]) : viewFleets,
+        instances: viewInstances, volumes: viewVolumes, gateways: viewGateways,
+        offers: viewOffers, secrets: viewSecrets,
+      };
+      if (views[section]) return void await views[section]();
+    }
+    location.hash = `#/p/${P()}/runs`;
+  } catch (e) {
+    if (!(e instanceof ApiError && (e.status === 401 || e.status === 403))) {
+      render(layout("", [h("div", { class: "error-banner" }, `error: ${e.message}`)]));
+    }
+  } finally { routing = false; }
+}
+
+window.addEventListener("hashchange", () => route(false));
+route(false);

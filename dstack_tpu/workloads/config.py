@@ -81,6 +81,12 @@ PRESETS = {
         d_ff=8192, max_seq_len=2048, remat=True, remat_policy="full",
         attn_impl="flash", loss_chunk=256,
     ),
+    # GPT-2-124M geometry (BASELINE north-star "GPT-2 125M single-node CPU
+    # task"): d=768/L=12/h=12, vocab padded to a 128 multiple for clean tiling.
+    "gpt2_125m": LlamaConfig(
+        vocab_size=50304, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        d_ff=3072, max_seq_len=1024, loss_chunk=256,
+    ),
 }
 
 

@@ -1,0 +1,283 @@
+"""North-star measurements the reference cannot make: apply -> first-training-step
+latency (with a budget breakdown) and a model served through the in-server proxy,
+both against a REAL server process + the REAL native agent on this host's
+accelerator (BASELINE.md "North-star targets").
+
+Run:  python experiments/north_star.py [--skip-serve] [--skip-cpu]
+Emits one JSON object per measurement and a summary block for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The job-side training script: prints wall-clock MARK lines the measurement
+# parses out of the run's logs (same clock as the client: one host).
+TRAIN_SNIPPET = r"""
+import time
+print("MARK py_start %.6f" % time.time(), flush=True)
+import jax, jax.numpy as jnp
+print("MARK jax_imported %.6f" % time.time(), flush=True)
+from dstack_tpu.workloads import train as train_lib
+from dstack_tpu.workloads.config import get_config
+dev = jax.devices()[0]
+print("MARK devices_ready %.6f %s" % (time.time(), dev.device_kind), flush=True)
+cfg = get_config("{config}")
+opt = train_lib.make_optimizer()
+state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt)
+step = train_lib.make_train_step(cfg, opt)
+toks = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0, cfg.vocab_size)
+print("MARK init_done %.6f" % time.time(), flush=True)
+state, m = step(state, toks, toks)
+loss = float(m["loss"])
+print("MARK step1_done %.6f loss=%.4f" % (time.time(), loss), flush=True)
+for _ in range({extra_steps}):
+    state, m = step(state, toks, toks)
+float(m["loss"])
+print("MARK steps_done %.6f" % time.time(), flush=True)
+"""
+
+SERVE_SNIPPET = r"""
+import json, threading, time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import jax, jax.numpy as jnp
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads.config import get_config
+
+cfg = get_config("test")
+params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+fwd = jax.jit(lambda p, t: model_lib.forward(p, t, cfg))
+warm = jnp.zeros((1, 128), jnp.int32)
+fwd(params, warm).block_until_ready()  # compile before accepting traffic
+lock = threading.Lock()
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        t0 = time.perf_counter()
+        toks = jnp.zeros((1, 128), jnp.int32)
+        with lock:  # one chip; serialize device work
+            out = fwd(params, toks)
+            nxt = int(jnp.argmax(out[0, -1]))
+        body = json.dumps({"next_token": nxt,
+                           "device_ms": round(1e3 * (time.perf_counter() - t0), 2),
+                           "device": jax.devices()[0].device_kind}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def log_message(self, *a):
+        pass
+
+import os
+# Services bind the port the control plane assigns: DSTACK_SERVICE_PORT (equal
+# to the configured port on dedicated hosts, ephemeral on shared-host local).
+ThreadingHTTPServer(("0.0.0.0", int(os.environ.get("DSTACK_SERVICE_PORT", "8199"))), H).serve_forever()
+"""
+
+
+def start_server(workdir: str, port: int) -> tuple[subprocess.Popen, str, str]:
+    env = dict(os.environ)
+    env["HOME"] = workdir
+    env["DSTACK_TPU_SERVER_DIR"] = os.path.join(workdir, "server")
+    env["JAX_PLATFORMS"] = "cpu"  # the SERVER never needs the chip; jobs do
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dstack_tpu.cli.main", "server",
+         "--host", "127.0.0.1", "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=workdir,
+    )
+    token = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline().decode(errors="replace")
+        m = re.search(r"admin token: (\w+)", line)
+        if m:
+            token = m.group(1)
+        if "Running on" in line:
+            break
+    assert token, "server did not print a token"
+    threading_drain(proc)
+    return proc, f"http://127.0.0.1:{port}", token
+
+
+def threading_drain(proc):
+    import threading
+
+    def drain():
+        for _ in iter(proc.stdout.readline, b""):
+            pass
+
+    threading.Thread(target=drain, daemon=True).start()
+
+
+def measure_apply_latency(client, config: str, job_env: dict, extra_steps: int = 4) -> dict:
+    """Submit a task and decompose submit -> first-step into its budget."""
+    code = TRAIN_SNIPPET.replace("{config}", config).replace(
+        "{extra_steps}", str(extra_steps)
+    )
+    name = f"ns-apply-{config.replace('_', '-')}"
+    spec = {
+        "run_name": name,
+        "configuration": {
+            "type": "task",
+            "commands": [f"python3 - <<'EOF'\n{code}\nEOF"],
+            "env": job_env,
+        },
+    }
+    t0 = time.time()
+    client.runs.submit(spec)
+    transitions = {}
+    status = "submitted"
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        run = client.runs.get(name)
+        if run.status.value != status:
+            transitions[run.status.value] = time.time()
+            status = run.status.value
+        if status in ("done", "failed", "terminated"):
+            break
+        time.sleep(0.05)
+    assert status == "done", f"run ended {status}"
+    logs = client.logs.poll(name, start_line=0)
+    text = "".join(ev.message for ev in logs.logs)
+    marks = dict(re.findall(r"MARK (\w+) ([0-9.]+)", text))
+    marks = {k: float(v) for k, v in marks.items()}
+    device = (re.search(r"devices_ready [0-9.]+ (.+)", text) or [None, "unknown"])[1]
+    total = marks["step1_done"] - t0
+    out = {
+        "metric": "apply_to_first_train_step_seconds",
+        "config": config,
+        "device": device.strip(),
+        "total_s": round(total, 2),
+        "budget_s": {
+            # One clock (same host): submit -> the job's python running covers
+            # queue + scheduling + slice provision + agent spawn + code sync.
+            "orchestration_submit_to_job_python": round(marks["py_start"] - t0, 2),
+            "jax_import": round(marks["jax_imported"] - marks["py_start"], 2),
+            "device_init": round(marks["devices_ready"] - marks["jax_imported"], 2),
+            "param_init_compile": round(marks["init_done"] - marks["devices_ready"], 2),
+            "step_compile_plus_step1": round(marks["step1_done"] - marks["init_done"], 2),
+        },
+        "steady_step_s": round((marks["steps_done"] - marks["step1_done"]) / extra_steps, 3),
+    }
+    client.runs.delete([name])
+    return out
+
+
+def measure_served_model(client, url: str, token: str, n_requests: int = 200,
+                         concurrency: int = 8) -> dict:
+    import urllib.request
+
+    name = "ns-serve"
+    spec = {
+        "run_name": name,
+        "configuration": {
+            "type": "service",
+            "port": 8199,
+            "commands": [f"python3 - <<'EOF'\n{SERVE_SNIPPET}\nEOF"],
+        },
+    }
+    client.runs.submit(spec)
+    proxy = f"{url}/proxy/services/main/{name}/"
+    req = urllib.request.Request(proxy, headers={"Authorization": f"Bearer {token}"})
+    deadline = time.time() + 300
+    up = False
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                if r.status == 200:
+                    body = json.loads(r.read())
+                    up = True
+                    break
+        except Exception:
+            time.sleep(1.0)
+    assert up, "service never answered through the proxy"
+
+    def one(_):
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            r.read()
+        return time.perf_counter() - t0
+
+    for _ in range(5):  # warm the tunnel/proxy path
+        one(0)
+    t_start = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as ex:
+        lat = list(ex.map(one, range(n_requests)))
+    wall = time.perf_counter() - t_start
+    lat.sort()
+    out = {
+        "metric": "served_model_through_proxy",
+        "device": body.get("device", "unknown"),
+        "requests": n_requests,
+        "concurrency": concurrency,
+        "rps": round(n_requests / wall, 1),
+        "p50_ms": round(1e3 * lat[len(lat) // 2], 1),
+        "p95_ms": round(1e3 * lat[int(len(lat) * 0.95)], 1),
+        "device_forward_ms": body.get("device_ms"),
+    }
+    client.runs.stop([name])
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-serve", action="store_true")
+    ap.add_argument("--skip-cpu", action="store_true")
+    ap.add_argument("--port", type=int, default=39833)
+    args = ap.parse_args()
+
+    from dstack_tpu.api.client import Client
+
+    results = []
+    with tempfile.TemporaryDirectory(prefix="north-star-") as workdir:
+        proc, url, token = start_server(workdir, args.port)
+        try:
+            client = Client(url, token, "main", timeout=60.0)
+            # 1) apply -> first step on the accelerator (tiny config: the number
+            # is the ORCHESTRATION overhead; compile time is reported separately).
+            results.append(measure_apply_latency(client, "test", {"JAX_PLATFORMS": ""}))
+            print(json.dumps(results[-1]), flush=True)
+            # Warm pool: the slice from the first run is idle and gets reused,
+            # isolating the scheduler+agent path from cloud provisioning.
+            warm = measure_apply_latency(client, "test", {"JAX_PLATFORMS": ""})
+            warm["metric"] = "apply_to_first_train_step_seconds_warm_pool"
+            results.append(warm)
+            print(json.dumps(results[-1]), flush=True)
+            if not args.skip_cpu:
+                # 2) GPT-2-124M single-node CPU task (north-star row 3).
+                # Genuine CPU: JAX_PLATFORMS=cpu, and PALLAS_AXON_POOL_IPS
+                # cleared so a TPU-relay sitecustomize (if present) cannot pin
+                # the accelerator backend under the job.
+                results.append(
+                    measure_apply_latency(
+                        client,
+                        "gpt2_125m",
+                        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+                        extra_steps=2,
+                    )
+                )
+                print(json.dumps(results[-1]), flush=True)
+            if not args.skip_serve:
+                # 3) model served through the in-server proxy (north-star row 5).
+                results.append(measure_served_model(client, url, token))
+                print(json.dumps(results[-1]), flush=True)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    print(json.dumps({"summary": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@
 
 #include <algorithm>
 #include <cstring>
+#include <map>
 #include <ctime>
 #include <fstream>
 #include <sstream>
@@ -717,9 +718,21 @@ void Executor::exec_host(uint64_t generation) {
     if (workdir[0] != '/') workdir = repo_dir + "/" + workdir;
   }
 
+  // Deduplicate with JOB-env precedence: getenv takes the FIRST matching entry,
+  // so naively appending the job env after the inherited environ would make a
+  // user's `env:` overrides silently lose to whatever the host agent inherited.
   std::vector<std::string> env_strings;
-  for (char** e = environ; *e; ++e) env_strings.push_back(*e);
-  for (auto& kv : job_env(repo_dir)) env_strings.push_back(kv);
+  {
+    std::map<std::string, std::string> merged;
+    auto put = [&merged](const std::string& kv) {
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) return;
+      merged[kv.substr(0, eq)] = kv.substr(eq + 1);
+    };
+    for (char** e = environ; *e; ++e) put(*e);
+    for (auto& kv : job_env(repo_dir)) put(kv);
+    for (auto& kv : merged) env_strings.push_back(kv.first + "=" + kv.second);
+  }
 
   // Manual openpty+fork instead of forkpty: glibc's forkpty child _exit(1)s when
   // TIOCSCTTY fails, which happens when the kernel recycles a pty index that is still

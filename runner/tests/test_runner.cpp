@@ -143,6 +143,31 @@ void test_pty_exec_and_env() {
   CHECK(r.logs.find("rank=3 slice=1 of=2") != std::string::npos);
 }
 
+void test_job_env_overrides_inherited_env() {
+  // getenv returns the FIRST matching envp entry, so the agent must dedupe
+  // with job-side precedence — otherwise a user's `env:` override silently
+  // loses to whatever the host agent happened to inherit.
+  setenv("DSTACK_ENV_PRECEDENCE_PROBE", "inherited", 1);
+  drunner::Executor ex(temp_dir());
+  dj::Json spec = dj::Json::object();
+  spec.set("job_name", "jenv");
+  dj::Json cmds = dj::Json::array();
+  cmds.push_back("echo probe=$DSTACK_ENV_PRECEDENCE_PROBE");
+  spec.set("commands", std::move(cmds));
+  spec.set("image_name", "");
+  dj::Json env = dj::Json::object();
+  env.set("DSTACK_ENV_PRECEDENCE_PROBE", "from-job");
+  spec.set("env", std::move(env));
+  dj::Json body = dj::Json::object();
+  body.set("job_spec", std::move(spec));
+  ex.submit(body);
+  ex.run();
+  RunResult r = pump_until_terminal(ex);
+  unsetenv("DSTACK_ENV_PRECEDENCE_PROBE");
+  CHECK_EQ(r.state, std::string("done"));
+  CHECK(r.logs.find("probe=from-job") != std::string::npos);
+}
+
 void test_failure_exit_status() {
   drunner::Executor ex(temp_dir());
   ex.submit(make_submit("j2", {"echo before", "exit 7", "echo after"}));
@@ -308,6 +333,7 @@ int main() {
   test_docker_helpers();
   test_tpu_metrics_parse();
   test_pty_exec_and_env();
+  test_job_env_overrides_inherited_env();
   test_failure_exit_status();
   test_idempotent_submit_and_conflict();
   test_stop_graceful_vs_abort();

@@ -1,0 +1,100 @@
+"""Admin SPA (server/statics): serving + API-contract parity.
+
+The SPA is build-less ES modules (no node toolchain in CI), so these tests pin
+the contract statically: every endpoint the JS calls must be a registered
+route, and the shell/assets must serve. Parity: the reference serves its React
+SPA from server statics (ref: src/dstack/_internal/server/app.py:292-295)."""
+
+import re
+from pathlib import Path
+
+from tests.common import api_server
+
+STATICS = Path(__file__).parent.parent / "dstack_tpu" / "server" / "statics"
+
+
+def spa_api_paths():
+    src = (STATICS / "app.js").read_text()
+    # api("/api/...") and api(`/api/...${...}`) call sites.
+    paths = set()
+    for m in re.finditer(r"""api\((?:"([^"]+)"|`([^`]+)`)""", src):
+        path = m.group(1) or m.group(2)
+        path = path.replace("${P()}", "{project_name}")
+        if "${" in path:  # run-name etc. interpolations aren't route segments
+            path = re.sub(r"\$\{[^}]+\}", "X", path)
+        paths.add(path)
+    return paths
+
+
+class TestSpaContract:
+    def test_spa_calls_only_registered_routes(self):
+        from dstack_tpu.server.app import create_app
+
+        app = create_app(db_path=":memory:", run_background_tasks=False)
+        registered = {r.resource.canonical for r in app.router.routes() if r.resource}
+        paths = spa_api_paths()
+        assert len(paths) >= 20, f"path extraction broke: {sorted(paths)}"
+        missing = sorted(p for p in paths if p not in registered)
+        assert not missing, f"SPA calls unregistered endpoints: {missing}"
+
+    def test_assets_exist_and_reference_each_other(self):
+        html = (STATICS / "index.html").read_text()
+        assert "/statics/app.js" in html and "/statics/style.css" in html
+        js = (STATICS / "app.js").read_text()
+        # Every resource surface has a view (VERDICT: "every REST resource a page").
+        for view in ("viewRuns", "viewRunDetail", "viewFleets", "viewFleetDetail",
+                     "viewInstances", "viewVolumes", "viewGateways", "viewOffers",
+                     "viewSecrets", "viewProjects", "viewUsers", "viewLogin"):
+            assert f"async function {view}" in js, f"missing {view}"
+        # Live log tail + metrics sparklines are wired.
+        assert "logs/poll" in js and "metrics/job" in js and "sparkline" in js
+
+    async def test_shell_and_assets_served(self):
+        async with api_server() as api:
+            resp = await api.client.get("/")
+            assert resp.status == 200
+            assert "app.js" in await resp.text()
+            resp = await api.client.get("/statics/app.js")
+            assert resp.status == 200
+            assert "javascript" in resp.content_type
+            resp = await api.client.get("/statics/style.css")
+            assert resp.status == 200
+
+    def test_js_brackets_balanced(self):
+        """No JS runtime ships in this image; a string/comment-aware bracket
+        balance check catches the truncation/paste class of syntax errors."""
+        src = (STATICS / "app.js").read_text()
+        stack = []
+        pairs = {")": "(", "]": "[", "}": "{"}
+        i, n, mode = 0, len(src), None
+        while i < n:
+            c = src[i]
+            if c == "\n" and mode == "//":
+                mode = None
+            if mode is None:
+                if c in "'\"`":
+                    mode = c
+                elif src[i : i + 2] == "//":
+                    mode, i = "//", i + 1
+                elif src[i : i + 2] == "/*":
+                    mode, i = "/*", i + 1
+                elif c in "([{":
+                    stack.append(c)
+                elif c in ")]}":
+                    assert stack and stack[-1] == pairs[c], f"bracket mismatch at byte {i}"
+                    stack.pop()
+            elif mode in "'\"`":
+                if c == "\\":
+                    i += 1
+                elif c == mode:
+                    mode = None
+                elif mode == "`" and src[i : i + 2] == "${":
+                    depth, i = 1, i + 2
+                    while i < n and depth:
+                        depth += {"{": 1, "}": -1}.get(src[i], 0)
+                        i += 1
+                    continue
+            elif mode == "/*" and src[i : i + 2] == "*/":
+                mode, i = None, i + 1
+            i += 1
+        assert not stack and mode is None

@@ -1,0 +1,348 @@
+"""Gateway TLS: SNI cert store + ACME http-01 issuance against a fake ACME CA.
+
+The fake server implements enough of RFC 8555 to exercise the real client:
+JWS-posted account/order/challenge flow, http-01 validation performed by
+actually fetching /.well-known/acme-challenge/ from the gateway's HTTP app,
+CSR-based finalize signed by an in-test CA. Done = a service registered with a
+domain gets a cert and the HTTPS listener serves it under SNI (VERDICT #6)."""
+
+import asyncio
+import base64
+import datetime
+import hashlib
+import json
+import socket
+import ssl
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from dstack_tpu.gateway.app import create_app
+from dstack_tpu.gateway.tls import CertStore, self_signed_cert
+from dstack_tpu.gateway.tls_manager import TlsManager
+
+
+def _b64u_decode(s):
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class TestCa:
+    """In-test CA that signs CSRs (what the fake ACME finalize uses)."""
+
+    def __init__(self):
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+
+        self.key = ec.generate_private_key(ec.SECP256R1())
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "fake-acme-ca")])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self.cert = (
+            x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(self.key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=30))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+            .sign(self.key, hashes.SHA256())
+        )
+        self.ca_pem = self.cert.public_bytes(serialization.Encoding.PEM).decode()
+
+    def sign_csr(self, csr_der: bytes) -> str:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+
+        csr = x509.load_der_x509_csr(csr_der)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(csr.subject)
+            .issuer_name(self.cert.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=30))
+            .add_extension(
+                csr.extensions.get_extension_for_class(x509.SubjectAlternativeName).value,
+                critical=False,
+            )
+            .sign(self.key, hashes.SHA256())
+        )
+        return cert.public_bytes(serialization.Encoding.PEM).decode() + self.ca_pem
+
+
+class FakeAcme:
+    """Enough of RFC 8555 for the client: nonces, JWS parsing (signatures are
+    not verified — the protocol flow is what's under test), http-01 validation
+    against the real gateway HTTP port."""
+
+    def __init__(self, ca: TestCa, challenge_host: str):
+        self.ca = ca
+        self.challenge_host = challenge_host  # host:port serving the gateway app
+        self.base = ""
+        self.jwk = None
+        self.order_status = "pending"
+        self.authz_status = "pending"
+        self.cert_pem = None
+        self.validated_tokens = []
+
+    def thumbprint(self):
+        canonical = json.dumps(self.jwk, separators=(",", ":"), sort_keys=True)
+        return base64.urlsafe_b64encode(
+            hashlib.sha256(canonical.encode()).digest()
+        ).rstrip(b"=").decode()
+
+    def app(self):
+        app = web.Application()
+
+        def nonce_headers():
+            return {"Replay-Nonce": "nonce-" + hashlib.sha1(str(id(self)).encode()).hexdigest()[:8]}
+
+        async def directory(request):
+            return web.json_response({
+                "newNonce": f"{self.base}/new-nonce",
+                "newAccount": f"{self.base}/new-account",
+                "newOrder": f"{self.base}/new-order",
+            })
+
+        async def new_nonce(request):
+            return web.Response(status=200, headers=nonce_headers())
+
+        def parse_jws(body):
+            jws = json.loads(body)
+            protected = json.loads(_b64u_decode(jws["protected"]))
+            payload = jws["payload"]
+            return protected, json.loads(_b64u_decode(payload)) if payload else None
+
+        async def new_account(request):
+            protected, _ = parse_jws(await request.read())
+            self.jwk = protected["jwk"]
+            return web.json_response(
+                {"status": "valid"}, status=201,
+                headers={**nonce_headers(), "Location": f"{self.base}/acct/1"},
+            )
+
+        async def new_order(request):
+            _, payload = parse_jws(await request.read())
+            assert payload["identifiers"][0]["value"] == "svc.test"
+            return web.json_response(
+                {
+                    "status": "pending",
+                    "authorizations": [f"{self.base}/authz/1"],
+                    "finalize": f"{self.base}/finalize/1",
+                },
+                status=201,
+                headers={**nonce_headers(), "Location": f"{self.base}/order/1"},
+            )
+
+        async def authz(request):
+            return web.json_response(
+                {
+                    "status": self.authz_status,
+                    "challenges": [
+                        {"type": "dns-01", "token": "unused", "url": f"{self.base}/chall/0"},
+                        {"type": "http-01", "token": "tok-123", "url": f"{self.base}/chall/1"},
+                    ],
+                },
+                headers=nonce_headers(),
+            )
+
+        async def chall(request):
+            # Validate over the wire like a real CA: fetch the challenge body
+            # from the gateway's HTTP app.
+            import urllib.request
+
+            url = f"http://{self.challenge_host}/.well-known/acme-challenge/tok-123"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                body = resp.read().decode()
+            expected = f"tok-123.{self.thumbprint()}"
+            if body == expected:
+                self.authz_status = "valid"
+                self.validated_tokens.append("tok-123")
+            else:
+                self.authz_status = "invalid"
+            return web.json_response({"status": self.authz_status}, headers=nonce_headers())
+
+        async def finalize(request):
+            _, payload = parse_jws(await request.read())
+            assert self.authz_status == "valid", "finalize before authz valid"
+            self.cert_pem = self.ca.sign_csr(_b64u_decode(payload["csr"]))
+            self.order_status = "valid"
+            return web.json_response(
+                {"status": "valid", "certificate": f"{self.base}/cert/1"},
+                headers=nonce_headers(),
+            )
+
+        async def cert(request):
+            return web.Response(body=self.cert_pem.encode(), headers=nonce_headers())
+
+        app.router.add_get("/directory", directory)
+        app.router.add_route("HEAD", "/new-nonce", new_nonce)
+        app.router.add_post("/new-account", new_account)
+        app.router.add_post("/new-order", new_order)
+        app.router.add_post("/authz/1", authz)
+        app.router.add_post("/chall/1", chall)
+        app.router.add_post("/finalize/1", finalize)
+        app.router.add_post("/cert/1", cert)
+        return app
+
+
+def _tls_get(port: int, server_name: str, path: str, ca_pem: str = None) -> tuple:
+    """Raw TLS GET with SNI; returns (status_line, body, peer_cn)."""
+    from cryptography import x509
+
+    if ca_pem:
+        ctx = ssl.create_default_context(cadata=ca_pem)
+    else:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    tls = ctx.wrap_socket(sock, server_hostname=server_name)
+    der = tls.getpeercert(binary_form=True)
+    cn = x509.load_der_x509_certificate(der).subject.rfc4514_string()
+    tls.sendall(
+        f"GET {path} HTTP/1.1\r\nHost: {server_name}\r\nConnection: close\r\n\r\n".encode()
+    )
+    data = b""
+    while True:
+        chunk = tls.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    tls.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return head.split(b"\r\n")[0].decode(), body.decode(errors="replace"), cn
+
+
+class TestSniStore:
+    def test_per_domain_certs_served_by_sni(self, tmp_path):
+        store = CertStore(str(tmp_path))
+        for dom in ("a.test", "b.test"):
+            chain, key = self_signed_cert(dom)
+            store.put(dom, chain, key)
+        assert store.domains() == ["a.test", "b.test"]
+        assert store.has("A.TEST")
+
+        async def run():
+            app = web.Application()
+
+            async def hello(request):
+                return web.Response(text="hi")
+
+            app.router.add_get("/{tail:.*}", hello)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0, ssl_context=store.server_context())
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                for dom in ("a.test", "b.test"):
+                    status, _, cn = await asyncio.to_thread(_tls_get, port, dom, "/")
+                    assert "200" in status
+                    assert cn == f"CN={dom}"
+                # Unknown SNI gets the placeholder, not a handshake failure.
+                status, _, cn = await asyncio.to_thread(_tls_get, port, "other.test", "/")
+                assert "placeholder" not in cn or cn  # handshake completed
+            finally:
+                await runner.cleanup()
+
+        asyncio.run(run())
+
+
+class TestAcmeEndToEnd:
+    async def test_domain_service_gets_cert_and_serves_tls(self, tmp_path):
+        ca = TestCa()
+
+        # A tiny upstream replica the domain routes to.
+        upstream = web.Application()
+
+        async def pong(request):
+            return web.json_response({"via": "replica", "path": request.path})
+
+        upstream.router.add_get("/{tail:.*}", pong)
+        upstream_server = TestServer(upstream)
+        await upstream_server.start_server()
+
+        # Gateway HTTP app with a TLS manager pointing at the fake ACME.
+        fake = FakeAcme(ca, challenge_host="")
+        acme_server = TestServer(fake.app())
+        await acme_server.start_server()
+        fake.base = f"http://127.0.0.1:{acme_server.port}"
+
+        tm = TlsManager(str(tmp_path), acme_directory=f"{fake.base}/directory")
+        gw_app = create_app("gw-token", tls_manager=tm)
+        gw_server = TestServer(gw_app)
+        await gw_server.start_server()
+        fake.challenge_host = f"127.0.0.1:{gw_server.port}"
+
+        try:
+            # Register a service with a domain via the control API.
+            resp = await gw_server.session if False else None
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                r = await session.post(
+                    f"http://127.0.0.1:{gw_server.port}/api/registry/register",
+                    json={
+                        "project": "main", "run_name": "svc", "domain": "svc.test",
+                        "replicas": [{"host": "127.0.0.1", "port": upstream_server.port}],
+                    },
+                    headers={"Authorization": "Bearer gw-token"},
+                )
+                assert r.status == 200
+
+            # Issuance kicked off in the background; wait for the store.
+            for _ in range(100):
+                if tm.store.has("svc.test"):
+                    break
+                await asyncio.sleep(0.1)
+            assert tm.store.has("svc.test"), "ACME issuance never completed"
+            assert fake.validated_tokens == ["tok-123"]  # validated over HTTP
+
+            # HTTPS listener serves the CA-signed cert under SNI and routes by
+            # domain to the replica.
+            runner = web.AppRunner(gw_app)
+            await runner.setup()
+            tls_site = web.TCPSite(runner, "127.0.0.1", 0, ssl_context=tm.server_context())
+            await tls_site.start()
+            tls_port = tls_site._server.sockets[0].getsockname()[1]
+            try:
+                status, body, cn = await asyncio.to_thread(
+                    _tls_get, tls_port, "svc.test", "/ping", ca.ca_pem
+                )
+                assert "200" in status
+                assert cn == "CN=svc.test"
+                assert '"via": "replica"' in body
+            finally:
+                await runner.cleanup()
+        finally:
+            await gw_server.close()
+            await acme_server.close()
+            await upstream_server.close()
+
+    async def test_issuance_failure_does_not_break_registration(self, tmp_path):
+        """A dead ACME endpoint must not fail service registration — the
+        appliance keeps serving HTTP and logs the issuance failure."""
+        tm = TlsManager(str(tmp_path), acme_directory="http://127.0.0.1:1/directory")
+        gw_app = create_app("gw-token", tls_manager=tm)
+        gw_server = TestServer(gw_app)
+        await gw_server.start_server()
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                r = await session.post(
+                    f"http://127.0.0.1:{gw_server.port}/api/registry/register",
+                    json={"project": "main", "run_name": "s2", "domain": "dead.test",
+                          "replicas": []},
+                    headers={"Authorization": "Bearer gw-token"},
+                )
+                assert r.status == 200
+            await asyncio.sleep(0.3)
+            assert not tm.store.has("dead.test")
+        finally:
+            await gw_server.close()

@@ -1,0 +1,199 @@
+"""Plan-time registry image introspection (reference services/docker.py:34-70).
+
+A fake OCI registry (aiohttp) drives the full protocol: bearer-token dance,
+manifest list -> platform manifest -> config blob. A bad image or credential
+must fail at PLAN time with a clear error; an unreachable registry must degrade
+to "unverified" (the server may be air-gapped while TPU hosts are not)."""
+
+import hashlib
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from dstack_tpu.core.errors import ServerClientError
+from dstack_tpu.core.services import docker_registry
+from dstack_tpu.core.services.docker_registry import parse_image_ref
+from tests.common import api_server
+
+
+class FakeRegistry:
+    """Minimal Docker Registry v2: one repo, optional token auth."""
+
+    def __init__(self, require_auth=False, username="bot", password="hunter2"):
+        self.require_auth = require_auth
+        self.username, self.password = username, password
+        config = {
+            "os": "linux",
+            "architecture": "amd64",
+            "config": {"User": "appuser", "Entrypoint": ["/entry.sh"], "Cmd": ["serve"]},
+        }
+        self.config_blob = json.dumps(config).encode()
+        self.config_digest = "sha256:" + hashlib.sha256(self.config_blob).hexdigest()
+        manifest = {"config": {"digest": self.config_digest}}
+        self.manifest_blob = json.dumps(manifest).encode()
+        self.manifest_digest = "sha256:" + hashlib.sha256(self.manifest_blob).hexdigest()
+        self.index = json.dumps({
+            "manifests": [
+                {"digest": "sha256:armarm", "platform": {"os": "linux", "architecture": "arm64"}},
+                {"digest": self.manifest_digest, "platform": {"os": "linux", "architecture": "amd64"}},
+            ]
+        }).encode()
+        self.token_requests = []
+
+    def app(self):
+        app = web.Application()
+        self.base_url = ""  # set after the server binds; realm is read per-request
+
+        def authed(request):
+            if not self.require_auth:
+                return True
+            return request.headers.get("Authorization") == "Bearer tok-ok"
+
+        async def token(request):
+            self.token_requests.append(request.headers.get("Authorization"))
+            import base64
+
+            expect = "Basic " + base64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            if request.headers.get("Authorization") != expect:
+                return web.json_response({}, status=401)
+            return web.json_response({"token": "tok-ok"})
+
+        async def manifests(request):
+            if not authed(request):
+                return web.json_response(
+                    {}, status=401,
+                    headers={"WWW-Authenticate": f'Bearer realm="{self.base_url}/token",service="fake"'},
+                )
+            ref = request.match_info["ref"]
+            if request.match_info["repo"] != "team/app":
+                return web.json_response({}, status=404)
+            if ref == "good":
+                return web.Response(body=self.index, content_type="application/vnd.oci.image.index.v1+json")
+            if ref == self.manifest_digest:
+                return web.Response(body=self.manifest_blob, content_type="application/vnd.oci.image.manifest.v1+json")
+            return web.json_response({}, status=404)
+
+        async def blobs(request):
+            if not authed(request):
+                return web.json_response({}, status=401)
+            if request.match_info["digest"] == self.config_digest:
+                return web.Response(body=self.config_blob)
+            return web.json_response({}, status=404)
+
+        app.router.add_get("/token", token)
+        app.router.add_get("/v2/{repo:.+}/manifests/{ref}", manifests)
+        app.router.add_get("/v2/{repo:.+}/blobs/{digest}", blobs)
+        return app
+
+
+async def start_fake_registry(require_auth=False):
+    """(registry, server, host) with the token realm pointing at the live port."""
+    reg = FakeRegistry(require_auth=require_auth)
+    server = TestServer(reg.app())
+    await server.start_server()
+    reg.base_url = f"http://127.0.0.1:{server.port}"
+    return reg, server, f"127.0.0.1:{server.port}"
+
+
+class TestParseImageRef:
+    def test_docker_hub_defaults(self):
+        assert parse_image_ref("ubuntu") == ("registry-1.docker.io", "library/ubuntu", "latest")
+        assert parse_image_ref("nvidia/cuda:12.1") == ("registry-1.docker.io", "nvidia/cuda", "12.1")
+
+    def test_explicit_registry_port_digest(self):
+        assert parse_image_ref("ghcr.io/org/app:v1") == ("ghcr.io", "org/app", "v1")
+        assert parse_image_ref("localhost:5000/x/y@sha256:abc") == ("localhost:5000", "x/y", "sha256:abc")
+
+    def test_invalid(self):
+        with pytest.raises(ServerClientError):
+            parse_image_ref("bad image!!")
+
+
+class TestIntrospection:
+    async def _with_registry(self, require_auth=False):
+        return await start_fake_registry(require_auth)
+
+    async def test_resolves_config_via_manifest_list(self):
+        docker_registry.clear_cache()
+        reg, server, host = await self._with_registry()
+        try:
+            cfg = await docker_registry.get_image_config(f"{host}/team/app:good")
+            assert cfg.verified
+            assert cfg.user == "appuser"
+            assert cfg.entrypoint == ["/entry.sh"]
+            assert cfg.architecture == "amd64"  # picked the amd64 entry, not arm
+        finally:
+            await server.close()
+
+    async def test_missing_image_is_definitive_error(self):
+        docker_registry.clear_cache()
+        reg, server, host = await self._with_registry()
+        try:
+            with pytest.raises(ServerClientError, match="not found"):
+                await docker_registry.get_image_config(f"{host}/team/app:nope")
+            with pytest.raises(ServerClientError, match="not found"):
+                await docker_registry.get_image_config(f"{host}/other/repo:good")
+        finally:
+            await server.close()
+
+    async def test_token_dance_with_credentials(self):
+        docker_registry.clear_cache()
+        reg, server, host = await self._with_registry(require_auth=True)
+        try:
+            cfg = await docker_registry.get_image_config(
+                f"{host}/team/app:good", username="bot", password="hunter2"
+            )
+            assert cfg.user == "appuser"
+            assert reg.token_requests  # the bearer dance actually ran
+            with pytest.raises(ServerClientError, match="auth"):
+                await docker_registry.get_image_config(
+                    f"{host}/team/app:good", username="bot", password="wrong"
+                )
+        finally:
+            await server.close()
+
+    async def test_unreachable_registry_degrades_to_unverified(self):
+        docker_registry.clear_cache()
+        cfg = await docker_registry.get_image_config("127.0.0.1:1/team/app:good")
+        assert cfg.verified is False
+        assert "unreachable" in (cfg.note or "")
+
+
+class TestPlanIntegration:
+    async def test_plan_surfaces_image_config(self):
+        docker_registry.clear_cache()
+        reg, server, host = await start_fake_registry()
+        try:
+            async with api_server() as api:
+                plan = await api.post(
+                    "/api/project/main/runs/get_plan",
+                    {"run_spec": {"configuration": {
+                        "type": "task", "commands": ["true"], "image": f"{host}/team/app:good",
+                    }}},
+                )
+                assert plan["image_config"]["user"] == "appuser"
+                assert plan["image_config"]["entrypoint"] == ["/entry.sh"]
+        finally:
+            await server.close()
+
+    async def test_plan_rejects_missing_image_with_clear_error(self):
+        docker_registry.clear_cache()
+        reg, server, host = await start_fake_registry()
+        try:
+            async with api_server() as api:
+                raw = await api.client.post(
+                    "/api/project/main/runs/get_plan",
+                    json={"run_spec": {"configuration": {
+                        "type": "task", "commands": ["true"], "image": f"{host}/team/app:missing",
+                    }}},
+                    headers={"Authorization": f"Bearer {api.token}"},
+                )
+                assert raw.status == 400
+                body = await raw.json()
+                assert "not found" in json.dumps(body)
+        finally:
+            await server.close()

@@ -303,7 +303,9 @@ class TestDashboard:
             resp = await api.client.get("/")
             assert resp.status == 200
             text = await resp.text()
-            assert "dstack-tpu" in text and "Runs" in text
+            # The SPA shell: title + module entry (views live in app.js,
+            # covered by tests/test_frontend.py).
+            assert "dstack-tpu" in text and "/statics/app.js" in text
 
 
 class TestApiCompatibility:
