@@ -112,7 +112,13 @@ def _fetch_token(challenge: dict, username: Optional[str], password: Optional[st
             + (" — check registry_auth credentials" if username else "")
         )
     data = json.loads(body)
-    return data.get("token") or data.get("access_token")
+    token = data.get("token") or data.get("access_token")
+    if not token:
+        # A 200 with no token is a malformed token endpoint, not bad creds.
+        raise ServerClientError(
+            "registry token endpoint returned no token (malformed response)"
+        )
+    return token
 
 
 def _get_with_auth(url: str, accept: str, auth_state: dict) -> Tuple[int, dict, bytes]:
@@ -145,11 +151,17 @@ def get_image_config_sync(
     base = f"{_scheme(registry, insecure)}://{registry}/v2/{repo}"
     auth: dict = {"username": username, "password": password}
     try:
-        status, hdrs, body = _get_with_auth(f"{base}/manifests/{ref}", MANIFEST_ACCEPT, auth)
+        return _introspect(image, base, ref, auth)
     except (OSError, urllib.error.URLError) as e:
-        # Unreachable registry is NOT a bad image: the server may be air-gapped
-        # while the TPU hosts are not. Degrade to unverified.
+        # Unreachable network is NOT a bad image: the server may be air-gapped
+        # while the TPU hosts are not. This covers ALL hops — manifest, index
+        # re-fetch, and the config blob (often a different CDN host than the
+        # registry itself). Degrade to unverified.
         return ImageConfig(image=image, verified=False, note=f"registry unreachable: {e}")
+
+
+def _introspect(image: str, base: str, ref: str, auth: dict) -> ImageConfig:
+    status, hdrs, body = _get_with_auth(f"{base}/manifests/{ref}", MANIFEST_ACCEPT, auth)
     if status in (401, 403):
         raise ServerClientError(
             f"not authorized to pull {image} (HTTP {status}) — check registry_auth"
@@ -209,11 +221,20 @@ async def get_image_config(
     )
 
 
-# (image, username) -> (monotonic_deadline, ImageConfig | ServerClientError).
+# cache key -> (monotonic_deadline, ImageConfig | ServerClientError).
 # Keeps repeated plans fast and avoids hammering registries; definitive errors
-# are cached too (a missing tag stays missing for the TTL).
+# are cached too (a missing tag stays missing for the TTL). The key includes a
+# password digest + the insecure flag so that fixing a credential takes effect
+# immediately instead of replaying a cached auth failure for the TTL.
 _cache: dict = {}
 _CACHE_TTL = 300.0
+
+
+def _cache_key(image, username, password, insecure):
+    import hashlib
+
+    pw_digest = hashlib.sha256((password or "").encode()).hexdigest()[:16]
+    return (image, username, pw_digest, insecure)
 
 
 async def get_image_config_cached(
@@ -224,7 +245,7 @@ async def get_image_config_cached(
 ) -> ImageConfig:
     import time
 
-    key = (image, username)
+    key = _cache_key(image, username, password, insecure)
     hit = _cache.get(key)
     if hit and hit[0] > time.monotonic():
         if isinstance(hit[1], Exception):

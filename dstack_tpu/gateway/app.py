@@ -264,6 +264,10 @@ async def serve(
         await tls_site.start()
         tls_actual = tls_site._server.sockets[0].getsockname()[1]
         print(f"dstack-tpu-gateway tls on {host}:{tls_actual}", flush=True)
+    if tls_manager is not None:
+        # Renewal runs even without a TLS listener: issued certs may be
+        # consumed from --certs-dir by an external terminator.
+        tls_manager.start_renewal()
     while True:
         await asyncio.sleep(3600)
 

@@ -51,6 +51,8 @@ class CertStore:
 
     def _load_all(self) -> None:
         for name in os.listdir(self.certs_dir):
+            if name.startswith("."):  # .placeholder, dotfiles — not domains
+                continue
             full = os.path.join(self.certs_dir, name, "fullchain.pem")
             key = os.path.join(self.certs_dir, name, "privkey.pem")
             if os.path.exists(full) and os.path.exists(key):
@@ -65,7 +67,11 @@ class CertStore:
         ctx.load_cert_chain(fullchain, privkey)
         return ctx
 
-    def put(self, domain: str, fullchain_pem: str, privkey_pem: str) -> None:
+    def put(self, domain: str, fullchain_pem: str, privkey_pem: str,
+            managed: bool = False) -> None:
+        """``managed=True`` marks the cert as ACME-issued (renewable); without
+        it the cert is operator-provisioned and the renewal sweep must never
+        touch it (the reference's `certificate` passthrough)."""
         d = self._domain_dir(domain)
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, "fullchain.pem"), "w") as f:
@@ -74,13 +80,41 @@ class CertStore:
         fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
             f.write(privkey_pem)
+        marker = os.path.join(d, "acme-managed")
+        if managed:
+            with open(marker, "w") as f:
+                f.write("issued by the gateway's ACME client\n")
+        elif os.path.exists(marker):
+            os.unlink(marker)  # operator override takes the domain back
         with self._lock:
             self._contexts[domain.lower()] = self._make_ctx(
                 os.path.join(d, "fullchain.pem"), key_path
             )
 
+    def is_managed(self, domain: str) -> bool:
+        return os.path.exists(os.path.join(self._domain_dir(domain), "acme-managed"))
+
     def has(self, domain: str) -> bool:
         return domain.lower() in self._contexts
+
+    def expiry(self, domain: str) -> Optional[datetime.datetime]:
+        """not_valid_after of the stored leaf certificate (UTC), or None."""
+        from cryptography import x509
+
+        path = os.path.join(self._domain_dir(domain), "fullchain.pem")
+        try:
+            with open(path, "rb") as f:
+                pem = f.read()
+        except OSError:
+            return None
+        try:
+            cert = x509.load_pem_x509_certificate(pem)
+        except ValueError:
+            return None
+        exp = getattr(cert, "not_valid_after_utc", None)
+        if exp is None:  # older cryptography: naive UTC datetime
+            exp = cert.not_valid_after.replace(tzinfo=datetime.timezone.utc)
+        return exp
 
     def domains(self):
         return sorted(self._contexts)
@@ -167,6 +201,9 @@ class AcmeClient:
         unpublish: Callable[[str], None],
         contact: Optional[str] = None,
         timeout: float = 10.0,
+        account_path: Optional[str] = None,
+        poll_interval: float = 0.5,
+        poll_tries: int = 30,
     ) -> None:
         from cryptography.hazmat.primitives.asymmetric import ec
 
@@ -175,10 +212,60 @@ class AcmeClient:
         self.unpublish = unpublish
         self.contact = contact
         self.timeout = timeout
-        self.account_key = ec.generate_private_key(ec.SECP256R1())
+        self.account_path = account_path
+        self.poll_interval = poll_interval
+        self.poll_tries = poll_tries
+        self.account_key = None
         self.kid: Optional[str] = None
         self._nonce: Optional[str] = None
         self._dir: Optional[dict] = None
+        # obtain() mutates _nonce/kid/_dir; issuances for different domains may
+        # be kicked off from concurrent registrations, so serialize them.
+        self._op_lock = threading.Lock()
+        if account_path and os.path.exists(account_path):
+            self._load_account()
+        if self.account_key is None:
+            self.account_key = ec.generate_private_key(ec.SECP256R1())
+
+    def _load_account(self) -> None:
+        from cryptography.hazmat.primitives import serialization
+
+        try:
+            with open(self.account_path) as f:
+                data = json.load(f)
+            if data.get("directory_url") != self.directory_url:
+                # The kid belongs to a different CA (e.g. staging -> prod
+                # switch); replaying it gets accountDoesNotExist forever.
+                logger.info("ACME directory changed (%s -> %s); registering anew",
+                            data.get("directory_url"), self.directory_url)
+                return
+            self.account_key = serialization.load_pem_private_key(
+                data["key_pem"].encode(), password=None
+            )
+            self.kid = data.get("kid")
+        except (OSError, ValueError, KeyError, TypeError):
+            logger.exception("unreadable ACME account file %s; re-registering",
+                             self.account_path)
+            self.account_key = None
+            self.kid = None
+
+    def _save_account(self) -> None:
+        """Persist the account key + kid so restarts reuse the registration
+        (RFC 8555 accounts are long-lived; re-registering per process hits CA
+        rate limits and loses authorization caching)."""
+        if not self.account_path:
+            return
+        from cryptography.hazmat.primitives import serialization
+
+        key_pem = self.account_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ).decode()
+        fd = os.open(self.account_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump({"key_pem": key_pem, "kid": self.kid,
+                       "directory_url": self.directory_url}, f)
 
     # -- low-level JOSE/HTTP plumbing ------------------------------------
 
@@ -239,27 +326,46 @@ class AcmeClient:
         return nonce
 
     def _post(self, url: str, payload: Optional[dict]) -> Tuple[int, dict, bytes]:
-        protected: dict = {"alg": "ES256", "nonce": self._fresh_nonce(), "url": url}
-        if self.kid:
-            protected["kid"] = self.kid
-        else:
-            protected["jwk"] = self._jwk()
-        protected_b64 = _b64u(json.dumps(protected).encode())
-        payload_b64 = "" if payload is None else _b64u(json.dumps(payload).encode())
-        jws = {
-            "protected": protected_b64,
-            "payload": payload_b64,
-            "signature": self._sign(protected_b64, payload_b64),
-        }
-        return self._http(
-            "POST", url, json.dumps(jws).encode(),
-            {"Content-Type": "application/jose+json"},
-        )
+        # RFC 8555 §6.5: on urn:ietf:params:acme:error:badNonce the server
+        # includes a fresh Replay-Nonce and the client SHOULD retry the request
+        # with it (_http already captured it). Last attempt returns whatever
+        # the server said.
+        last_attempt = 2
+        for attempt in range(last_attempt + 1):
+            protected: dict = {"alg": "ES256", "nonce": self._fresh_nonce(), "url": url}
+            if self.kid:
+                protected["kid"] = self.kid
+            else:
+                protected["jwk"] = self._jwk()
+            protected_b64 = _b64u(json.dumps(protected).encode())
+            payload_b64 = "" if payload is None else _b64u(json.dumps(payload).encode())
+            jws = {
+                "protected": protected_b64,
+                "payload": payload_b64,
+                "signature": self._sign(protected_b64, payload_b64),
+            }
+            status, hdrs, body = self._http(
+                "POST", url, json.dumps(jws).encode(),
+                {"Content-Type": "application/jose+json"},
+            )
+            if status == 400 and attempt < last_attempt:
+                try:
+                    err_type = json.loads(body).get("type")
+                except ValueError:
+                    err_type = None
+                if err_type == "urn:ietf:params:acme:error:badNonce":
+                    logger.info("badNonce from %s; retrying with fresh nonce", url)
+                    continue
+            return status, hdrs, body
 
     # -- the issuance flow ------------------------------------------------
 
     def obtain(self, domain: str) -> Tuple[str, str]:
         """Blocking issuance: returns (fullchain_pem, privkey_pem)."""
+        with self._op_lock:
+            return self._obtain_locked(domain)
+
+    def _obtain_locked(self, domain: str) -> Tuple[str, str]:
         import time
 
         from cryptography import x509
@@ -281,6 +387,7 @@ class AcmeClient:
             )
             if not self.kid:
                 raise AcmeError("newAccount returned no Location (kid)")
+            self._save_account()
 
         status, hdrs, body = self._post(
             d["newOrder"], {"identifiers": [{"type": "dns", "value": domain}]}
@@ -309,14 +416,14 @@ class AcmeClient:
                 if status not in (200, 202):
                     raise AcmeError(f"challenge answer failed: HTTP {status}")
                 # Poll the authorization until valid.
-                for _ in range(30):
+                for _ in range(self.poll_tries):
                     status, _, body = self._post(authz_url, None)
                     state = json.loads(body).get("status")
                     if state == "valid":
                         break
                     if state in ("invalid", "revoked", "expired"):
                         raise AcmeError(f"authorization {state} for {domain}")
-                    time.sleep(0.5)
+                    time.sleep(self.poll_interval)
                 else:
                     raise AcmeError(f"authorization pending past deadline for {domain}")
 
@@ -335,7 +442,7 @@ class AcmeClient:
                 raise AcmeError(f"finalize failed: HTTP {status}: {body[:200]!r}")
 
             cert_url = json.loads(body).get("certificate")
-            for _ in range(30):
+            for _ in range(self.poll_tries):
                 if cert_url:
                     break
                 status, _, body = self._post(order_url, None)
@@ -343,7 +450,7 @@ class AcmeClient:
                 if data.get("status") == "invalid":
                     raise AcmeError("order invalid after finalize")
                 cert_url = data.get("certificate")
-                time.sleep(0.5)
+                time.sleep(self.poll_interval)
             if not cert_url:
                 raise AcmeError("order never reached valid/certificate")
             status, _, body = self._post(cert_url, None)

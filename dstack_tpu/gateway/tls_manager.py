@@ -3,15 +3,22 @@
 One manager per appliance: owns the CertStore (SNI) and, when an ACME directory
 is configured, an AcmeClient whose http-01 bodies the HTTP app serves from
 ``/.well-known/acme-challenge/``. Domains with operator-provisioned certs in
-the store never trigger issuance (the reference's `certificate` passthrough)."""
+the store never trigger issuance (the reference's `certificate` passthrough).
+
+Renewal parity: the reference's certbot both issues AND renews
+(ref proxy/gateway/services/nginx.py:75-110 + certbot's systemd timer); here
+``check_renewals`` re-issues any cert inside ``renew_before_days`` of expiry
+and ``renew_loop`` runs it periodically (started by gateway.app.serve)."""
 
 from __future__ import annotations
 
 import asyncio
+import datetime
 import logging
+import os
 import ssl
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from dstack_tpu.gateway.tls import AcmeClient, CertStore
 
@@ -24,11 +31,18 @@ class TlsManager:
         certs_dir: str,
         acme_directory: Optional[str] = None,
         acme_contact: Optional[str] = None,
+        renew_before_days: float = 30.0,
+        renew_check_interval: float = 3600.0,
     ) -> None:
         self.store = CertStore(certs_dir)
         self._challenges: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._inflight: set = set()
+        # Strong refs: the event loop only weak-refs tasks, so a bare
+        # create_task() result can be collected mid-issuance.
+        self._tasks: set = set()
+        self.renew_before = datetime.timedelta(days=renew_before_days)
+        self.renew_check_interval = renew_check_interval
         self.acme: Optional[AcmeClient] = None
         if acme_directory:
             self.acme = AcmeClient(
@@ -36,6 +50,7 @@ class TlsManager:
                 publish=self._publish,
                 unpublish=self._unpublish,
                 contact=acme_contact,
+                account_path=os.path.join(certs_dir, "acme_account.json"),
             )
 
     # http-01 plumbing -----------------------------------------------------
@@ -52,10 +67,17 @@ class TlsManager:
             return self._challenges.get(token)
 
     # issuance -------------------------------------------------------------
-    def ensure_async(self, domain: str) -> None:
-        """Fire-and-forget: issue the domain's cert unless present/in flight."""
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    def ensure_async(self, domain: str, force: bool = False) -> None:
+        """Fire-and-forget: issue the domain's cert unless present/in flight.
+        ``force=True`` re-issues over an existing cert (renewal)."""
         domain = domain.lower()
-        if self.store.has(domain) or self.acme is None:
+        if self.acme is None or (not force and self.store.has(domain)):
             return
         with self._lock:
             if domain in self._inflight:
@@ -65,7 +87,7 @@ class TlsManager:
         async def _run() -> None:
             try:
                 chain, key = await asyncio.to_thread(self.acme.obtain, domain)
-                self.store.put(domain, chain, key)
+                self.store.put(domain, chain, key, managed=True)
                 logger.info("obtained certificate for %s", domain)
             except Exception:
                 logger.exception("ACME issuance failed for %s", domain)
@@ -73,7 +95,7 @@ class TlsManager:
                 with self._lock:
                     self._inflight.discard(domain)
 
-        asyncio.get_running_loop().create_task(_run())
+        self._spawn(_run())
 
     async def ensure(self, domain: str) -> bool:
         """Blocking variant (tests / eager callers): True when a cert exists."""
@@ -87,8 +109,46 @@ class TlsManager:
         except Exception:
             logger.exception("ACME issuance failed for %s", domain)
             return False
-        self.store.put(domain, chain, key)
+        self.store.put(domain, chain, key, managed=True)
         return True
+
+    # renewal --------------------------------------------------------------
+    def renewal_due(self, domain: str) -> bool:
+        exp = self.store.expiry(domain)
+        if exp is None:
+            return False
+        return exp - datetime.datetime.now(datetime.timezone.utc) < self.renew_before
+
+    def check_renewals(self) -> List[str]:
+        """Kick off re-issuance for every ACME-managed cert inside the renewal
+        window; returns the domains scheduled (issuance runs in background).
+        Operator-provisioned certs (no acme-managed marker) are never touched —
+        renewing them would replace a private-CA cert and hammer the CA with
+        doomed http-01 attempts."""
+        if self.acme is None:
+            return []
+        due = [
+            d for d in self.store.domains()
+            if self.store.is_managed(d) and self.renewal_due(d)
+        ]
+        for domain in due:
+            logger.info("certificate for %s expires within %s; renewing",
+                        domain, self.renew_before)
+            self.ensure_async(domain, force=True)
+        return due
+
+    async def renew_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.renew_check_interval)
+            try:
+                self.check_renewals()
+            except Exception:
+                logger.exception("renewal sweep failed")
+
+    def start_renewal(self) -> None:
+        """Start the periodic renewal sweep (call from a running loop)."""
+        if self.acme is not None:
+            self._spawn(self.renew_loop())
 
     def server_context(self) -> ssl.SSLContext:
         return self.store.server_context()

@@ -88,6 +88,8 @@ class FakeAcme:
         self.authz_status = "pending"
         self.cert_pem = None
         self.validated_tokens = []
+        self.badnonce_remaining = 0  # inject N badNonce rejections on new-order
+        self.new_order_posts = 0
 
     def thumbprint(self):
         canonical = json.dumps(self.jwk, separators=(",", ":"), sort_keys=True)
@@ -126,6 +128,13 @@ class FakeAcme:
             )
 
         async def new_order(request):
+            self.new_order_posts += 1
+            if self.badnonce_remaining > 0:
+                self.badnonce_remaining -= 1
+                return web.json_response(
+                    {"type": "urn:ietf:params:acme:error:badNonce"},
+                    status=400, headers=nonce_headers(),
+                )
             _, payload = parse_jws(await request.read())
             assert payload["identifiers"][0]["value"] == "svc.test"
             return web.json_response(
@@ -152,12 +161,15 @@ class FakeAcme:
 
         async def chall(request):
             # Validate over the wire like a real CA: fetch the challenge body
-            # from the gateway's HTTP app.
-            import urllib.request
+            # from the gateway's HTTP app. Must be async — the gateway serves
+            # the challenge from this same event loop, so a blocking fetch
+            # here would deadlock (this bug shipped in round 4).
+            import aiohttp
 
             url = f"http://{self.challenge_host}/.well-known/acme-challenge/tok-123"
-            with urllib.request.urlopen(url, timeout=5) as resp:
-                body = resp.read().decode()
+            async with aiohttp.ClientSession() as session:
+                async with session.get(url, timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    body = await resp.text()
             expected = f"tok-123.{self.thumbprint()}"
             if body == expected:
                 self.authz_status = "valid"
@@ -323,6 +335,91 @@ class TestAcmeEndToEnd:
             await gw_server.close()
             await acme_server.close()
             await upstream_server.close()
+
+    async def test_near_expiry_cert_is_renewed(self, tmp_path):
+        """A stored cert inside the renewal window is re-issued over ACME and
+        the SNI store picks up the fresh one (certbot-renewal parity)."""
+        from cryptography import x509
+
+        ca = TestCa()
+        fake = FakeAcme(ca, challenge_host="")
+        acme_server = TestServer(fake.app())
+        await acme_server.start_server()
+        fake.base = f"http://127.0.0.1:{acme_server.port}"
+
+        tm = TlsManager(
+            str(tmp_path), acme_directory=f"{fake.base}/directory",
+            renew_before_days=10,
+        )
+        # Seed a soon-expiring ACME-issued cert (5 days < the 10-day window)
+        # plus an operator-provisioned one the sweep must never touch.
+        chain, key = self_signed_cert("svc.test", days=5)
+        tm.store.put("svc.test", chain, key, managed=True)
+        op_chain, op_key = self_signed_cert("operator.test", days=5)
+        tm.store.put("operator.test", op_chain, op_key)
+        old_exp = tm.store.expiry("svc.test")
+        op_exp = tm.store.expiry("operator.test")
+
+        gw_app = create_app("gw-token", tls_manager=tm)
+        gw_server = TestServer(gw_app)
+        await gw_server.start_server()
+        fake.challenge_host = f"127.0.0.1:{gw_server.port}"
+        try:
+            assert tm.renewal_due("svc.test")
+            assert tm.check_renewals() == ["svc.test"]
+            for _ in range(100):
+                exp = tm.store.expiry("svc.test")
+                if exp is not None and exp != old_exp:
+                    break
+                await asyncio.sleep(0.1)
+            assert tm.store.expiry("svc.test") != old_exp, "never renewed"
+            pem = (tmp_path / "svc.test" / "fullchain.pem").read_bytes()
+            cert = x509.load_pem_x509_certificate(pem)
+            assert "fake-acme-ca" in cert.issuer.rfc4514_string()
+            # The fresh 30-day cert sits outside the 10-day window.
+            assert not tm.renewal_due("svc.test")
+            assert tm.check_renewals() == []
+            # The operator-provisioned cert was left alone despite being due.
+            assert tm.store.expiry("operator.test") == op_exp
+            assert not tm.store.is_managed("operator.test")
+        finally:
+            await gw_server.close()
+            await acme_server.close()
+
+    async def test_badnonce_retry_and_account_persistence(self, tmp_path):
+        """RFC 8555 §6.5 badNonce rejections are retried with the fresh nonce,
+        and the account key + kid survive a manager restart."""
+        ca = TestCa()
+        fake = FakeAcme(ca, challenge_host="")
+        acme_server = TestServer(fake.app())
+        await acme_server.start_server()
+        fake.base = f"http://127.0.0.1:{acme_server.port}"
+
+        tm = TlsManager(str(tmp_path), acme_directory=f"{fake.base}/directory")
+        gw_app = create_app("gw-token", tls_manager=tm)
+        gw_server = TestServer(gw_app)
+        await gw_server.start_server()
+        fake.challenge_host = f"127.0.0.1:{gw_server.port}"
+        try:
+            fake.badnonce_remaining = 2  # two rejections, third attempt lands
+            assert await tm.ensure("svc.test")
+            assert fake.new_order_posts == 3
+
+            acct_path = tmp_path / "acme_account.json"
+            assert acct_path.exists()
+            acct = json.loads(acct_path.read_text())
+            assert acct["kid"] == tm.acme.kid
+
+            # "Restart": a new manager over the same certs dir reuses the
+            # registration instead of creating a fresh account.
+            tm2 = TlsManager(str(tmp_path), acme_directory=f"{fake.base}/directory")
+            assert tm2.acme.kid == tm.acme.kid
+            old_pub = tm.acme.account_key.public_key().public_numbers()
+            new_pub = tm2.acme.account_key.public_key().public_numbers()
+            assert (old_pub.x, old_pub.y) == (new_pub.x, new_pub.y)
+        finally:
+            await gw_server.close()
+            await acme_server.close()
 
     async def test_issuance_failure_does_not_break_registration(self, tmp_path):
         """A dead ACME endpoint must not fail service registration — the

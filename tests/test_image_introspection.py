@@ -24,6 +24,7 @@ class FakeRegistry:
     def __init__(self, require_auth=False, username="bot", password="hunter2"):
         self.require_auth = require_auth
         self.username, self.password = username, password
+        self.empty_token = False  # 200 from /token with no token field
         config = {
             "os": "linux",
             "architecture": "amd64",
@@ -60,6 +61,8 @@ class FakeRegistry:
             ).decode()
             if request.headers.get("Authorization") != expect:
                 return web.json_response({}, status=401)
+            if self.empty_token:
+                return web.json_response({})
             return web.json_response({"token": "tok-ok"})
 
         async def manifests(request):
@@ -161,6 +164,60 @@ class TestIntrospection:
         cfg = await docker_registry.get_image_config("127.0.0.1:1/team/app:good")
         assert cfg.verified is False
         assert "unreachable" in (cfg.note or "")
+
+    async def test_network_failure_mid_introspection_degrades(self, monkeypatch):
+        """The config blob often lives on a different (CDN) host than the
+        registry: a network failure on ANY hop must degrade to unverified,
+        not error the plan (ADVICE r4)."""
+        docker_registry.clear_cache()
+        reg, server, host = await self._with_registry()
+        real_request = docker_registry._request
+
+        def flaky_request(url, headers, timeout=10.0):
+            if "/blobs/" in url:
+                raise OSError("blob CDN unreachable")
+            return real_request(url, headers, timeout)
+
+        monkeypatch.setattr(docker_registry, "_request", flaky_request)
+        try:
+            cfg = await docker_registry.get_image_config(f"{host}/team/app:good")
+            assert cfg.verified is False
+            assert "unreachable" in (cfg.note or "")
+        finally:
+            await server.close()
+
+    async def test_tokenless_token_endpoint_is_clear_error(self):
+        """A 200 from the token endpoint with no token is a malformed-endpoint
+        error, not a 'Bearer None' credential failure (ADVICE r4)."""
+        docker_registry.clear_cache()
+        reg, server, host = await start_fake_registry(require_auth=True)
+        reg.empty_token = True
+        try:
+            with pytest.raises(ServerClientError, match="no token"):
+                await docker_registry.get_image_config(
+                    f"{host}/team/app:good", username="bot", password="hunter2"
+                )
+        finally:
+            await server.close()
+
+    async def test_fixed_password_bypasses_cached_auth_failure(self):
+        """The introspection cache keys on the credential, so correcting a
+        password takes effect immediately instead of replaying the cached
+        auth error for the TTL (ADVICE r4)."""
+        docker_registry.clear_cache()
+        reg, server, host = await start_fake_registry(require_auth=True)
+        try:
+            with pytest.raises(ServerClientError, match="auth"):
+                await docker_registry.get_image_config_cached(
+                    f"{host}/team/app:good", username="bot", password="wrong"
+                )
+            cfg = await docker_registry.get_image_config_cached(
+                f"{host}/team/app:good", username="bot", password="hunter2"
+            )
+            assert cfg.user == "appuser"
+        finally:
+            await server.close()
+            docker_registry.clear_cache()
 
 
 class TestPlanIntegration:
