@@ -1,0 +1,253 @@
+"""A self-contained, stdlib-only web IDE for dev environments.
+
+Parity: the reference installs an IDE backend at dev-env start
+(ref server/services/jobs/configurators/dev.py:35 `ide.get_install_commands()`
+downloads openvscode-server). That needs egress at job start; TPU pods are
+often air-gapped, so this module is the always-available tier of the IDE
+chain the dev-env configurator builds (code-server -> openvscode-server ->
+THIS -> bare file listing): a real editor — file tree, open, edit, save,
+create — served by ``python3 -m dstack_tpu.ide`` with zero dependencies
+beyond the interpreter that is already in every supported image.
+
+Binds 127.0.0.1 only: it is reached through the attach bridge / SSH tunnel,
+the same trust model as the reference's `code-server --auth none`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import posixpath
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+MAX_FILE_BYTES = 2 * 1024 * 1024  # editor is for source files, not datasets
+SKIP_DIRS = {".git", "__pycache__", ".venv", "node_modules", ".pytest_cache"}
+
+PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>dstack-tpu IDE</title>
+<style>
+  :root { --bg:#1e1e24; --panel:#26262e; --fg:#d8d8e0; --accent:#7aa2f7; --dim:#8a8a96; }
+  * { box-sizing: border-box; }
+  body { margin:0; display:flex; height:100vh; font:13px/1.5 ui-monospace,monospace;
+         background:var(--bg); color:var(--fg); }
+  #tree { width:260px; overflow:auto; background:var(--panel); padding:8px;
+          border-right:1px solid #000; flex-shrink:0; }
+  #tree .f { cursor:pointer; padding:1px 4px; border-radius:3px; white-space:nowrap; }
+  #tree .f:hover { background:#34343e; }
+  #tree .f.open { color:var(--accent); }
+  #tree .d { color:var(--dim); padding:1px 4px; white-space:nowrap; }
+  #main { flex:1; display:flex; flex-direction:column; min-width:0; }
+  #bar { display:flex; gap:8px; align-items:center; padding:6px 10px;
+         background:var(--panel); border-bottom:1px solid #000; }
+  #path { color:var(--accent); flex:1; overflow:hidden; text-overflow:ellipsis; }
+  button { background:#3a3a46; color:var(--fg); border:1px solid #000;
+           border-radius:4px; padding:3px 10px; cursor:pointer; font:inherit; }
+  button:hover { background:#444452; }
+  #ed { flex:1; width:100%; resize:none; border:0; outline:0; padding:10px;
+        background:var(--bg); color:var(--fg); font:13px/1.5 ui-monospace,monospace;
+        tab-size:4; }
+  #status { padding:3px 10px; background:var(--panel); color:var(--dim);
+            border-top:1px solid #000; min-height:22px; }
+</style></head><body>
+<div id="tree"></div>
+<div id="main">
+  <div id="bar">
+    <span id="path">(no file)</span>
+    <button id="new">new file</button>
+    <button id="save">save</button>
+  </div>
+  <textarea id="ed" spellcheck="false" placeholder="open a file from the tree"></textarea>
+  <div id="status">dstack-tpu IDE</div>
+</div>
+<script>
+let cur = null;
+const $ = id => document.getElementById(id);
+const status = m => { $("status").textContent = m; };
+async function tree() {
+  const r = await fetch("api/tree"); const items = await r.json();
+  const t = $("tree"); t.innerHTML = "";
+  for (const it of items) {
+    const div = document.createElement("div");
+    div.className = it.dir ? "d" : "f";
+    div.style.paddingLeft = (6 + it.depth * 14) + "px";
+    div.textContent = (it.dir ? "\\u25b8 " : "") + it.name;
+    if (!it.dir) {
+      div.dataset.path = it.path;
+      div.onclick = () => open(it.path);
+    }
+    t.appendChild(div);
+  }
+}
+async function open(p) {
+  const r = await fetch("api/file?path=" + encodeURIComponent(p));
+  if (!r.ok) { status("open failed: " + (await r.text())); return; }
+  $("ed").value = await r.text();
+  cur = p; $("path").textContent = p;
+  document.querySelectorAll("#tree .f").forEach(e =>
+    e.classList.toggle("open", e.dataset.path === p));
+  status("opened " + p);
+}
+async function save() {
+  if (cur === null) { status("no file open"); return; }
+  const r = await fetch("api/file?path=" + encodeURIComponent(cur),
+                        { method: "PUT", body: $("ed").value });
+  status(r.ok ? "saved " + cur : "save failed: " + (await r.text()));
+}
+$("save").onclick = save;
+$("new").onclick = async () => {
+  const p = prompt("new file path (relative to workspace):");
+  if (!p) return;
+  const r = await fetch("api/file?path=" + encodeURIComponent(p),
+                        { method: "PUT", body: "" });
+  if (r.ok) { await tree(); await open(p); } else status(await r.text());
+};
+document.addEventListener("keydown", e => {
+  if ((e.ctrlKey || e.metaKey) && e.key === "s") { e.preventDefault(); save(); }
+});
+tree();
+</script></body></html>"""
+
+
+class IdeHandler(BaseHTTPRequestHandler):
+    root: str = "."
+    server_version = "dstack-tpu-ide"
+
+    # -- helpers ----------------------------------------------------------
+    def _send(self, code: int, body: bytes, ctype: str = "text/plain") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", f"{ctype}; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Dstack-IDE", "dstack-tpu")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _resolve(self, rel: str) -> str:
+        """Reject traversal: the resolved path must stay inside root."""
+        rel = posixpath.normpath(urllib.parse.unquote(rel)).lstrip("/")
+        if rel.startswith(".."):
+            raise PermissionError(rel)
+        full = os.path.realpath(os.path.join(self.root, rel))
+        root = os.path.realpath(self.root)
+        if full != root and not full.startswith(root + os.sep):
+            raise PermissionError(rel)
+        return full
+
+    def _query(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        return dict(urllib.parse.parse_qsl(parsed.query))
+
+    def log_message(self, fmt, *args):  # quiet; job logs carry stdout already
+        pass
+
+    # -- routes -----------------------------------------------------------
+    def do_GET(self) -> None:
+        route = urllib.parse.urlparse(self.path).path
+        if route in ("/", "/index.html"):
+            self._send(200, PAGE.encode(), "text/html")
+        elif route == "/healthcheck":
+            self._send(200, json.dumps({"status": "ok", "ide": "dstack-tpu"}).encode(),
+                       "application/json")
+        elif route == "/api/tree":
+            self._send(200, json.dumps(self._tree()).encode(), "application/json")
+        elif route == "/api/file":
+            self._get_file()
+        else:
+            self._send(404, b"not found")
+
+    def do_PUT(self) -> None:
+        if urllib.parse.urlparse(self.path).path != "/api/file":
+            self._send(404, b"not found")
+            return
+        # CSRF guard: browsers attach an Origin header to cross-site writes;
+        # a write whose Origin doesn't match the address the IDE is served on
+        # comes from another site scripting the user's forwarded port. Our own
+        # UI is same-origin, so its Origin (when sent) always matches Host.
+        origin = self.headers.get("Origin")
+        if origin:
+            origin_host = urllib.parse.urlparse(origin).netloc
+            if origin_host != (self.headers.get("Host") or ""):
+                self._send(403, b"cross-origin write rejected")
+                return
+        rel = self._query().get("path", "")
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > MAX_FILE_BYTES:
+            self._send(413, b"file too large for the editor")
+            return
+        body = self.rfile.read(length)
+        try:
+            full = self._resolve(rel)
+            os.makedirs(os.path.dirname(full) or ".", exist_ok=True)
+            with open(full, "wb") as f:
+                f.write(body)
+        except PermissionError:
+            self._send(403, b"path escapes workspace")
+            return
+        except OSError as e:
+            self._send(500, str(e).encode())
+            return
+        self._send(200, b"saved")
+
+    # No POST: a cross-site POST with a simple content type skips the CORS
+    # preflight that protects PUT, so writes are PUT-only.
+
+    def _get_file(self) -> None:
+        rel = self._query().get("path", "")
+        try:
+            full = self._resolve(rel)
+            if not os.path.isfile(full):
+                self._send(404, b"no such file")
+                return
+            if os.path.getsize(full) > MAX_FILE_BYTES:
+                self._send(413, b"file too large for the editor")
+                return
+            with open(full, "rb") as f:
+                self._send(200, f.read())
+        except PermissionError:
+            self._send(403, b"path escapes workspace")
+
+    def _tree(self) -> list:
+        items = []
+
+        def walk(dirpath: str, relbase: str, depth: int) -> None:
+            try:
+                names = sorted(os.listdir(dirpath))
+            except OSError:
+                return
+            dirs = [n for n in names if os.path.isdir(os.path.join(dirpath, n))]
+            files = [n for n in names if not os.path.isdir(os.path.join(dirpath, n))]
+            for name in dirs:
+                if name in SKIP_DIRS or name.startswith("."):
+                    continue
+                rel = posixpath.join(relbase, name) if relbase else name
+                items.append({"name": name, "path": rel, "dir": True, "depth": depth})
+                if depth < 6 and len(items) < 2000:
+                    walk(os.path.join(dirpath, name), rel, depth + 1)
+            for name in files:
+                rel = posixpath.join(relbase, name) if relbase else name
+                items.append({"name": name, "path": rel, "dir": False, "depth": depth})
+
+        walk(self.root, "", 0)
+        return items[:2000]
+
+
+def serve(port: int, root: str, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    handler = type("BoundIdeHandler", (IdeHandler,), {"root": root})
+    server = ThreadingHTTPServer((host, port), handler)
+    return server
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="dstack-tpu-ide")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--root", default=".")
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args()
+    server = serve(args.port, args.root, args.host)
+    print(f"dstack-tpu IDE on {args.host}:{server.server_address[1]}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

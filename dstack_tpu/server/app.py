@@ -45,41 +45,47 @@ async def _on_startup(app: web.Application) -> None:
         key_specs = json.loads(settings.ENCRYPTION_KEYS)
         encryption.configure_keys(key_specs)
         logger.info("configured %d at-rest encryption key(s)", len(key_specs))
-    admin_row, created = await users_service.get_or_create_admin_user(
-        db, token=settings.ADMIN_TOKEN
-    )
-    app["admin_token"] = admin_row["token"]
-    if created:
-        logger.info("created admin user")
-    # default project
-    existing = await db.fetchone(
-        "SELECT id FROM projects WHERE name = ? AND deleted = 0",
-        (settings.DEFAULT_PROJECT_NAME,),
-    )
-    if existing is None:
-        await projects_service.create_project(db, admin_row, settings.DEFAULT_PROJECT_NAME)
-        logger.info("created default project %s", settings.DEFAULT_PROJECT_NAME)
-    # Declarative server config: converge projects/backends/plugins to config.yml
-    # (reference ServerConfigManager, services/config.py).
-    try:
-        from dstack_tpu.server.services import config as config_service
-        from dstack_tpu.server.services import encryption as encryption_service
+    # Multi-replica HA init: N replicas sharing one postgres database elect a
+    # single bootstrapper via an advisory lock (no-op on sqlite; reference
+    # server/app.py:109-113 guards the same section the same way).
+    async with db.advisory_lock("server-init"):
+        admin_row, created = await users_service.get_or_create_admin_user(
+            db, token=settings.ADMIN_TOKEN
+        )
+        app["admin_token"] = admin_row["token"]
+        if created:
+            logger.info("created admin user")
+        # default project
+        existing = await db.fetchone(
+            "SELECT id FROM projects WHERE name = ? AND deleted = 0",
+            (settings.DEFAULT_PROJECT_NAME,),
+        )
+        if existing is None:
+            await projects_service.create_project(db, admin_row, settings.DEFAULT_PROJECT_NAME)
+            logger.info("created default project %s", settings.DEFAULT_PROJECT_NAME)
+        # Declarative server config: converge projects/backends/plugins to
+        # config.yml (reference ServerConfigManager, services/config.py).
+        # Inside the init lock: concurrent replicas applying the same config
+        # would race on project/backend creation.
+        try:
+            from dstack_tpu.server.services import config as config_service
+            from dstack_tpu.server.services import encryption as encryption_service
 
-        server_config = config_service.load_config(settings.SERVER_DIR)
-        env_plugins = os.getenv("DSTACK_TPU_PLUGINS")
-        if env_plugins:
-            server_config.plugins.extend(
-                p.strip() for p in env_plugins.split(",") if p.strip()
-            )
-        if (
-            server_config.encryption is not None
-            and server_config.encryption.keys
-            and not settings.ENCRYPTION_KEYS  # env wins over the file
-        ):
-            encryption_service.configure_keys(server_config.encryption.keys)
-        await config_service.apply_config(db, admin_row, server_config)
-    except Exception:
-        logger.exception("applying server config failed; continuing with DB state")
+            server_config = config_service.load_config(settings.SERVER_DIR)
+            env_plugins = os.getenv("DSTACK_TPU_PLUGINS")
+            if env_plugins:
+                server_config.plugins.extend(
+                    p.strip() for p in env_plugins.split(",") if p.strip()
+                )
+            if (
+                server_config.encryption is not None
+                and server_config.encryption.keys
+                and not settings.ENCRYPTION_KEYS  # env wins over the file
+            ):
+                encryption_service.configure_keys(server_config.encryption.keys)
+            await config_service.apply_config(db, admin_row, server_config)
+        except Exception:
+            logger.exception("applying server config failed; continuing with DB state")
     # Re-prime the service autoscaler's RPS window from its persisted buckets
     # so a restart doesn't zero a busy service's scaling knowledge.
     try:

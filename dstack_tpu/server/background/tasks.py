@@ -310,8 +310,10 @@ async def _provision_slice(
                 data = json.dumps(_volume_attachment_data(vol, vol_index))
                 for iid in ids:
                     conn.execute(
-                        "INSERT OR REPLACE INTO volume_attachments"
-                        " (volume_id, instance_id, attachment_data) VALUES (?, ?, ?)",
+                        "INSERT INTO volume_attachments"
+                        " (volume_id, instance_id, attachment_data) VALUES (?, ?, ?)"
+                        " ON CONFLICT (volume_id, instance_id)"
+                        " DO UPDATE SET attachment_data = excluded.attachment_data",
                         (str(vol.id), iid, data),
                     )
 

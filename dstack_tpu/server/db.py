@@ -1,34 +1,299 @@
-"""Async-friendly sqlite persistence.
+"""Async-friendly persistence with a sqlite/postgres dialect seam.
 
-Parity: reference server/db.py (async SQLAlchemy, WAL pragma db.py:35-40) — re-designed
-on stdlib sqlite3: one writer connection in WAL mode, all statements funneled through a
-single worker thread so the asyncio event loop never blocks and writes are serialized
-(sqlite's own model). Schema migrations are ordered DDL scripts tracked in a version
-table (alembic equivalent)."""
+Parity: reference server/db.py (async SQLAlchemy over sqlite+aiosqlite OR
+postgres+asyncpg, WAL pragma db.py:35-40) and services/locking.py (postgres
+advisory locks for multi-replica HA init). Re-designed without an ORM: one
+worker thread owns the connection, all statements are funneled through it so
+the asyncio event loop never blocks and writes are serialized. The dialect
+object hides everything engine-specific:
+
+- placeholder style: services author qmark (`?`) SQL; the postgres dialect
+  translates to `%s` outside string literals at execution time.
+- DDL: migrations are authored once in portable DDL (TEXT/INTEGER/REAL +
+  `ON CONFLICT` upserts, supported by both engines); the postgres dialect
+  rewrites the few remaining divergences (BLOB -> BYTEA) and splits scripts
+  into single statements (sqlite's executescript has no postgres analogue).
+- advisory locks: `Database.advisory_lock(name)` guards multi-replica init
+  sections (admin/user bootstrap, config apply). On sqlite it is a no-op —
+  one process, one writer thread — while on postgres it takes a session
+  advisory lock so N server replicas sharing one database elect a single
+  initializer, like the reference's `with_for_update`+advisory-lock HA init
+  (ref server/app.py:109-113).
+
+The postgres driver (psycopg 3 or psycopg2) is not bundled in this image;
+`Database("postgresql://...")` raises a clear error at connect() when no
+driver is importable, and the postgres test module skips itself the same way.
+Multi-replica deployment is documented in README.md (Run `dstack-tpu server`
+N times against the same DSTACK_TPU_DB_URL; background schedulers coordinate
+through transactions + advisory locks).
+"""
 
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
+import re
 import sqlite3
 import threading
 import queue
 import uuid
+from contextlib import asynccontextmanager
 from pathlib import Path
-from typing import Any, Iterable, List, Optional
+from typing import Any, Iterable, List, Optional, Sequence
 
 from dstack_tpu.server import migrations
+
+
+# ---------------------------------------------------------------------------
+# Dialects
+
+
+@functools.lru_cache(maxsize=1024)
+def translate_qmark(sql: str, marker: str = "%s") -> str:
+    """Rewrite qmark placeholders to `marker`, leaving quoted literals alone.
+    Memoized: the scheduler loops re-execute a small fixed set of statements."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            out.append(ch)
+            if ch == "'":
+                if i + 1 < len(sql) and sql[i + 1] == "'":  # escaped ''
+                    out.append("'")
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+        elif ch == "?":
+            out.append(marker)
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def split_script(script: str) -> List[str]:
+    """Split a DDL script into statements on top-level semicolons (the repo's
+    migration DDL keeps no semicolons inside string literals or bodies)."""
+    statements, buf, in_str = [], [], False
+    for ch in script:
+        if ch == "'":
+            in_str = not in_str
+        if ch == ";" and not in_str:
+            stmt = "".join(buf).strip()
+            if stmt:
+                statements.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+    tail = "".join(buf).strip()
+    if tail:
+        statements.append(tail)
+    return statements
+
+
+class SqliteDialect:
+    """Owns the sqlite3 connection; qmark SQL passes through untouched."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def connect(self):
+        if self.path != ":memory:":
+            Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        conn = sqlite3.connect(self.path)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA busy_timeout=10000")
+        conn.execute("PRAGMA foreign_keys=ON")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        migrations.migrate(conn)
+        return conn
+
+    def run_script(self, conn, script: str) -> None:
+        conn.executescript(script)
+
+    def tx_advisory_lock(self, conn, name: str) -> None:
+        pass  # the single writer thread already serializes all transactions
+
+    def session_lock(self, conn, name: str) -> None:
+        pass
+
+    def session_unlock(self, conn, name: str) -> None:
+        pass
+
+
+class PgRow:
+    """dict+index row access matching what sqlite3.Row offers services."""
+
+    __slots__ = ("_cols", "_vals")
+
+    def __init__(self, cols: Sequence[str], vals: Sequence[Any]):
+        self._cols = cols
+        self._vals = vals
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._vals[key]
+        try:
+            return self._vals[self._cols.index(key)]
+        except ValueError:
+            raise KeyError(key) from None
+
+    def keys(self):
+        return list(self._cols)
+
+    def __iter__(self):
+        return iter(self._vals)
+
+    def __len__(self):
+        return len(self._vals)
+
+    def __repr__(self):  # pragma: no cover
+        return f"PgRow({dict(zip(self._cols, self._vals))!r})"
+
+
+class _PgCursor:
+    """Cursor facade returning PgRow so service code is row-type agnostic."""
+
+    def __init__(self, cursor):
+        self._cur = cursor
+
+    @property
+    def rowcount(self) -> int:
+        return self._cur.rowcount
+
+    def _cols(self) -> List[str]:
+        return [d[0] for d in (self._cur.description or [])]
+
+    def fetchone(self) -> Optional[PgRow]:
+        row = self._cur.fetchone()
+        return None if row is None else PgRow(self._cols(), row)
+
+    def fetchall(self) -> List[PgRow]:
+        cols = None
+        out = []
+        for row in self._cur.fetchall():
+            if cols is None:
+                cols = self._cols()
+            out.append(PgRow(cols, row))
+        return out
+
+
+class _PgConnection:
+    """The connection object handed to db.run() closures under postgres: the
+    same `.execute(qmark_sql, params)` surface the sqlite3 connection has."""
+
+    def __init__(self, raw):
+        self.raw = raw
+
+    def execute(self, sql: str, params: Iterable = ()) -> _PgCursor:
+        cur = self.raw.cursor()
+        cur.execute(translate_qmark(sql), tuple(params))
+        return _PgCursor(cur)
+
+    def executemany(self, sql: str, rows: Iterable[Iterable]) -> None:
+        cur = self.raw.cursor()
+        cur.executemany(translate_qmark(sql), [tuple(r) for r in rows])
+
+    def commit(self) -> None:
+        self.raw.commit()
+
+    def rollback(self) -> None:
+        self.raw.rollback()
+
+    def close(self) -> None:
+        self.raw.close()
+
+
+_PG_DDL_FIXUPS = [
+    (re.compile(r"\bBLOB\b"), "BYTEA"),
+]
+
+
+class PostgresDialect:
+    """Talks to postgres via psycopg 3 or psycopg2, whichever imports."""
+
+    name = "postgres"
+
+    def __init__(self, dsn: str):
+        self.dsn = dsn
+
+    @staticmethod
+    def _driver():
+        try:
+            import psycopg  # psycopg 3
+
+            return psycopg, 3
+        except ImportError:
+            pass
+        try:
+            import psycopg2
+
+            return psycopg2, 2
+        except ImportError:
+            raise RuntimeError(
+                "postgres DSN configured but no driver available: install "
+                "psycopg (v3) or psycopg2 on the server host"
+            ) from None
+
+    def connect(self) -> _PgConnection:
+        driver, _version = self._driver()
+        conn = _PgConnection(driver.connect(self.dsn))
+        migrations.migrate(conn, dialect=self)
+        return conn
+
+    def fixup_ddl(self, script: str) -> str:
+        for pattern, replacement in _PG_DDL_FIXUPS:
+            script = pattern.sub(replacement, script)
+        return script
+
+    def run_script(self, conn: _PgConnection, script: str) -> None:
+        for statement in split_script(self.fixup_ddl(script)):
+            conn.execute(statement)
+
+    # hashtext() maps the lock name onto postgres's bigint advisory-lock
+    # keyspace; xact locks release at commit/rollback, session locks at
+    # session_unlock or disconnect.
+    def tx_advisory_lock(self, conn: _PgConnection, name: str) -> None:
+        conn.execute("SELECT pg_advisory_xact_lock(hashtext(?))", (name,))
+
+    def session_lock(self, conn: _PgConnection, name: str) -> None:
+        conn.execute("SELECT pg_advisory_lock(hashtext(?))", (name,))
+
+    def session_unlock(self, conn: _PgConnection, name: str) -> None:
+        conn.execute("SELECT pg_advisory_unlock(hashtext(?))", (name,))
+
+
+def make_dialect(url: str):
+    if url.startswith(("postgres://", "postgresql://")):
+        return PostgresDialect(url)
+    if url.startswith("sqlite:///"):
+        url = url[len("sqlite:///"):] or ":memory:"
+    return SqliteDialect(url)
+
+
+# ---------------------------------------------------------------------------
+# Database
 
 
 class Database:
     """All access goes through execute()/fetchall()/fetchone() coroutines.
 
-    A dedicated thread owns the sqlite3 connection; requests are queued, keeping the
-    event loop responsive under the write-heavy scheduler loops.
+    A dedicated thread owns the connection; requests are queued, keeping the
+    event loop responsive under the write-heavy scheduler loops. `url` is a
+    sqlite path (default) or a postgres:// DSN.
     """
 
-    def __init__(self, path: str = ":memory:"):
-        self._path = path
+    def __init__(self, url: str = ":memory:"):
+        self.dialect = make_dialect(url)
         self._queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -36,8 +301,6 @@ class Database:
     async def connect(self) -> None:
         if self._thread is not None:
             return
-        if self._path != ":memory:":
-            Path(self._path).parent.mkdir(parents=True, exist_ok=True)
         loop = asyncio.get_running_loop()
         started: "asyncio.Future" = loop.create_future()
         self._thread = threading.Thread(
@@ -48,13 +311,7 @@ class Database:
 
     def _worker(self, loop: asyncio.AbstractEventLoop, started: "asyncio.Future") -> None:
         try:
-            conn = sqlite3.connect(self._path)
-            conn.row_factory = sqlite3.Row
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA busy_timeout=10000")
-            conn.execute("PRAGMA foreign_keys=ON")
-            conn.execute("PRAGMA synchronous=NORMAL")
-            migrations.migrate(conn)
+            conn = self.dialect.connect()
             loop.call_soon_threadsafe(started.set_result, None)
         except Exception as e:  # pragma: no cover
             loop.call_soon_threadsafe(started.set_exception, e)
@@ -82,29 +339,45 @@ class Database:
         return await fut
 
     async def execute(self, sql: str, params: Iterable = ()) -> int:
-        def _do(conn: sqlite3.Connection) -> int:
+        def _do(conn) -> int:
             cur = conn.execute(sql, tuple(params))
             return cur.rowcount
 
         return await self.run(_do)
 
     async def executemany(self, sql: str, rows: List[Iterable]) -> None:
-        def _do(conn: sqlite3.Connection) -> None:
+        def _do(conn) -> None:
             conn.executemany(sql, [tuple(r) for r in rows])
 
         await self.run(_do)
 
-    async def fetchall(self, sql: str, params: Iterable = ()) -> List[sqlite3.Row]:
-        def _do(conn: sqlite3.Connection):
+    async def fetchall(self, sql: str, params: Iterable = ()) -> List[Any]:
+        def _do(conn):
             return conn.execute(sql, tuple(params)).fetchall()
 
         return await self.run(_do)
 
-    async def fetchone(self, sql: str, params: Iterable = ()) -> Optional[sqlite3.Row]:
-        def _do(conn: sqlite3.Connection):
+    async def fetchone(self, sql: str, params: Iterable = ()) -> Optional[Any]:
+        def _do(conn):
             return conn.execute(sql, tuple(params)).fetchone()
 
         return await self.run(_do)
+
+    def tx_advisory_lock(self, conn, name: str) -> None:
+        """Inside a db.run() closure: serialize a critical section across
+        server replicas (transaction-scoped; released at commit/rollback)."""
+        self.dialect.tx_advisory_lock(conn, name)
+
+    @asynccontextmanager
+    async def advisory_lock(self, name: str):
+        """Serialize a multi-statement init section across server replicas
+        sharing one postgres database (no-op on sqlite: single process owns
+        the file). Usage: `async with db.advisory_lock("init"): ...`"""
+        await self.run(lambda conn: self.dialect.session_lock(conn, name))
+        try:
+            yield
+        finally:
+            await self.run(lambda conn: self.dialect.session_unlock(conn, name))
 
     async def close(self) -> None:
         if self._thread is not None and not self._closed:

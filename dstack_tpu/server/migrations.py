@@ -226,12 +226,28 @@ MIGRATIONS: List[Tuple[int, str]] = [
 ]
 
 
-def migrate(conn: sqlite3.Connection) -> None:
+def migrate(conn, dialect=None) -> None:
+    """Apply pending migrations. `conn` is a sqlite3.Connection (default) or
+    the postgres connection adapter; `dialect` (server.db dialect object)
+    rewrites/splits the portable DDL for engines without executescript. The
+    DDL itself is authored once: both engines accept the TEXT/INTEGER/REAL
+    columns, partial indexes, and ON CONFLICT clauses used here."""
+    # Multi-replica bootstrap: when several server processes share a postgres
+    # database, only one may apply DDL at a time (reference runs alembic under
+    # an advisory lock for the same reason). The lock comes FIRST — postgres's
+    # CREATE TABLE IF NOT EXISTS is itself racy across sessions, and
+    # pg_advisory_xact_lock needs no table; postgres DDL is transactional so
+    # everything below sits inside the one locked transaction.
+    if dialect is not None:
+        dialect.tx_advisory_lock(conn, "dstack-migrations")
     conn.execute("CREATE TABLE IF NOT EXISTS schema_version (version INTEGER NOT NULL)")
     row = conn.execute("SELECT MAX(version) AS v FROM schema_version").fetchone()
     current = row["v"] if row and row["v"] is not None else 0
     for version, script in MIGRATIONS:
         if version > current:
-            conn.executescript(script)
+            if dialect is not None:
+                dialect.run_script(conn, script)
+            else:
+                conn.executescript(script)
             conn.execute("INSERT INTO schema_version (version) VALUES (?)", (version,))
     conn.commit()

@@ -33,12 +33,9 @@ def make_compute(backend_type: str, config: Optional[dict] = None) -> Compute:
 async def create_backend(db: Database, project_row, config: BackendConfig) -> None:
     make_compute(config.type.value, config.model_dump())  # validates type
     await db.execute(
-        "INSERT OR REPLACE INTO backends (id, project_id, type, config) VALUES ("
-        " COALESCE((SELECT id FROM backends WHERE project_id = ? AND type = ?), ?),"
-        " ?, ?, ?)",
+        "INSERT INTO backends (id, project_id, type, config) VALUES (?, ?, ?, ?)"
+        " ON CONFLICT (project_id, type) DO UPDATE SET config = excluded.config",
         (
-            project_row["id"],
-            config.type.value,
             new_id(),
             project_row["id"],
             config.type.value,

@@ -22,6 +22,10 @@ from dstack_tpu.core.models.configurations import (
 from dstack_tpu.core.models.profiles import Profile
 from dstack_tpu.core.models.runs import JobSpec, Requirements, RunSpec
 
+# Pinned openvscode-server release installed at dev-env start when the image
+# ships no IDE and the host has egress (reference configurators/dev.py:35).
+OPENVSCODE_VERSION = "1.97.2"
+
 DEFAULT_STOP_DURATION = 300
 DEFAULT_MAX_DURATION = {"task": None, "service": None, "dev-environment": 72 * 3600}
 
@@ -129,20 +133,41 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
 
 def _build_commands(conf) -> List[str]:
     if isinstance(conf, DevEnvironmentConfiguration):
-        # init, then an IDE backend on the assigned port (reference
-        # configurators/dev.py installs code-server; zero-egress hosts fall back
-        # to serving the workspace over HTTP so `attach` always has a socket).
+        # init, then an IDE backend on the assigned port. Four-tier chain
+        # (reference configurators/dev.py:35 get_install_commands() downloads
+        # openvscode-server unconditionally — which needs egress at job start):
+        #   1. code-server already in the image (docker/tpu bakes it)
+        #   2. install openvscode-server once (reference parity; needs curl+egress)
+        #   3. the repo's stdlib web IDE (dstack_tpu/ide.py — always works
+        #      air-gapped wherever the package is importable)
+        #   4. bare workspace listing (attach always has a socket)
         # The server keeps the env alive and IS the attach target.
+        ovs = OPENVSCODE_VERSION
         return [
             *conf.init,
             f"echo 'dev environment ready ({conf.ide.value})'",
-            'if command -v code-server >/dev/null 2>&1; then'
-            ' echo "ide: code-server on port $DSTACK_SERVICE_PORT";'
-            ' exec code-server --bind-addr "127.0.0.1:$DSTACK_SERVICE_PORT" --auth none;'
-            " else"
-            ' echo "ide: serving workspace over http on port $DSTACK_SERVICE_PORT";'
-            ' exec python3 -m http.server "$DSTACK_SERVICE_PORT" --bind 127.0.0.1;'
-            " fi",
+            'if command -v code-server >/dev/null 2>&1; then\n'
+            '  echo "ide: code-server on port $DSTACK_SERVICE_PORT"\n'
+            '  exec code-server --bind-addr "127.0.0.1:$DSTACK_SERVICE_PORT" --auth none\n'
+            "fi",
+            'if [ ! -x "$HOME/.dstack-ide/bin/openvscode-server" ]'
+            " && command -v curl >/dev/null 2>&1; then\n"
+            '  mkdir -p "$HOME/.dstack-ide"\n'
+            f'  curl -fsSL --max-time 120 "https://github.com/gitpod-io/openvscode-server/releases/download/openvscode-server-v{ovs}/openvscode-server-v{ovs}-linux-x64.tar.gz"'
+            ' | tar -xz -C "$HOME/.dstack-ide" --strip-components=1'
+            ' || echo "ide: openvscode-server download failed; trying fallbacks"\n'
+            "fi",
+            'if [ -x "$HOME/.dstack-ide/bin/openvscode-server" ]; then\n'
+            '  echo "ide: openvscode-server on port $DSTACK_SERVICE_PORT"\n'
+            '  exec "$HOME/.dstack-ide/bin/openvscode-server" --host 127.0.0.1'
+            ' --port "$DSTACK_SERVICE_PORT" --without-connection-token\n'
+            "fi",
+            'if python3 -c "import dstack_tpu.ide" >/dev/null 2>&1; then\n'
+            '  echo "ide: dstack-tpu web IDE on port $DSTACK_SERVICE_PORT"\n'
+            '  exec python3 -m dstack_tpu.ide --port "$DSTACK_SERVICE_PORT" --root .\n'
+            "fi",
+            'echo "ide: serving workspace over http on port $DSTACK_SERVICE_PORT"',
+            'exec python3 -m http.server "$DSTACK_SERVICE_PORT" --bind 127.0.0.1',
         ]
     if conf.entrypoint:
         # An explicit entrypoint overrides image defaults; commands become its body.

@@ -91,8 +91,9 @@ async def set_members(db: Database, project_name: str, members: List[dict]) -> P
         conn.execute("DELETE FROM members WHERE project_id = ?", (project_id,))
         for user_id, role in resolved:
             conn.execute(
-                "INSERT OR REPLACE INTO members (project_id, user_id, project_role)"
-                " VALUES (?, ?, ?)",
+                "INSERT INTO members (project_id, user_id, project_role)"
+                " VALUES (?, ?, ?) ON CONFLICT (project_id, user_id)"
+                " DO UPDATE SET project_role = excluded.project_role",
                 (project_id, user_id, role),
             )
 

@@ -123,8 +123,8 @@ async def persist_stats(db: Database) -> None:
     def _tx(conn) -> None:
         conn.execute("DELETE FROM service_stats WHERE bucket < ?", (cutoff,))
         conn.executemany(
-            "INSERT OR REPLACE INTO service_stats (run_id, bucket, count)"
-            " VALUES (?, ?, ?)",
+            "INSERT INTO service_stats (run_id, bucket, count) VALUES (?, ?, ?)"
+            " ON CONFLICT (run_id, bucket) DO UPDATE SET count = excluded.count",
             changed,
         )
 

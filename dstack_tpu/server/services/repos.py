@@ -61,7 +61,8 @@ async def upload_code(db: Database, project_row, repo_name: str, blob: bytes) ->
         await store.put(code_blob_key(project_row["id"], repo_name, blob_hash), blob)
         stored_blob = None
     await db.execute(
-        "INSERT OR IGNORE INTO codes (id, repo_id, blob_hash, blob) VALUES (?, ?, ?, ?)",
+        "INSERT INTO codes (id, repo_id, blob_hash, blob) VALUES (?, ?, ?, ?)"
+        " ON CONFLICT (repo_id, blob_hash) DO NOTHING",
         (new_id(), repo_row["id"], blob_hash, stored_blob),
     )
     return blob_hash

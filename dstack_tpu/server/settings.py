@@ -17,7 +17,13 @@ SERVER_DIR = Path(os.getenv("DSTACK_TPU_SERVER_DIR", os.path.expanduser("~/.dsta
 DATA_DIR = SERVER_DIR / "data"
 LOGS_DIR = SERVER_DIR / "logs"
 
-DB_PATH = os.getenv("DSTACK_TPU_DB_PATH", str(DATA_DIR / "server.db"))
+# DSTACK_TPU_DB_URL accepts a postgres:// DSN (multi-replica control plane;
+# reference server/db.py supports both dialects the same way) or a
+# sqlite:///path URL; DSTACK_TPU_DB_PATH remains the plain-path spelling.
+DB_PATH = os.getenv(
+    "DSTACK_TPU_DB_URL",
+    os.getenv("DSTACK_TPU_DB_PATH", str(DATA_DIR / "server.db")),
+)
 
 ADMIN_TOKEN = os.getenv("DSTACK_TPU_SERVER_ADMIN_TOKEN")
 

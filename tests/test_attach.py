@@ -55,23 +55,43 @@ class TestAttachBridge:
                 )
                 local_port = local_srv.sockets[0].getsockname()[1]
 
-                # The http.server fallback serves the workspace: GET / through the
-                # forwarded port must answer (retry while the env's socket binds).
+                # A REAL IDE serves through the forwarded port: with no
+                # code-server and no egress in this env, the configurator's
+                # chain lands on the repo's web IDE (dstack_tpu/ide.py), not a
+                # bare http.server listing (retry while the socket binds).
                 status = None
+                ide_header = None
                 async with aiohttp.ClientSession() as session:
                     for _ in range(60):
                         try:
                             async with session.get(
-                                f"http://127.0.0.1:{local_port}/",
+                                f"http://127.0.0.1:{local_port}/healthcheck",
                                 timeout=aiohttp.ClientTimeout(total=3),
                             ) as resp:
                                 status = resp.status
+                                ide_header = resp.headers.get("X-Dstack-IDE")
+                                health = await resp.json()
                                 if status == 200:
                                     break
                         except aiohttp.ClientError:
                             pass
                         await asyncio.sleep(0.2)
                 assert status == 200
+                assert ide_header == "dstack-tpu", "expected the IDE, not http.server"
+                assert health["ide"] == "dstack-tpu"
+
+                # It is an editor, not a listing: create a file over the
+                # bridge, read it back.
+                async with aiohttp.ClientSession() as session:
+                    async with session.put(
+                        f"http://127.0.0.1:{local_port}/api/file?path=notes/hello.py",
+                        data=b"print('edited in the dev env')",
+                    ) as resp:
+                        assert resp.status == 200
+                    async with session.get(
+                        f"http://127.0.0.1:{local_port}/api/file?path=notes/hello.py"
+                    ) as resp:
+                        assert await resp.text() == "print('edited in the dev env')"
 
                 # While a bridge was open, inactivity was pinned at 0.
                 run_row = await api.db.fetchone("SELECT * FROM runs WHERE run_name = 'dev'")

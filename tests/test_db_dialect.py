@@ -1,0 +1,244 @@
+"""The sqlite/postgres dialect seam in server/db.py.
+
+Parity: reference server/db.py supports both engines behind one session
+interface and uses postgres advisory locks for multi-replica HA init
+(ref services/locking.py, app.py:109-113). The image ships no postgres
+driver, so the live-postgres tests skip cleanly unless a driver AND a
+DSTACK_TPU_TEST_PG_DSN are present; everything else (placeholder
+translation, DDL fixups, URL dispatch, the connection adapter protocol)
+is exercised directly."""
+
+import os
+
+import pytest
+
+from dstack_tpu.server import migrations
+from dstack_tpu.server.db import (
+    Database,
+    PgRow,
+    PostgresDialect,
+    SqliteDialect,
+    _PgConnection,
+    make_dialect,
+    split_script,
+    translate_qmark,
+)
+
+
+def _have_pg_driver() -> bool:
+    try:
+        PostgresDialect._driver()
+        return True
+    except RuntimeError:
+        return False
+
+
+class TestTranslateQmark:
+    def test_basic(self):
+        assert translate_qmark("SELECT * FROM t WHERE a = ? AND b = ?") == (
+            "SELECT * FROM t WHERE a = %s AND b = %s"
+        )
+
+    def test_question_mark_inside_literal_untouched(self):
+        sql = "UPDATE t SET note = 'why?' WHERE id = ?"
+        assert translate_qmark(sql) == "UPDATE t SET note = 'why?' WHERE id = %s"
+
+    def test_escaped_quote_inside_literal(self):
+        sql = "SELECT 'it''s a ?' , ? FROM t"
+        assert translate_qmark(sql) == "SELECT 'it''s a ?' , %s FROM t"
+
+    def test_no_placeholders(self):
+        assert translate_qmark("SELECT 1") == "SELECT 1"
+
+
+class TestScriptHandling:
+    def test_split_script(self):
+        script = """
+        CREATE TABLE a (x TEXT);
+        CREATE INDEX ix ON a(x);
+        """
+        assert split_script(script) == [
+            "CREATE TABLE a (x TEXT)",
+            "CREATE INDEX ix ON a(x)",
+        ]
+
+    def test_split_ignores_semicolons_in_literals(self):
+        script = "INSERT INTO a VALUES ('x;y');CREATE TABLE b (z TEXT)"
+        assert split_script(script) == [
+            "INSERT INTO a VALUES ('x;y')",
+            "CREATE TABLE b (z TEXT)",
+        ]
+
+    def test_blob_becomes_bytea(self):
+        d = PostgresDialect("postgresql://ignored")
+        assert d.fixup_ddl("blob BLOB,") == "blob BYTEA,"
+        assert "BLOB" not in d.fixup_ddl("\n".join(s for _, s in migrations.MIGRATIONS))
+
+    def test_migration_ddl_splits_cleanly(self):
+        # Every migration script must survive the statement splitter: no
+        # triggers/procedural bodies with embedded semicolons.
+        for _version, script in migrations.MIGRATIONS:
+            for stmt in split_script(script):
+                assert stmt.upper().startswith(("CREATE", "ALTER", "INSERT", "DROP")), stmt
+
+
+class TestDialectDispatch:
+    def test_urls(self):
+        assert isinstance(make_dialect(":memory:"), SqliteDialect)
+        assert isinstance(make_dialect("/tmp/x.db"), SqliteDialect)
+        assert isinstance(make_dialect("sqlite:///tmp/x.db"), SqliteDialect)
+        assert isinstance(make_dialect("postgres://u@h/db"), PostgresDialect)
+        assert isinstance(make_dialect("postgresql://u@h/db"), PostgresDialect)
+
+    def test_sqlite_url_strips_scheme(self):
+        assert make_dialect("sqlite:///tmp/x.db").path == "tmp/x.db"
+
+    @pytest.mark.skipif(_have_pg_driver(), reason="a postgres driver is installed")
+    def test_missing_driver_is_a_clear_error(self):
+        with pytest.raises(RuntimeError, match="no driver"):
+            PostgresDialect("postgresql://u@h/db").connect()
+
+
+class TestPgRow:
+    def test_dual_access(self):
+        row = PgRow(["id", "name"], ["u1", "alice"])
+        assert row["id"] == "u1"
+        assert row[1] == "alice"
+        assert row.keys() == ["id", "name"]
+        assert list(row) == ["u1", "alice"]
+        with pytest.raises(KeyError):
+            row["missing"]
+
+
+class _StubCursor:
+    def __init__(self, log):
+        self.log = log
+        self.description = [("a",), ("b",)]
+        self.rowcount = 1
+
+    def execute(self, sql, params=()):
+        self.log.append(("execute", sql, params))
+
+    def executemany(self, sql, rows):
+        self.log.append(("executemany", sql, rows))
+
+    def fetchone(self):
+        return (1, 2)
+
+    def fetchall(self):
+        return [(1, 2), (3, 4)]
+
+
+class _StubRaw:
+    def __init__(self):
+        self.log = []
+
+    def cursor(self):
+        return _StubCursor(self.log)
+
+    def commit(self):
+        self.log.append(("commit",))
+
+    def rollback(self):
+        self.log.append(("rollback",))
+
+    def close(self):
+        self.log.append(("close",))
+
+
+class TestPgConnectionAdapter:
+    def test_execute_translates_and_wraps_rows(self):
+        raw = _StubRaw()
+        conn = _PgConnection(raw)
+        cur = conn.execute("SELECT a, b FROM t WHERE a = ?", ("x",))
+        assert raw.log == [("execute", "SELECT a, b FROM t WHERE a = %s", ("x",))]
+        row = cur.fetchone()
+        assert row["a"] == 1 and row["b"] == 2
+        assert [r["b"] for r in cur.fetchall()] == [2, 4]
+        assert cur.rowcount == 1
+
+    def test_executemany_translates(self):
+        raw = _StubRaw()
+        _PgConnection(raw).executemany("INSERT INTO t VALUES (?, ?)", [(1, 2), (3, 4)])
+        assert raw.log == [("executemany", "INSERT INTO t VALUES (%s, %s)", [(1, 2), (3, 4)])]
+
+    def test_advisory_lock_sql(self):
+        raw = _StubRaw()
+        d = PostgresDialect("postgresql://ignored")
+        d.tx_advisory_lock(_PgConnection(raw), "server-init")
+        assert raw.log[0][1] == "SELECT pg_advisory_xact_lock(hashtext(%s))"
+        d.session_lock(_PgConnection(raw), "server-init")
+        d.session_unlock(_PgConnection(raw), "server-init")
+        assert [e[1] for e in raw.log[1:]] == [
+            "SELECT pg_advisory_lock(hashtext(%s))",
+            "SELECT pg_advisory_unlock(hashtext(%s))",
+        ]
+
+
+class TestSqliteAdvisoryLockNoop:
+    async def test_advisory_lock_context_is_usable(self):
+        db = Database(":memory:")
+        await db.connect()
+        try:
+            async with db.advisory_lock("server-init"):
+                await db.execute(
+                    "INSERT INTO users (id, username, token, created_at)"
+                    " VALUES (?, ?, ?, ?)",
+                    ("u1", "alice", "tok", "2026-01-01"),
+                )
+            row = await db.fetchone("SELECT username FROM users WHERE id = ?", ("u1",))
+            assert row["username"] == "alice"
+        finally:
+            await db.close()
+
+    async def test_portable_upserts_run_on_sqlite(self):
+        """The ON CONFLICT statements services now use must work on sqlite."""
+        db = Database(":memory:")
+        await db.connect()
+        try:
+            await db.execute(
+                "INSERT INTO service_stats (run_id, bucket, count) VALUES (?, ?, ?)"
+                " ON CONFLICT (run_id, bucket) DO UPDATE SET count = excluded.count",
+                ("r1", 10, 1),
+            )
+            await db.execute(
+                "INSERT INTO service_stats (run_id, bucket, count) VALUES (?, ?, ?)"
+                " ON CONFLICT (run_id, bucket) DO UPDATE SET count = excluded.count",
+                ("r1", 10, 7),
+            )
+            row = await db.fetchone(
+                "SELECT count FROM service_stats WHERE run_id = ? AND bucket = ?",
+                ("r1", 10),
+            )
+            assert row["count"] == 7
+        finally:
+            await db.close()
+
+
+PG_DSN = os.getenv("DSTACK_TPU_TEST_PG_DSN")
+
+
+@pytest.mark.skipif(
+    not (_have_pg_driver() and PG_DSN),
+    reason="needs a postgres driver and DSTACK_TPU_TEST_PG_DSN",
+)
+class TestLivePostgres:
+    """Runs only where a real postgres is available (not in this image)."""
+
+    async def test_migrate_crud_upsert_and_locks(self):
+        db = Database(PG_DSN)
+        await db.connect()
+        try:
+            async with db.advisory_lock("pg-e2e"):
+                await db.execute(
+                    "INSERT INTO users (id, username, token, created_at)"
+                    " VALUES (?, ?, ?, ?) ON CONFLICT (username) DO NOTHING",
+                    ("u-pg", "pg-user", "tok-pg", "2026-01-01"),
+                )
+            row = await db.fetchone(
+                "SELECT username FROM users WHERE id = ?", ("u-pg",)
+            )
+            assert row["username"] == "pg-user"
+        finally:
+            await db.execute("DELETE FROM users WHERE id = ?", ("u-pg",))
+            await db.close()

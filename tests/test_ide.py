@@ -1,0 +1,140 @@
+"""The stdlib web IDE served into dev environments (dstack_tpu/ide.py).
+
+Parity: the reference delivers an IDE backend at dev-env start
+(ref server/services/jobs/configurators/dev.py:35); this is the air-gapped
+tier of that chain, so it must behave like an editor (tree/read/write) and
+refuse to escape the workspace."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dstack_tpu import ide
+
+
+@pytest.fixture
+def ide_server(tmp_path):
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "train.py").write_text("import jax\n")
+    (tmp_path / "README.md").write_text("hello\n")
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "HEAD").write_text("ref: refs/heads/main\n")
+    server = ide.serve(0, str(tmp_path))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}", tmp_path
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _put(url, body):
+    req = urllib.request.Request(url, data=body, method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestIde:
+    def test_page_health_and_identity(self, ide_server):
+        base, _ = ide_server
+        status, headers, body = _get(base + "/")
+        assert status == 200 and b"dstack-tpu IDE" in body
+        assert headers["X-Dstack-IDE"] == "dstack-tpu"
+        status, _, body = _get(base + "/healthcheck")
+        assert json.loads(body)["ide"] == "dstack-tpu"
+
+    def test_tree_lists_files_and_skips_dotdirs(self, ide_server):
+        base, _ = ide_server
+        _, _, body = _get(base + "/api/tree")
+        items = json.loads(body)
+        paths = [i["path"] for i in items]
+        assert "README.md" in paths
+        assert "src/train.py" in paths
+        assert not any(p.startswith(".git") for p in paths)
+        depth = {i["path"]: i["depth"] for i in items}
+        assert depth["src/train.py"] == 1
+
+    def test_read_write_roundtrip(self, ide_server):
+        base, tmp_path = ide_server
+        status, _, body = _get(base + "/api/file?path=src/train.py")
+        assert (status, body) == (200, b"import jax\n")
+        status, _ = _put(base + "/api/file?path=src/train.py", b"import jax.numpy\n")
+        assert status == 200
+        assert (tmp_path / "src" / "train.py").read_bytes() == b"import jax.numpy\n"
+
+    def test_create_in_new_directory(self, ide_server):
+        base, tmp_path = ide_server
+        status, _ = _put(base + "/api/file?path=new/deep/file.txt", b"x")
+        assert status == 200
+        assert (tmp_path / "new" / "deep" / "file.txt").read_text() == "x"
+
+    def test_traversal_rejected(self, ide_server):
+        base, _ = ide_server
+        status, _ = _put(base + "/api/file?path=../escape.txt", b"nope")
+        assert status == 403
+        req = urllib.request.Request(base + "/api/file?path=%2e%2e%2fetc%2fpasswd")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status in (403, 404)
+
+    def test_cross_origin_write_rejected(self, ide_server):
+        """CSRF guard: a write carrying a foreign Origin must be refused, and
+        POST (which skips CORS preflight cross-site) must not write at all."""
+        base, tmp_path = ide_server
+        req = urllib.request.Request(
+            base + "/api/file?path=evil.py", data=b"pwned", method="PUT",
+            headers={"Origin": "http://evil.example"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 403
+        assert not (tmp_path / "evil.py").exists()
+
+        req = urllib.request.Request(
+            base + "/api/file?path=evil.py", data=b"pwned", method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 501  # no POST handler at all
+        assert not (tmp_path / "evil.py").exists()
+
+    def test_same_origin_write_allowed(self, ide_server):
+        base, tmp_path = ide_server
+        host = base[len("http://"):]
+        req = urllib.request.Request(
+            base + "/api/file?path=ok.py", data=b"fine", method="PUT",
+            headers={"Origin": f"http://{host}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        assert (tmp_path / "ok.py").read_text() == "fine"
+
+    def test_missing_file_404(self, ide_server):
+        base, _ = ide_server
+        try:
+            urllib.request.urlopen(base + "/api/file?path=nope.txt", timeout=5)
+            status = 200
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404
