@@ -1,0 +1,124 @@
+"""Hugging Face fine-tuning sugar for the Python SDK.
+
+Parity: reference src/dstack/api/huggingface/__init__.py:6 — a
+`SFTFineTuningTask` that packages model/dataset/hyperparameters into a
+ready-to-submit Task so users fine-tune without writing a configuration.
+TPU re-design: the reference's knobs are CUDA-shaped (4-bit bitsandbytes
+quantization, paged optimizers); on TPU the natural knobs are bf16 (MXU
+native), LoRA, and a slice topology, and the generated commands run TRL's
+maintained `trl sft` entrypoint against the requested accelerator.
+
+Usage::
+
+    from dstack_tpu.api import Client
+    from dstack_tpu.api.huggingface import SFTFineTuningTask
+
+    task = SFTFineTuningTask(
+        model_name="google/gemma-2b",
+        dataset_name="tatsu-lab/alpaca",
+        env={"HF_TOKEN": "..."},
+        tpu="v5litepod-8",
+    )
+    client.runs.submit({"run_name": "sft", "configuration": task.dict()})
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dstack_tpu.core.models.configurations import TaskConfiguration
+
+_TOKEN_VARS = ("HF_TOKEN", "HUGGING_FACE_HUB_TOKEN")
+
+
+def SFTFineTuningTask(
+    model_name: str,
+    dataset_name: str,
+    env: Dict[str, str],
+    new_model_name: Optional[str] = None,
+    tpu: Optional[str] = None,
+    report_to: Optional[str] = None,
+    per_device_train_batch_size: int = 4,
+    gradient_accumulation_steps: int = 1,
+    learning_rate: float = 2e-4,
+    weight_decay: float = 0.001,
+    lora: bool = True,
+    lora_r: int = 64,
+    lora_alpha: int = 16,
+    lora_dropout: float = 0.1,
+    max_seq_length: Optional[int] = None,
+    num_train_epochs: float = 1,
+    max_steps: int = -1,
+    bf16: bool = True,
+    gradient_checkpointing: bool = True,
+    warmup_ratio: float = 0.03,
+    logging_steps: int = 25,
+    save_steps: int = 0,
+) -> TaskConfiguration:
+    """Build a supervised-fine-tuning TaskConfiguration (TRL ``trl sft``).
+
+    ``env`` must carry an HF token (HF_TOKEN or HUGGING_FACE_HUB_TOKEN) so
+    gated models/datasets resolve and the tuned model can push back to the
+    hub as ``new_model_name``; ``report_to="wandb"`` additionally requires
+    WANDB_API_KEY — both validated here, at authoring time, the same contract
+    the reference enforces.
+    """
+    if not any(v in env for v in _TOKEN_VARS):
+        raise ValueError(
+            "env must include HF_TOKEN (or HUGGING_FACE_HUB_TOKEN) — needed for"
+            " gated models and to push the fine-tuned model"
+        )
+    if report_to == "wandb" and "WANDB_API_KEY" not in env:
+        raise ValueError('report_to="wandb" requires WANDB_API_KEY in env')
+    if report_to not in (None, "none", "wandb", "tensorboard"):
+        raise ValueError(f"unsupported report_to: {report_to!r}")
+
+    output_dir = "./sft-output"
+    args: List[str] = [
+        f"--model_name_or_path {model_name}",
+        f"--dataset_name {dataset_name}",
+        f"--output_dir {output_dir}",
+        f"--per_device_train_batch_size {per_device_train_batch_size}",
+        f"--gradient_accumulation_steps {gradient_accumulation_steps}",
+        f"--learning_rate {learning_rate}",
+        f"--weight_decay {weight_decay}",
+        f"--num_train_epochs {num_train_epochs}",
+        f"--warmup_ratio {warmup_ratio}",
+        f"--logging_steps {logging_steps}",
+    ]
+    if max_steps > 0:
+        args.append(f"--max_steps {max_steps}")
+    if max_seq_length:
+        args.append(f"--max_seq_length {max_seq_length}")
+    if bf16:
+        args.append("--bf16 True")
+    if gradient_checkpointing:
+        args.append("--gradient_checkpointing True")
+    if save_steps > 0:
+        args.append(f"--save_steps {save_steps}")
+    if lora:
+        args += [
+            "--use_peft",
+            f"--lora_r {lora_r}",
+            f"--lora_alpha {lora_alpha}",
+            f"--lora_dropout {lora_dropout}",
+        ]
+    if report_to:
+        args.append(f"--report_to {report_to}")
+    if new_model_name:
+        args += ["--push_to_hub", f"--hub_model_id {new_model_name}"]
+
+    arg_str = " ".join(args)
+    commands = [
+        "pip install -q 'trl>=0.8' peft datasets",
+        f"trl sft {arg_str}",
+    ]
+
+    conf: Dict = {
+        "type": "task",
+        "commands": commands,
+        "env": env,
+    }
+    if tpu:
+        conf["resources"] = {"tpu": tpu}
+    return TaskConfiguration.model_validate(conf)

@@ -171,7 +171,19 @@ class IdeHandler(BaseHTTPRequestHandler):
                 self._send(403, b"cross-origin write rejected")
                 return
         rel = self._query().get("path", "")
-        length = int(self.headers.get("Content-Length") or 0)
+        # Content-Length is client input: absent/chunked would silently write
+        # an empty file, negative would read to EOF past the size cap.
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            self._send(411, b"chunked uploads not supported; send Content-Length")
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or "")
+        except ValueError:
+            self._send(411, b"missing or invalid Content-Length")
+            return
+        if length < 0:
+            self._send(411, b"missing or invalid Content-Length")
+            return
         if length > MAX_FILE_BYTES:
             self._send(413, b"file too large for the editor")
             return

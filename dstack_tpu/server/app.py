@@ -37,6 +37,11 @@ logger = logging.getLogger(__name__)
 
 
 async def _on_startup(app: web.Application) -> None:
+    # Error reporting first (reference app.py:81-89 inits Sentry before the
+    # rest of the lifespan): startup failures below should be reported too.
+    from dstack_tpu.server.services import error_reporting
+
+    error_reporting.setup()
     db: Database = app["db"]
     await db.connect()  # runs migrations
     if settings.ENCRYPTION_KEYS:

@@ -215,6 +215,9 @@ class _PgConnection:
 
 _PG_DDL_FIXUPS = [
     (re.compile(r"\bBLOB\b"), "BYTEA"),
+    # sqlite INTEGER is 64-bit; postgres INTEGER is int4, which byte counters
+    # (HBM/memory usage) and cumulative CPU-microsecond columns overflow.
+    (re.compile(r"\bINTEGER\b"), "BIGINT"),
 ]
 
 

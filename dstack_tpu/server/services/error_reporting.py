@@ -1,0 +1,138 @@
+"""Env-gated error reporting for the control plane.
+
+Parity: reference server/app.py:81-89 — `sentry_sdk.init` when SENTRY_DSN is
+set, tagging release + deployment environment. Two tiers here:
+
+- ``DSTACK_TPU_SENTRY_DSN``: init sentry_sdk when the package is importable
+  (it is not bundled; setting the var without it logs a warning and degrades).
+- ``DSTACK_TPU_ERROR_REPORT_URL``: SDK-free tier in the repo's house style —
+  a logging handler that ships every ERROR-or-worse record (message +
+  traceback + release) as a JSON POST from a background thread, so any
+  `logger.exception` in the middleware, services, or background loops reaches
+  the operator's webhook/collector without blocking the event loop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+import urllib.request
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ReportHandler(logging.Handler):
+    """Queue + drain thread: emit() never blocks, delivery failures are
+    dropped silently (error reporting must never take the server down)."""
+
+    def __init__(self, url: str, max_queue: int = 256, timeout: float = 5.0):
+        super().__init__(level=logging.ERROR)
+        self.url = url
+        self.timeout = timeout
+        self.delivered = 0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._thread = threading.Thread(
+            target=self._pump, name="error-report", daemon=True
+        )
+        self._thread.start()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        import dstack_tpu
+
+        tb = None
+        if record.exc_info and record.exc_info[0] is not None:
+            tb = "".join(traceback.format_exception(*record.exc_info))
+        payload = {
+            "logger": record.name,
+            "level": record.levelname,
+            "message": record.getMessage(),
+            "traceback": tb,
+            "release": dstack_tpu.__version__,
+            "environment": os.getenv("DSTACK_TPU_DEPLOYMENT_ENV", "production"),
+            "timestamp": time.time(),
+        }
+        try:
+            self._queue.put_nowait(payload)
+        except queue.Full:
+            pass  # shed under a log storm; reporting must not amplify it
+
+    def _pump(self) -> None:
+        while True:
+            payload = self._queue.get()
+            if payload is None:
+                return
+            try:
+                req = urllib.request.Request(
+                    self.url,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=self.timeout):
+                    self.delivered += 1
+            except Exception:
+                pass
+
+    def drain(self, deadline: float = 2.0) -> None:
+        """Best effort flush (tests / shutdown)."""
+        end = time.time() + deadline
+        while not self._queue.empty() and time.time() < end:
+            time.sleep(0.02)
+
+    def stop(self) -> None:
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
+
+
+_handler: Optional[ReportHandler] = None
+
+
+def setup() -> Optional[str]:
+    """Install the configured reporter; returns which tier activated."""
+    global _handler
+    dsn = os.getenv("DSTACK_TPU_SENTRY_DSN")
+    if dsn:
+        try:
+            import sentry_sdk  # type: ignore
+
+            import dstack_tpu
+
+            sentry_sdk.init(
+                dsn=dsn,
+                release=dstack_tpu.__version__,
+                environment=os.getenv("DSTACK_TPU_DEPLOYMENT_ENV", "production"),
+            )
+            logger.info("error reporting: sentry enabled")
+            return "sentry"
+        except ImportError:
+            logger.warning(
+                "DSTACK_TPU_SENTRY_DSN is set but sentry_sdk is not installed;"
+                " falling back to DSTACK_TPU_ERROR_REPORT_URL if configured"
+            )
+        except Exception:
+            # A typo'd DSN (sentry raises BadDsn) must not stop the control
+            # plane from booting over a non-essential reporting feature.
+            logger.exception("sentry init failed; continuing without it")
+    url = os.getenv("DSTACK_TPU_ERROR_REPORT_URL")
+    if url:
+        if _handler is None:
+            _handler = ReportHandler(url)
+            logging.getLogger().addHandler(_handler)
+        logger.info("error reporting: POSTing ERROR records to %s", url)
+        return "http"
+    return None
+
+
+def teardown() -> None:
+    global _handler
+    if _handler is not None:
+        logging.getLogger().removeHandler(_handler)
+        _handler.stop()
+        _handler = None

@@ -150,12 +150,19 @@ def _build_commands(conf) -> List[str]:
             '  echo "ide: code-server on port $DSTACK_SERVICE_PORT"\n'
             '  exec code-server --bind-addr "127.0.0.1:$DSTACK_SERVICE_PORT" --auth none\n'
             "fi",
+            # Extract into a temp dir and promote atomically: an interrupted
+            # download must not leave a half-install that [ -x ] mistakes for
+            # complete (wedging the env until ~/.dstack-ide is deleted).
             'if [ ! -x "$HOME/.dstack-ide/bin/openvscode-server" ]'
             " && command -v curl >/dev/null 2>&1; then\n"
-            '  mkdir -p "$HOME/.dstack-ide"\n'
-            f'  curl -fsSL --max-time 120 "https://github.com/gitpod-io/openvscode-server/releases/download/openvscode-server-v{ovs}/openvscode-server-v{ovs}-linux-x64.tar.gz"'
-            ' | tar -xz -C "$HOME/.dstack-ide" --strip-components=1'
-            ' || echo "ide: openvscode-server download failed; trying fallbacks"\n'
+            '  rm -rf "$HOME/.dstack-ide.tmp" && mkdir -p "$HOME/.dstack-ide.tmp"\n'
+            f'  if curl -fsSL --max-time 120 "https://github.com/gitpod-io/openvscode-server/releases/download/openvscode-server-v{ovs}/openvscode-server-v{ovs}-linux-x64.tar.gz"'
+            ' | tar -xz -C "$HOME/.dstack-ide.tmp" --strip-components=1; then\n'
+            '    rm -rf "$HOME/.dstack-ide" && mv "$HOME/.dstack-ide.tmp" "$HOME/.dstack-ide"\n'
+            "  else\n"
+            '    rm -rf "$HOME/.dstack-ide.tmp"\n'
+            '    echo "ide: openvscode-server download failed; trying fallbacks"\n'
+            "  fi\n"
             "fi",
             'if [ -x "$HOME/.dstack-ide/bin/openvscode-server" ]; then\n'
             '  echo "ide: openvscode-server on port $DSTACK_SERVICE_PORT"\n'

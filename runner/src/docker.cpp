@@ -123,9 +123,13 @@ struct Conn {
   }
 
   // Reads until `delim` appears; returns content before delim, consumes it.
-  bool read_until(const std::string& delim, std::string* out, int timeout_sec) {
+  // `max_bytes` bounds buffering: a daemon that streams endless bytes with no
+  // delimiter (hostile or broken) must not balloon memory — fail instead.
+  bool read_until(const std::string& delim, std::string* out, int timeout_sec,
+                  size_t max_bytes = 1 << 20) {
     size_t pos;
     while ((pos = buffered.find(delim)) == std::string::npos) {
+      if (buffered.size() > max_bytes) return false;
       char buf[8192];
       ssize_t n = read_some(fd, buf, sizeof(buf), timeout_sec);
       if (n <= 0) return false;
@@ -254,6 +258,9 @@ HttpResult DockerClient::request(const std::string& method, const std::string& p
       if (!conn.read_until("\r\n", &size_line, timeout_sec)) break;
       long chunk = strtol(size_line.c_str(), nullptr, 16);
       if (chunk <= 0) break;
+      // A hostile/corrupt size line (e.g. "FFFFFFFFFFFFFFF") must not turn
+      // into an exabyte read_n that buffers until timeout.
+      if (chunk > (1L << 30)) break;
       if (!conn.read_n(static_cast<size_t>(chunk), capture, body_sink, timeout_sec)) break;
       std::string crlf;
       conn.read_until("\r\n", &crlf, timeout_sec);
@@ -264,6 +271,16 @@ HttpResult DockerClient::request(const std::string& method, const std::string& p
     conn.read_all(capture, body_sink, timeout_sec);
   }
   return out;
+}
+
+// Daemon bytes are untrusted input: a malformed body must surface as the
+// client's own error type, not leak the JSON parser's runtime_error upward.
+static dj::Json parse_engine_json(const std::string& body, const std::string& what) {
+  try {
+    return dj::Json::parse(body);
+  } catch (const std::exception&) {
+    throw DockerError(what + ": malformed JSON from engine");
+  }
 }
 
 static std::string api_error(const HttpResult& r, const std::string& what) {
@@ -391,19 +408,19 @@ dj::Json DockerClient::list_containers(const std::string& label) {
   HttpResult r = request(
       "GET", "/containers/json?all=1&filters=" + url_escape(filters.dump()), "");
   if (r.status != 200) throw DockerError(api_error(r, "listing containers"));
-  return dj::Json::parse(r.body);
+  return parse_engine_json(r.body, "listing containers");
 }
 
 dj::Json DockerClient::inspect_container(const std::string& id) {
   HttpResult r = request("GET", "/containers/" + id + "/json", "");
   if (r.status != 200) throw DockerError(api_error(r, "inspecting container"));
-  return dj::Json::parse(r.body);
+  return parse_engine_json(r.body, "inspecting container");
 }
 
 dj::Json DockerClient::container_stats(const std::string& id) {
   HttpResult r = request("GET", "/containers/" + id + "/stats?stream=false", "", {}, nullptr, 30);
   if (r.status != 200) throw DockerError(api_error(r, "reading container stats"));
-  return dj::Json::parse(r.body);
+  return parse_engine_json(r.body, "reading container stats");
 }
 
 }  // namespace ddocker

@@ -95,11 +95,15 @@ class Json {
 
   static Json parse(const std::string& text) {
     size_t pos = 0;
-    Json v = parse_value(text, pos);
+    Json v = parse_value(text, pos, 0);
     skip_ws(text, pos);
     if (pos != text.size()) throw std::runtime_error("trailing JSON content");
     return v;
   }
+
+  // Daemon/server bytes are untrusted; recursion must be bounded or a hostile
+  // "[[[[..." overflows the stack instead of throwing.
+  static constexpr int kMaxDepth = 192;
 
  private:
   void write(std::ostringstream& os) const {
@@ -168,12 +172,13 @@ class Json {
     while (p < t.size() && std::isspace(static_cast<unsigned char>(t[p]))) ++p;
   }
 
-  static Json parse_value(const std::string& t, size_t& p) {
+  static Json parse_value(const std::string& t, size_t& p, int depth) {
+    if (depth > kMaxDepth) throw std::runtime_error("JSON nesting too deep");
     skip_ws(t, p);
     if (p >= t.size()) throw std::runtime_error("unexpected end of JSON");
     char c = t[p];
-    if (c == '{') return parse_object(t, p);
-    if (c == '[') return parse_array(t, p);
+    if (c == '{') return parse_object(t, p, depth);
+    if (c == '[') return parse_array(t, p, depth);
     if (c == '"') return Json(parse_string(t, p));
     if (c == 't' || c == 'f') return parse_bool(t, p);
     if (c == 'n') {
@@ -207,7 +212,13 @@ class Json {
       ++p;
     }
     if (p == start) throw std::runtime_error("invalid JSON number");
-    return Json(std::stod(t.substr(start, p - start)));
+    // stod throws invalid_argument/out_of_range, which would escape the
+    // parser's runtime_error contract on inputs like "-" or "1e999999".
+    try {
+      return Json(std::stod(t.substr(start, p - start)));
+    } catch (const std::exception&) {
+      throw std::runtime_error("invalid JSON number");
+    }
   }
 
   static std::string parse_string(const std::string& t, size_t& p) {
@@ -230,6 +241,12 @@ class Json {
           case '\\': out += '\\'; break;
           case 'u': {
             if (p + 4 >= t.size()) throw std::runtime_error("bad \\u escape");
+            for (size_t h = p + 1; h <= p + 4; ++h) {
+              if (!std::isxdigit(static_cast<unsigned char>(t[h]))) {
+                throw std::runtime_error("bad \\u escape");  // stoul would
+                // otherwise throw invalid_argument or parse a hex prefix
+              }
+            }
             unsigned long cp = std::stoul(t.substr(p + 1, 4), nullptr, 16);
             p += 4;
             // Combine UTF-16 surrogate pairs (python json.dumps with ensure_ascii
@@ -279,7 +296,7 @@ class Json {
     return out;
   }
 
-  static Json parse_array(const std::string& t, size_t& p) {
+  static Json parse_array(const std::string& t, size_t& p, int depth) {
     ++p;
     JsonArray arr;
     skip_ws(t, p);
@@ -288,7 +305,7 @@ class Json {
       return Json(std::move(arr));
     }
     while (true) {
-      arr.push_back(parse_value(t, p));
+      arr.push_back(parse_value(t, p, depth + 1));
       skip_ws(t, p);
       if (p >= t.size()) throw std::runtime_error("unterminated array");
       if (t[p] == ',') {
@@ -304,7 +321,7 @@ class Json {
     return Json(std::move(arr));
   }
 
-  static Json parse_object(const std::string& t, size_t& p) {
+  static Json parse_object(const std::string& t, size_t& p, int depth) {
     ++p;
     JsonObject obj;
     skip_ws(t, p);
@@ -319,7 +336,7 @@ class Json {
       skip_ws(t, p);
       if (p >= t.size() || t[p] != ':') throw std::runtime_error("expected :");
       ++p;
-      obj[key] = parse_value(t, p);
+      obj[key] = parse_value(t, p, depth + 1);
       skip_ws(t, p);
       if (p >= t.size()) throw std::runtime_error("unterminated object");
       if (t[p] == ',') {

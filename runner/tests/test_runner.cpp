@@ -4,6 +4,9 @@
 // same surface with 1,957 LoC of Go tests, runner/internal/executor/executor_test.go).
 //
 // Build + run: `make test` in runner/.
+#include <csignal>
+#include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <chrono>
@@ -298,6 +301,72 @@ void test_json_roundtrip() {
   CHECK(threw);
 }
 
+// Every malformed input must surface as std::runtime_error — never a
+// different exception type (stod/stoul leak invalid_argument), never a
+// crash (unbounded recursion), never silent acceptance.
+#define CHECK_JSON_REJECTED(text)                                                \
+  do {                                                                           \
+    ++g_checks;                                                                  \
+    bool ok = false;                                                             \
+    try {                                                                        \
+      dj::Json::parse(text);                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s accepted\n", __FILE__, __LINE__, #text);   \
+    } catch (const std::runtime_error&) {                                        \
+      ok = true;                                                                 \
+    } catch (const std::exception& e) {                                          \
+      fprintf(stderr, "FAIL %s:%d: %s threw %s, not runtime_error\n", __FILE__,  \
+              __LINE__, #text, e.what());                                        \
+    }                                                                            \
+    if (!ok) ++g_failures;                                                       \
+  } while (0)
+
+void test_json_adversarial() {
+  // Truncation at every structural point.
+  CHECK_JSON_REJECTED("");
+  CHECK_JSON_REJECTED("{");
+  CHECK_JSON_REJECTED("[");
+  CHECK_JSON_REJECTED("{\"a\"");
+  CHECK_JSON_REJECTED("{\"a\":");
+  CHECK_JSON_REJECTED("[1,");
+  CHECK_JSON_REJECTED("\"unterminated");
+  CHECK_JSON_REJECTED("\"ends with backslash\\");
+  // Bad escapes — including non-hex \u, which stoul would mis-handle.
+  CHECK_JSON_REJECTED("\"\\x\"");
+  CHECK_JSON_REJECTED("\"\\u12\"");
+  CHECK_JSON_REJECTED("\"\\uzzzz\"");
+  CHECK_JSON_REJECTED("\"\\u12g4\"");
+  // Numbers that break std::stod's contract.
+  CHECK_JSON_REJECTED("-");
+  CHECK_JSON_REJECTED("+");
+  CHECK_JSON_REJECTED("1e999999");
+  CHECK_JSON_REJECTED("--5");
+  // Structure garbage.
+  CHECK_JSON_REJECTED("{\"a\" 1}");
+  CHECK_JSON_REJECTED("{1: 2}");
+  CHECK_JSON_REJECTED("[1 2]");
+  CHECK_JSON_REJECTED("{} trailing");
+  CHECK_JSON_REJECTED("tru");
+  CHECK_JSON_REJECTED("nul");
+  // Hostile nesting: must throw, not overflow the stack.
+  std::string deep(100000, '[');
+  CHECK_JSON_REJECTED(deep);
+  std::string deep_obj;
+  for (int i = 0; i < 50000; ++i) deep_obj += "{\"a\":";
+  CHECK_JSON_REJECTED(deep_obj);
+  // Near the limit is still fine.
+  std::string ok_nest;
+  for (int i = 0; i < 100; ++i) ok_nest += "[";
+  ok_nest += "1";
+  for (int i = 0; i < 100; ++i) ok_nest += "]";
+  dj::Json v = dj::Json::parse(ok_nest);
+  CHECK(v.is_array());
+  // Lone surrogates fold to U+FFFD instead of emitting invalid UTF-8.
+  CHECK_EQ(dj::Json::parse("\"\\ud800\"").as_string(), std::string("\xEF\xBF\xBD"));
+  CHECK_EQ(dj::Json::parse("\"\\udc00x\"").as_string(), std::string("\xEF\xBF\xBDx"));
+  // And a valid pair still decodes.
+  CHECK_EQ(dj::Json::parse("\"\\ud83d\\ude00\"").as_string(), std::string("\xF0\x9F\x98\x80"));
+}
+
 void test_docker_helpers() {
   CHECK_EQ(ddocker::url_escape("repo/img:1.0"), std::string("repo%2Fimg%3A1.0"));
   // base64 of the credentials object (dj::Json orders keys alphabetically).
@@ -308,6 +377,136 @@ void test_docker_helpers() {
   // hits the 62nd code point must encode with '-' (url alphabet), never '+'.
   CHECK_EQ(ddocker::encode_registry_auth("u", "p>?~"),
            std::string("eyJwYXNzd29yZCI6InA-P34iLCJ1c2VybmFtZSI6InUifQ=="));
+}
+
+// A scripted Docker-Engine stand-in: accepts one AF_UNIX connection, reads
+// the request head, writes `response` verbatim, closes. Lets the chunked
+// transfer decoder in DockerClient::request face hostile daemon bytes.
+struct FakeEngine {
+  std::string sock_path;
+  int listen_fd = -1;
+  std::thread th;
+
+  explicit FakeEngine(std::string response) {
+    sock_path = temp_dir() + "/engine.sock";
+    listen_fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
+    bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    listen(listen_fd, 1);
+    th = std::thread([fd = listen_fd, response = std::move(response)] {
+      int c = accept(fd, nullptr, nullptr);
+      if (c < 0) return;
+      std::string req;
+      char buf[4096];
+      while (req.find("\r\n\r\n") == std::string::npos) {
+        ssize_t n = read(c, buf, sizeof(buf));
+        if (n <= 0) break;
+        req.append(buf, static_cast<size_t>(n));
+      }
+      size_t off = 0;
+      while (off < response.size()) {
+        ssize_t n = write(c, response.data() + off, response.size() - off);
+        if (n <= 0) break;
+        off += static_cast<size_t>(n);
+      }
+      close(c);
+    });
+  }
+
+  ~FakeEngine() {
+    th.join();
+    close(listen_fd);
+    unlink(sock_path.c_str());
+  }
+};
+
+// Streams logs from a scripted response; returns (ok, collected, error).
+struct StreamResult {
+  bool ok = false;
+  std::string data;
+  std::string error;
+};
+
+StreamResult stream_from(const std::string& response) {
+  FakeEngine engine(response);
+  ddocker::DockerClient client(engine.sock_path);
+  StreamResult out;
+  ddocker::StreamSink sink = [&out](const char* p, size_t n) { out.data.append(p, n); };
+  try {
+    client.stream_logs("c1", false, sink);
+    out.ok = true;
+  } catch (const ddocker::DockerError& e) {
+    out.error = e.what();
+  }
+  return out;
+}
+
+void test_chunked_adversarial() {
+  const std::string head = "HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n";
+
+  // Baseline: two well-formed chunks decode in order.
+  StreamResult r = stream_from(head + "5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string("hello world"));
+
+  // Truncated chunk: declares 10 bytes, delivers 3, closes. Must return
+  // promptly with the partial data — no hang, no crash.
+  r = stream_from(head + "A\r\nhel");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string("hel"));
+
+  // Absurd chunk length must not buffer-until-timeout.
+  r = stream_from(head + "FFFFFFFFFFFFFFF\r\nx");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string(""));
+
+  // Garbage size line ends the stream instead of crashing.
+  r = stream_from(head + "zz!!\r\nwhatever");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string(""));
+
+  // Negative size.
+  r = stream_from(head + "-5\r\nhello\r\n");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string(""));
+
+  // Missing CRLF between chunks: first chunk lands, stream then ends.
+  r = stream_from(head + "5\r\nhelloGARBAGE-NO-CRLF");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string("hello"));
+
+  // Chunk size with trailing junk on the line (strtol prefix) still delivers.
+  r = stream_from(head + "5;ext=1\r\nhello\r\n0\r\n\r\n");
+  CHECK(r.ok);
+  CHECK_EQ(r.data, std::string("hello"));
+
+  // Oversized headers (2 MiB, no terminator) trip the buffering cap and fail
+  // with the client's own error instead of ballooning memory.
+  std::string huge = "HTTP/1.1 200 OK\r\n";
+  huge.append(2 * 1024 * 1024, 'A');
+  r = stream_from(huge);
+  CHECK(!r.ok);
+  CHECK(r.error.find("truncated") != std::string::npos);
+
+  // No response at all.
+  r = stream_from("");
+  CHECK(!r.ok);
+
+  // Malformed JSON body on a parsed endpoint surfaces as DockerError.
+  {
+    FakeEngine engine(
+        "HTTP/1.1 200 OK\r\nContent-Length: 9\r\n\r\n{not json");
+    ddocker::DockerClient client(engine.sock_path);
+    bool threw = false;
+    try {
+      client.inspect_container("c1");
+    } catch (const ddocker::DockerError& e) {
+      threw = std::string(e.what()).find("malformed JSON") != std::string::npos;
+    }
+    CHECK(threw);
+  }
 }
 
 void test_tpu_metrics_parse() {
@@ -329,8 +528,13 @@ void test_tpu_metrics_parse() {
 }  // namespace
 
 int main() {
+  // The agent proper ignores SIGPIPE (main.cpp); the fake engine's scripted
+  // writes against an early-closing client need the same here.
+  signal(SIGPIPE, SIG_IGN);
   test_json_roundtrip();
+  test_json_adversarial();
   test_docker_helpers();
+  test_chunked_adversarial();
   test_tpu_metrics_parse();
   test_pty_exec_and_env();
   test_job_env_overrides_inherited_env();

@@ -74,6 +74,14 @@ class TestScriptHandling:
         assert d.fixup_ddl("blob BLOB,") == "blob BYTEA,"
         assert "BLOB" not in d.fixup_ddl("\n".join(s for _, s in migrations.MIGRATIONS))
 
+    def test_integer_becomes_bigint(self):
+        """sqlite INTEGER is 64-bit; pg INTEGER is int4 and byte counters
+        (memory_usage_bytes, cpu_usage_micro) overflow it within hours."""
+        d = PostgresDialect("postgresql://ignored")
+        assert d.fixup_ddl("memory_usage_bytes INTEGER,") == "memory_usage_bytes BIGINT,"
+        fixed = d.fixup_ddl("\n".join(s for _, s in migrations.MIGRATIONS))
+        assert "INTEGER" not in fixed
+
     def test_migration_ddl_splits_cleanly(self):
         # Every migration script must survive the statement splitter: no
         # triggers/procedural bodies with embedded semicolons.

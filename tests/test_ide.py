@@ -130,6 +130,31 @@ class TestIde:
             assert resp.status == 200
         assert (tmp_path / "ok.py").read_text() == "fine"
 
+    def test_chunked_and_bad_content_length_rejected(self, ide_server):
+        """Chunked uploads would silently write empty files; negative lengths
+        would read to EOF past the size cap — both refused up front."""
+        import http.client
+
+        base, tmp_path = ide_server
+        host = base[len("http://"):]
+
+        conn = http.client.HTTPConnection(host, timeout=5)
+        conn.putrequest("PUT", "/api/file?path=c.txt", skip_accept_encoding=True)
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"4\r\nbody\r\n0\r\n\r\n")
+        assert conn.getresponse().status == 411
+        conn.close()
+        assert not (tmp_path / "c.txt").exists()
+
+        conn = http.client.HTTPConnection(host, timeout=5)
+        conn.putrequest("PUT", "/api/file?path=c.txt", skip_accept_encoding=True)
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        assert conn.getresponse().status == 411
+        conn.close()
+        assert not (tmp_path / "c.txt").exists()
+
     def test_missing_file_404(self, ide_server):
         base, _ = ide_server
         try:

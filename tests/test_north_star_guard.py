@@ -1,0 +1,68 @@
+"""Regression guard for the north-star orchestration-overhead number.
+
+BASELINE.md records apply -> first-train-step with a budget whose
+orchestration segment (submit -> the job's python process running: queue +
+schedule + slice provision + agent spawn + code sync) measured ~2.8 s on the
+local backend (experiments/north_star.py). MFU has a bench floor and scheduler
+throughput has a scale guard; this enforces the third north-star the same way
+(VERDICT r4 #6): a conservative 10 s ceiling on shared 1-CPU CI hosts, loose
+enough to never flake, tight enough that an accidental sleep/poll regression
+in the submit path fails loudly."""
+
+import asyncio
+import re
+import time
+
+from dstack_tpu.server.services import logs as logs_service
+from tests.common import api_server
+from tests.test_services import _drive
+
+CEILING_S = 10.0
+
+
+class TestNorthStarGuard:
+    async def test_submit_to_job_python_under_ceiling(self, tmp_path):
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        try:
+            async with api_server() as api:
+                t0 = time.time()
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {
+                        "run_spec": {
+                            "run_name": "ns-guard",
+                            "configuration": {
+                                "type": "task",
+                                "commands": [
+                                    "python3 -c \"import time;"
+                                    " print('PYSTART %.6f' % time.time(), flush=True)\""
+                                ],
+                            },
+                        }
+                    },
+                )
+                run = None
+                deadline = time.time() + CEILING_S + 20  # let a slow run FINISH
+                while time.time() < deadline:
+                    await _drive(api)
+                    run = await api.post(
+                        "/api/project/main/runs/get", {"run_name": "ns-guard"}
+                    )
+                    if run["status"] in ("done", "failed", "terminated"):
+                        break
+                    await asyncio.sleep(0.05)
+                assert run is not None and run["status"] == "done", run
+
+                logs = await api.post(
+                    "/api/project/main/logs/poll", {"run_name": "ns-guard"}
+                )
+                text = "".join(e["message"] for e in logs["logs"])
+                match = re.search(r"PYSTART ([0-9.]+)", text)
+                assert match, f"job never printed PYSTART: {text!r}"
+                overhead = float(match.group(1)) - t0
+                assert 0 < overhead < CEILING_S, (
+                    f"submit -> job python took {overhead:.2f}s"
+                    f" (north-star budget segment; ceiling {CEILING_S}s)"
+                )
+        finally:
+            logs_service.set_log_storage(None)

@@ -1,0 +1,113 @@
+"""HF fine-tuning sugar (ref api/huggingface/__init__.py:6) and the env-gated
+error-report hook (ref server/app.py:81-89 Sentry init)."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from dstack_tpu.api.huggingface import SFTFineTuningTask
+from dstack_tpu.core.models.configurations import TaskConfiguration
+from dstack_tpu.server.services import error_reporting
+
+
+class TestSftSugar:
+    def test_builds_a_valid_task_configuration(self):
+        task = SFTFineTuningTask(
+            model_name="google/gemma-2b",
+            dataset_name="tatsu-lab/alpaca",
+            env={"HF_TOKEN": "hf_x"},
+            tpu="v5litepod-8",
+            new_model_name="me/gemma-2b-alpaca",
+            max_seq_length=2048,
+            max_steps=100,
+        )
+        assert isinstance(task, TaskConfiguration)
+        assert task.type == "task"
+        joined = "\n".join(task.commands)
+        assert "trl sft" in joined
+        assert "--model_name_or_path google/gemma-2b" in joined
+        assert "--dataset_name tatsu-lab/alpaca" in joined
+        assert "--use_peft" in joined  # LoRA default
+        assert "--bf16 True" in joined  # MXU-native dtype default
+        assert "--push_to_hub" in joined
+        assert "--hub_model_id me/gemma-2b-alpaca" in joined
+        assert "--max_steps 100" in joined
+        assert task.resources.tpu is not None
+        # Round-trips through the submit payload shape.
+        spec = {"run_name": "sft", "configuration": json.loads(task.model_dump_json())}
+        assert spec["configuration"]["type"] == "task"
+
+    def test_requires_hf_token(self):
+        with pytest.raises(ValueError, match="HF_TOKEN"):
+            SFTFineTuningTask("m", "d", env={})
+
+    def test_wandb_requires_key(self):
+        with pytest.raises(ValueError, match="WANDB_API_KEY"):
+            SFTFineTuningTask("m", "d", env={"HF_TOKEN": "x"}, report_to="wandb")
+        task = SFTFineTuningTask(
+            "m", "d", env={"HF_TOKEN": "x", "WANDB_API_KEY": "y"}, report_to="wandb"
+        )
+        assert "--report_to wandb" in "\n".join(task.commands)
+
+    def test_no_lora_drops_peft_flags(self):
+        task = SFTFineTuningTask("m", "d", env={"HF_TOKEN": "x"}, lora=False)
+        assert "--use_peft" not in "\n".join(task.commands)
+
+
+class TestErrorReporting:
+    async def test_error_records_reach_the_collector(self, monkeypatch):
+        received = []
+
+        async def collect(request):
+            received.append(await request.json())
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_post("/errors", collect)
+        server = TestServer(app)
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}/errors"
+        monkeypatch.setenv("DSTACK_TPU_ERROR_REPORT_URL", url)
+        monkeypatch.delenv("DSTACK_TPU_SENTRY_DSN", raising=False)
+        try:
+            assert error_reporting.setup() == "http"
+            log = logging.getLogger("dstack_tpu.test.reporting")
+            try:
+                raise RuntimeError("scheduler exploded")
+            except RuntimeError:
+                log.exception("unhandled server error: GET /api/x")
+            log.info("informational — must NOT be reported")
+            for _ in range(100):
+                if received:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(received) == 1
+            payload = received[0]
+            assert payload["message"] == "unhandled server error: GET /api/x"
+            assert "scheduler exploded" in payload["traceback"]
+            assert payload["level"] == "ERROR"
+            assert payload["release"]
+        finally:
+            error_reporting.teardown()
+            await server.close()
+
+    async def test_unconfigured_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("DSTACK_TPU_ERROR_REPORT_URL", raising=False)
+        monkeypatch.delenv("DSTACK_TPU_SENTRY_DSN", raising=False)
+        assert error_reporting.setup() is None
+
+    async def test_dead_collector_never_breaks_logging(self, monkeypatch):
+        monkeypatch.setenv("DSTACK_TPU_ERROR_REPORT_URL", "http://127.0.0.1:1/x")
+        monkeypatch.delenv("DSTACK_TPU_SENTRY_DSN", raising=False)
+        try:
+            assert error_reporting.setup() == "http"
+            log = logging.getLogger("dstack_tpu.test.reporting2")
+            for _ in range(10):
+                log.error("boom %d", 1)
+            await asyncio.sleep(0.2)  # pump thread must survive failures
+        finally:
+            error_reporting.teardown()
