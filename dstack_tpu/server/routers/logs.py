@@ -2,14 +2,32 @@
 
 from __future__ import annotations
 
+import logging
+
 from aiohttp import web
 
-from dstack_tpu.core.errors import ResourceNotExistsError
+from dstack_tpu.core.errors import ResourceNotExistsError, ServerClientError
 from dstack_tpu.core.models.logs import JobSubmissionLogs
 from dstack_tpu.server.routers._common import auth_project, body_dict, model_response, required
 from dstack_tpu.server.services import logs as logs_service
 
+logger = logging.getLogger(__name__)
+
 routes = web.RouteTableDef()
+
+
+async def _latest_job_id(db, project_id: str, run_name: str) -> str:
+    """The run's replica-0/job-0 latest submission — the default log target
+    for both the poll and WS endpoints (they must tail the SAME job)."""
+    row = await db.fetchone(
+        "SELECT j.id FROM jobs j JOIN runs r ON r.id = j.run_id"
+        " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
+        " ORDER BY j.replica_num, j.job_num, j.submission_num DESC LIMIT 1",
+        (project_id, run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"no jobs for run {run_name}")
+    return row["id"]
 
 
 @routes.post("/api/project/{project_name}/logs/poll")
@@ -20,16 +38,7 @@ async def poll_logs(request: web.Request) -> web.Response:
     run_name = required(body, "run_name")
     job_id = body.get("job_id")
     if job_id is None:
-        # Default to the latest submission of job (replica 0, num 0).
-        row = await db.fetchone(
-            "SELECT j.id FROM jobs j JOIN runs r ON r.id = j.run_id"
-            " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
-            " ORDER BY j.replica_num, j.job_num, j.submission_num DESC LIMIT 1",
-            (project_row["id"], run_name),
-        )
-        if row is None:
-            raise ResourceNotExistsError(f"no jobs for run {run_name}")
-        job_id = row["id"]
+        job_id = await _latest_job_id(db, project_row["id"], run_name)
     start_line = int(body.get("start_line") or 0)
     limit = min(int(body.get("limit") or 1000), 10000)
     import asyncio
@@ -46,3 +55,60 @@ async def poll_logs(request: web.Request) -> web.Response:
     return model_response(
         JobSubmissionLogs(logs=events, next_token=str(start_line + len(events)))
     )
+
+
+@routes.get("/api/project/{project_name}/logs/ws")
+async def stream_logs_ws(request: web.Request) -> web.StreamResponse:
+    """Live log stream: server pushes new log events over a WebSocket (the
+    reference runner exposes logs_ws, runner/api/ws.go:18; here the control
+    plane bridges it so the SPA tails without polling). Browser clients
+    authenticate via ?token= (see security.get_request_token)."""
+    import asyncio
+    import json as _json
+
+    _, project_row = await auth_project(request)
+    run_name = request.query.get("run_name")
+    if not run_name:
+        raise ServerClientError("run_name query parameter required")
+    db = request.app["db"]
+    job_id = await _latest_job_id(db, project_row["id"], run_name)
+    try:
+        start_line = int(request.query.get("start_line") or 0)
+    except ValueError:
+        raise ServerClientError("start_line must be an integer")
+
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+
+    async def pump() -> None:
+        nonlocal start_line
+        storage = logs_service.get_log_storage()
+        while True:
+            events = await asyncio.to_thread(
+                storage.poll_logs, project_row["id"], run_name, job_id,
+                start_line, 1000,
+            )
+            if events:
+                start_line += len(events)
+                await ws.send_json({
+                    "logs": [_json.loads(e.model_dump_json()) for e in events],
+                    "next_line": start_line,
+                })
+            else:
+                await asyncio.sleep(0.5)
+
+    task = asyncio.create_task(pump())
+    try:
+        async for _msg in ws:  # drain until the client closes
+            pass
+    finally:
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            # An abrupt tab close makes the in-flight send_json raise a
+            # connection error; that is a normal end of stream, not a 500.
+            logger.debug("log stream for %s ended abruptly", run_name, exc_info=True)
+    return ws

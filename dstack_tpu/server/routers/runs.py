@@ -35,6 +35,36 @@ async def list_all_runs(request: web.Request) -> web.Response:
     return model_response(runs)
 
 
+@routes.post("/api/project/{project_name}/configurations/parse")
+async def parse_config(request: web.Request) -> web.Response:
+    """YAML text -> validated configuration dict. The CLI parses YAML locally;
+    the browser SPA has no YAML parser, so run submission from the UI sends
+    the pasted text here first (then get_plan/submit with the result)."""
+    import json
+
+    import yaml
+
+    from dstack_tpu.core.errors import ConfigurationError, ServerClientError
+    from dstack_tpu.core.models.configurations import parse_configuration
+
+    await auth_project(request)
+    body = await body_dict(request)
+    text = body.get("yaml")
+    if not isinstance(text, str) or not text.strip():
+        raise ServerClientError("body must carry non-empty `yaml` text")
+    try:
+        data = yaml.safe_load(text)
+    except yaml.YAMLError as e:
+        raise ServerClientError(f"invalid YAML: {e}")
+    if not isinstance(data, dict):
+        raise ServerClientError("configuration must be a YAML mapping")
+    try:
+        conf = parse_configuration(data)
+    except (ConfigurationError, ValueError) as e:
+        raise ServerClientError(f"invalid configuration: {e}")
+    return web.json_response(json.loads(conf.model_dump_json()))
+
+
 @routes.post("/api/project/{project_name}/runs/get_plan")
 async def get_plan(request: web.Request) -> web.Response:
     user_row, project_row = await auth_project(request)

@@ -19,6 +19,10 @@ def get_request_token(request: web.Request) -> Optional[str]:
     auth = request.headers.get("Authorization", "")
     if auth.lower().startswith("bearer "):
         return auth[7:].strip()
+    # Browser WebSocket clients cannot set headers; accept ?token= on upgrade
+    # requests only (the SPA's live log stream / attach bridge).
+    if request.headers.get("Upgrade", "").lower() == "websocket":
+        return request.query.get("token") or None
     return None
 
 

@@ -199,6 +199,29 @@ function table(headers, rows, emptyMsg) {
     h("tbody", {}, rows));
 }
 
+/* Client-side pagination: `key` keeps the page across auto-refreshes. */
+const pageState = {};
+function paginated(key, headers, rows, emptyMsg, pageSize = 25) {
+  const wrap = h("div", {});
+  const draw = () => {
+    const total = Math.max(1, Math.ceil(rows.length / pageSize));
+    const page = Math.min(pageState[key] || 0, total - 1);
+    pageState[key] = page;
+    const slice = rows.slice(page * pageSize, (page + 1) * pageSize);
+    wrap.replaceChildren(
+      table(headers, slice, emptyMsg),
+      rows.length > pageSize
+        ? h("div", { class: "pager" },
+            h("button", { class: "small", disabled: page === 0 ? "" : null, onclick: () => { pageState[key] = page - 1; draw(); } }, "‹ prev"),
+            h("span", { class: "muted" }, ` page ${page + 1} / ${total} — ${rows.length} rows `),
+            h("button", { class: "small", disabled: page >= total - 1 ? "" : null, onclick: () => { pageState[key] = page + 1; draw(); } }, "next ›"))
+        : null,
+    );
+  };
+  draw();
+  return wrap;
+}
+
 /* ---------------- views ---------------- */
 
 async function viewLogin() {
@@ -255,10 +278,83 @@ async function viewRuns() {
     );
   });
   render(layout("runs", [
-    h("h1", {}, "Runs"),
-    table(["Name", "Type", "Status", "Submitted", "Cost", ""], rows, "no runs — submit one with `dstack-tpu apply`"),
+    h("h1", {}, "Runs", h("span", { style: "flex:1" }),
+      h("a", { class: "button", href: `#/p/${P()}/submit` }, "Submit run")),
+    paginated("runs", ["Name", "Type", "Status", "Submitted", "Cost", ""], rows, "no runs — submit one with `dstack-tpu apply` or the Submit run button"),
   ]));
   autoRefresh(8000);
+}
+
+/* Paste YAML -> parse -> plan (offers) -> apply: the UI path of what
+   `dstack-tpu apply -f conf.yml` does over the same endpoints. */
+async function viewSubmit() {
+  const ta = h("textarea", {
+    class: "yaml", rows: "14", spellcheck: "false",
+    placeholder: "type: task\ncommands:\n  - python train.py\nresources:\n  tpu: v5litepod-8",
+  });
+  const nameInput = h("input", { placeholder: "run name (optional — auto-generated)" });
+  const planBox = h("div", {});
+  const err = h("div", { class: "err" });
+  let plannedSpec = null;
+
+  // type=button: inside the form these would otherwise ALSO fire the form's
+  // onsubmit on every click (Apply would re-plan and drop its plannedSpec).
+  const applyBtn = h("button", { type: "button", disabled: "" }, "Apply");
+  const planBtn = h("button", { type: "button", class: "small" }, "Plan");
+
+  async function doPlan(ev) {
+    ev.preventDefault();
+    err.textContent = "";
+    planBox.replaceChildren(h("div", { class: "muted" }, "planning…"));
+    applyBtn.setAttribute("disabled", "");
+    plannedSpec = null;
+    try {
+      const conf = await api(`/api/project/${P()}/configurations/parse`, { yaml: ta.value });
+      const spec = { configuration: conf };
+      const name = nameInput.value.trim();
+      if (name) spec.run_name = name;
+      const plan = await api(`/api/project/${P()}/runs/get_plan`, { run_spec: spec });
+      plannedSpec = plan.run_spec || spec;
+      const offers = (plan.offers || []).map((o) => h("tr", {},
+        h("td", {}, o.slice_name || o.instance?.name || "—"),
+        h("td", {}, o.backend),
+        h("td", {}, o.region),
+        h("td", { class: "num" }, `${money(o.price)}/hr`),
+        h("td", {}, o.availability),
+      ));
+      planBox.replaceChildren(
+        h("h2", {}, `Plan: ${plan.action || "create"}${plan.effective_run_name ? ` — ${plan.effective_run_name}` : ""}`),
+        plan.total_offers
+          ? table(["Slice", "Backend", "Region", "Price", "Availability"], offers)
+          : h("div", { class: "err" }, "no offers match this configuration"),
+      );
+      if (plan.total_offers) applyBtn.removeAttribute("disabled");
+    } catch (e) {
+      planBox.replaceChildren();
+      err.textContent = e.message;
+    }
+  }
+
+  async function doApply(ev) {
+    ev.preventDefault();
+    if (!plannedSpec) return;
+    err.textContent = "";
+    try {
+      const run = await api(`/api/project/${P()}/runs/submit`, { run_spec: plannedSpec });
+      const name = (run.run_spec && run.run_spec.run_name) || run.run_name;
+      location.hash = `#/p/${P()}/runs/${encodeURIComponent(name)}`;
+    } catch (e) { err.textContent = e.message; }
+  }
+
+  planBtn.addEventListener("click", doPlan);
+  applyBtn.addEventListener("click", doApply);
+  render(layout("runs", [
+    h("h1", {}, h("a", { href: `#/p/${P()}/runs` }, "Runs"), " / submit"),
+    h("div", { class: "muted" }, "Paste a run configuration (task / service / dev-environment YAML), plan it, then apply."),
+    h("form", { class: "submit-form", onsubmit: doPlan },
+      ta, nameInput, h("div", { class: "row-actions" }, planBtn, applyBtn), err),
+    planBox,
+  ]));
 }
 
 async function viewRunDetail(runName) {
@@ -312,23 +408,47 @@ async function viewRunDetail(runName) {
     } catch { /* metrics are optional (job may not have started) */ }
   })();
 
-  // Live log tail over the REST poll endpoint.
+  // Live log tail: the server pushes increments over the logs WebSocket
+  // (no client polling loop). Falls back to a one-shot REST poll only when
+  // the socket cannot be established (e.g. run has no jobs yet).
   const logbox = h("div", { class: "logbox" }, "");
   const follow = h("input", { type: "checkbox", checked: "" });
   let logLine = 0;
-  const pollLogs = async () => {
-    try {
-      const batch = await api(`/api/project/${P()}/logs/poll`, { run_name: runName, start_line: logLine, limit: 1000 });
-      const evs = batch.logs || [];
-      if (evs.length) {
-        logLine += evs.length;
-        logbox.append(document.createTextNode(evs.map((e) => e.message).join("")));
-        if (follow.checked) logbox.scrollTop = logbox.scrollHeight;
-      }
-    } catch { /* run may have no logs yet */ }
+  const appendLogs = (evs) => {
+    if (!evs.length) return;
+    logbox.append(document.createTextNode(evs.map((e) => e.message).join("")));
+    if (follow.checked) logbox.scrollTop = logbox.scrollHeight;
   };
-  pollLogs();
-  timers.push(setInterval(pollLogs, 2000));
+  const wsProto = location.protocol === "https:" ? "wss" : "ws";
+  const ws = new WebSocket(
+    `${wsProto}://${location.host}/api/project/${P()}/logs/ws` +
+    `?run_name=${encodeURIComponent(runName)}&token=${encodeURIComponent(state.token)}` +
+    `&start_line=${logLine}`);
+  ws.onmessage = (ev) => {
+    try {
+      const batch = JSON.parse(ev.data);
+      appendLogs(batch.logs || []);
+      logLine = batch.next_line ?? logLine + (batch.logs || []).length;
+    } catch { /* ignore malformed frame */ }
+  };
+  // Fallback ONLY when the socket fails (proxy stripping Upgrade, server
+  // restart): resume polling from logLine so nothing duplicates, and keep
+  // tailing on a timer like the socket would have.
+  let fallback = null;
+  ws.onerror = () => {
+    if (fallback !== null) return;
+    const poll = async () => {
+      try {
+        const batch = await api(`/api/project/${P()}/logs/poll`, { run_name: runName, start_line: logLine, limit: 1000 });
+        const evs = batch.logs || [];
+        if (evs.length) { logLine += evs.length; appendLogs(evs); }
+      } catch { /* run may have no logs yet */ }
+    };
+    poll();
+    fallback = setInterval(poll, 2000);
+    timers.push(fallback);
+  };
+  sockets.push(ws);
 
   render(layout("runs", [
     h("h1", {}, h("a", { href: `#/p/${P()}/runs` }, "Runs"), " / ", runName, h("span", { class: "spacer", style: "flex:1" }), actions),
@@ -391,7 +511,7 @@ async function viewInstances() {
     h("td", { class: "num" }, i.price ? `${money(i.price)}/hr` : "—"),
     h("td", {}, ago(i.created)),
   ));
-  render(layout("instances", [h("h1", {}, "Instances"), table(["Name", "Status", "Type", "Hostname", "Fleet", "Price", "Created"], rows)]));
+  render(layout("instances", [h("h1", {}, "Instances"), paginated("instances", ["Name", "Status", "Type", "Hostname", "Fleet", "Price", "Created"], rows)]));
   autoRefresh(8000);
 }
 
@@ -460,7 +580,7 @@ async function viewOffers() {
   render(layout("offers", [
     h("h1", {}, "Offers"),
     h("div", { class: "muted" }, "TPU slice offers across configured backends, cheapest first."),
-    table(["Slice", "Backend", "Region", "Price", "Availability", "Tier"], rows),
+    paginated("offers", ["Slice", "Backend", "Region", "Price", "Availability", "Tier"], rows),
   ]));
 }
 
@@ -541,7 +661,12 @@ async function viewUsers() {
 /* ---------------- router ---------------- */
 
 let timers = [];
-function stopTimers() { timers.forEach(clearInterval); timers = []; }
+let sockets = [];
+function stopTimers() {
+  timers.forEach(clearInterval); timers = [];
+  sockets.forEach((s) => { try { s.close(); } catch { /* already closed */ } });
+  sockets = [];
+}
 function autoRefresh(ms) {
   // Periodic re-render of the current (list) view.
   timers.push(setInterval(() => { route(true); }, ms));
@@ -565,7 +690,8 @@ async function route(isRefresh = false) {
       const section = parts[2];
       if (section === "runs" && parts[3]) return void await viewRunDetail(parts[3]);
       const views = {
-        runs: viewRuns, fleets: parts[3] ? () => viewFleetDetail(parts[3]) : viewFleets,
+        runs: viewRuns, submit: viewSubmit,
+        fleets: parts[3] ? () => viewFleetDetail(parts[3]) : viewFleets,
         instances: viewInstances, volumes: viewVolumes, gateways: viewGateways,
         offers: viewOffers, secrets: viewSecrets,
       };

@@ -161,8 +161,10 @@ struct Conn {
     return true;
   }
 
-  // Reads to EOF.
-  void read_all(std::string* out, const StreamSink* sink, int timeout_sec) {
+  // Reads to EOF. `max_capture` bounds what is appended to `out` (streaming
+  // sinks are unbounded by design); excess buffered bytes are discarded.
+  void read_all(std::string* out, const StreamSink* sink, int timeout_sec,
+                long max_capture = -1) {
     if (!buffered.empty()) {
       if (sink) (*sink)(buffered.data(), buffered.size());
       if (out) out->append(buffered);
@@ -172,7 +174,10 @@ struct Conn {
     ssize_t n;
     while ((n = read_some(fd, buf, sizeof(buf), timeout_sec)) > 0) {
       if (sink) (*sink)(buf, static_cast<size_t>(n));
-      if (out) out->append(buf, static_cast<size_t>(n));
+      if (out && (max_capture < 0 ||
+                  out->size() < static_cast<size_t>(max_capture))) {
+        out->append(buf, static_cast<size_t>(n));
+      }
     }
   }
 };
@@ -252,7 +257,12 @@ HttpResult DockerClient::request(const std::string& method, const std::string& p
   // Error statuses carry a small JSON body we want intact, not streamed.
   const StreamSink* body_sink = (status >= 300) ? nullptr : sink;
   std::string* capture = (body_sink != nullptr) ? nullptr : &out.body;
+  // Buffered (non-streamed) bodies are bounded: a hostile/corrupt daemon must
+  // not balloon memory through ANY body path — chunk sizes, Content-Length,
+  // or read-to-EOF. Streaming sinks stay unbounded (logs/pull progress).
+  const long kMaxCapture = 64L * 1024 * 1024;
   if (chunked) {
+    long captured = 0;
     while (true) {
       std::string size_line;
       if (!conn.read_until("\r\n", &size_line, timeout_sec)) break;
@@ -261,14 +271,18 @@ HttpResult DockerClient::request(const std::string& method, const std::string& p
       // A hostile/corrupt size line (e.g. "FFFFFFFFFFFFFFF") must not turn
       // into an exabyte read_n that buffers until timeout.
       if (chunk > (1L << 30)) break;
+      captured += chunk;
+      if (capture != nullptr && captured > kMaxCapture) break;
       if (!conn.read_n(static_cast<size_t>(chunk), capture, body_sink, timeout_sec)) break;
       std::string crlf;
       conn.read_until("\r\n", &crlf, timeout_sec);
     }
   } else if (content_length >= 0) {
-    conn.read_n(static_cast<size_t>(content_length), capture, body_sink, timeout_sec);
+    if (capture == nullptr || content_length <= kMaxCapture) {
+      conn.read_n(static_cast<size_t>(content_length), capture, body_sink, timeout_sec);
+    }
   } else if (status != 204) {
-    conn.read_all(capture, body_sink, timeout_sec);
+    conn.read_all(capture, body_sink, timeout_sec, kMaxCapture);
   }
   return out;
 }

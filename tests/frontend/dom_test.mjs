@@ -1,0 +1,268 @@
+// DOM-level test for the admin SPA (server/statics/app.js): executes the real
+// app code against a hand-rolled DOM/fetch/WebSocket shim — no browser, no
+// npm deps, plain `node dom_test.mjs`. Run in CI; the pytest wrapper
+// (tests/test_frontend.py) skips it where node is absent (the TPU image).
+//
+// Covers: runs list renders + paginates, run detail streams logs over the
+// WebSocket (no polling), and the submit view drives parse -> plan -> apply.
+
+import { readFileSync } from "node:fs";
+import { dirname, join } from "node:path";
+import { fileURLToPath } from "node:url";
+import vm from "node:vm";
+
+let failures = 0;
+let checks = 0;
+function check(cond, msg) {
+  checks++;
+  if (!cond) { failures++; console.error(`FAIL: ${msg}`); }
+}
+
+/* ---------------- DOM shim ---------------- */
+
+class TextNode {
+  constructor(data) { this.nodeType = 3; this.data = String(data); }
+  get textContent() { return this.data; }
+}
+
+class El {
+  constructor(tag) {
+    this.tagName = String(tag).toUpperCase();
+    this.nodeType = 1;
+    this.children = [];
+    this.attrs = {};
+    this.listeners = {};
+    this.style = {};
+    this.value = "";
+    this.checked = true;
+    this.scrollTop = 0;
+    this.scrollHeight = 0;
+    this.innerHTML = "";
+  }
+  get className() { return this.attrs.class || ""; }
+  set className(v) { this.attrs.class = v; }
+  setAttribute(k, v) { this.attrs[k] = String(v); }
+  removeAttribute(k) { delete this.attrs[k]; }
+  getAttribute(k) { return k in this.attrs ? this.attrs[k] : null; }
+  addEventListener(t, f) { (this.listeners[t] ||= []).push(f); }
+  append(...cs) {
+    for (const c of cs) this.children.push(c && c.nodeType ? c : new TextNode(c));
+  }
+  replaceChildren(...cs) {
+    this.children = [];
+    this.append(...cs.filter((c) => c !== null && c !== undefined && c !== false));
+  }
+  get textContent() {
+    return this.children.map((c) => c.textContent ?? "").join("");
+  }
+  set textContent(v) {
+    this.children = v === "" ? [] : [new TextNode(v)];
+  }
+  dispatch(type, ev = {}) {
+    ev.preventDefault ||= () => {};
+    ev.stopPropagation ||= () => {};
+    ev.target ||= this;
+    for (const f of this.listeners[type] || []) f(ev);
+  }
+  click() { this.dispatch("click"); }
+  getBoundingClientRect() { return { left: 0, top: 0, width: 300, height: 64 }; }
+}
+
+function* walk(el) {
+  yield el;
+  for (const c of el.children || []) if (c.nodeType === 1) yield* walk(c);
+}
+const findAll = (root, pred) => [...walk(root)].filter(pred);
+const byTag = (root, tag) => findAll(root, (e) => e.tagName === tag.toUpperCase());
+const buttonByText = (root, text) =>
+  findAll(root, (e) => e.tagName === "BUTTON" && e.textContent.includes(text))[0];
+
+/* ---------------- environment shim ---------------- */
+
+const appRoot = new El("div");
+appRoot.attrs.id = "app";
+
+const hashListeners = [];
+const loc = { protocol: "http:", host: "testhost", _hash: "#/" };
+Object.defineProperty(loc, "hash", {
+  get() { return this._hash; },
+  set(v) {
+    this._hash = v;
+    setTimeout(() => hashListeners.forEach((f) => f()), 0);
+  },
+});
+
+const lsStore = { dstack_tpu_token: "test-token", dstack_tpu_project: "main" };
+
+const fetchCalls = [];
+const RUNS = Array.from({ length: 60 }, (_, i) => ({
+  run_spec: { run_name: `run-${i}`, configuration: { type: "task" } },
+  status: i % 2 ? "done" : "running",
+  submitted_at: new Date().toISOString(),
+  cost: 0.5,
+}));
+
+const ROUTES = {
+  "/api/users/get_my_user": () => ({ username: "admin", global_role: "admin" }),
+  "/api/projects/list": () => [{ project_name: "main", members: [] }],
+  "/api/project/main/runs/list": () => RUNS,
+  "/api/project/main/runs/get": () => ({
+    run_spec: { run_name: "run-0", configuration: { type: "task" } },
+    status: "running", submitted_at: new Date().toISOString(), cost: 0,
+    jobs: [],
+  }),
+  "/api/project/main/metrics/job": () => ({ points: [] }),
+  "/api/project/main/logs/poll": () => ({ logs: [] }),
+  "/api/project/main/configurations/parse": (body) => {
+    if (!body.yaml.includes("type:")) throw { status: 400, detail: "invalid configuration" };
+    return { type: "task", commands: ["python train.py"] };
+  },
+  "/api/project/main/runs/get_plan": (body) => ({
+    action: "create",
+    effective_run_name: "ui-run",
+    run_spec: { run_name: "ui-run", configuration: body.run_spec.configuration },
+    total_offers: 1,
+    offers: [{ slice_name: "v5litepod-8", backend: "local", region: "local", price: 1.2, availability: "available" }],
+  }),
+  "/api/project/main/runs/submit": (body) => ({
+    run_spec: { run_name: body.run_spec.run_name || "ui-run" },
+    status: "submitted",
+  }),
+};
+
+async function fakeFetch(path, opts = {}) {
+  const body = opts.body ? JSON.parse(opts.body) : {};
+  fetchCalls.push({ path, body });
+  const handler = ROUTES[path];
+  if (!handler) return { status: 404, ok: false, text: async () => `{"detail":"no stub for ${path}"}` };
+  try {
+    const data = handler(body);
+    return { status: 200, ok: true, text: async () => JSON.stringify(data) };
+  } catch (e) {
+    return { status: e.status || 500, ok: false, text: async () => JSON.stringify({ detail: e.detail }) };
+  }
+}
+
+const wsInstances = [];
+class FakeWebSocket {
+  constructor(url) { this.url = url; this.closed = false; wsInstances.push(this); }
+  close() { this.closed = true; }
+}
+
+const sandbox = {
+  document: {
+    getElementById: () => appRoot,
+    createElement: (t) => new El(t),
+    createElementNS: (_ns, t) => new El(t),
+    createTextNode: (s) => new TextNode(s),
+    body: new El("body"),
+  },
+  window: {
+    addEventListener: (t, f) => { if (t === "hashchange") hashListeners.push(f); },
+    confirm: () => true,
+    prompt: () => "",
+    alert: () => {},
+    innerWidth: 1280,
+  },
+  location: loc,
+  localStorage: {
+    getItem: (k) => (k in lsStore ? lsStore[k] : null),
+    setItem: (k, v) => { lsStore[k] = String(v); },
+    removeItem: (k) => { delete lsStore[k]; },
+  },
+  fetch: fakeFetch,
+  WebSocket: FakeWebSocket,
+  setInterval, clearInterval, setTimeout, clearTimeout,
+  Date, JSON, Math, Promise, Object, Array, String, Number, Infinity, NaN,
+  encodeURIComponent, decodeURIComponent, console, Error,
+};
+sandbox.globalThis = sandbox;
+
+const here = dirname(fileURLToPath(import.meta.url));
+const src = readFileSync(join(here, "../../dstack_tpu/server/statics/app.js"), "utf8");
+vm.createContext(sandbox);
+vm.runInContext(src, sandbox, { filename: "app.js" });
+
+const settle = (ms = 30) => new Promise((r) => setTimeout(r, ms));
+
+/* ---------------- the test ---------------- */
+
+await settle(); // initial route(): "#/" -> runs list
+
+// 1. Runs list renders and paginates at 25/page.
+{
+  const rows = byTag(appRoot, "tbody").flatMap((tb) => tb.children);
+  check(rows.length === 25, `runs list shows 25 rows/page (got ${rows.length})`);
+  check(appRoot.textContent.includes("page 1 / 3"), "pager shows page 1 / 3");
+  check(appRoot.textContent.includes("60 rows"), "pager shows total row count");
+  const next = buttonByText(appRoot, "next");
+  check(next, "pager has a next button");
+  next.click();
+  await settle(5);
+  check(appRoot.textContent.includes("page 2 / 3"), "next advances to page 2");
+  check(appRoot.textContent.includes("run-25"), "page 2 shows the 26th run");
+}
+
+// 2. Run detail streams logs over the WebSocket — no polling interval.
+{
+  loc.hash = "#/p/main/runs/run-0";
+  await settle();
+  check(wsInstances.length === 1, "run detail opened exactly one WebSocket");
+  const ws = wsInstances[0];
+  check(ws.url.includes("/api/project/main/logs/ws"), `WS hits the logs endpoint (${ws.url})`);
+  check(ws.url.includes("run_name=run-0"), "WS names the run");
+  check(ws.url.includes("token=test-token"), "WS carries the token (browsers cannot set headers)");
+  ws.onmessage({ data: JSON.stringify({ logs: [{ message: "hello-from-ws\n" }], next_line: 1 }) });
+  check(appRoot.textContent.includes("hello-from-ws"), "pushed log line rendered");
+  let pollCalls = fetchCalls.filter((c) => c.path.endsWith("/logs/poll"));
+  check(pollCalls.length === 0, "no REST log polling while the socket is open");
+  // Socket failure falls back to polling — resuming AFTER the pushed lines.
+  ws.onerror();
+  await settle();
+  pollCalls = fetchCalls.filter((c) => c.path.endsWith("/logs/poll"));
+  check(pollCalls.length === 1, "WS failure starts the poll fallback");
+  check(pollCalls[0].body.start_line === 1, "fallback resumes from the streamed position (no duplicates)");
+}
+
+// 3. Submit view: YAML -> parse -> plan -> apply -> lands on the run page.
+{
+  loc.hash = "#/p/main/submit";
+  await settle();
+  check(wsInstances[0].closed, "leaving run detail closed its WebSocket");
+  const ta = byTag(appRoot, "textarea")[0];
+  check(ta, "submit view has a YAML textarea");
+  ta.value = "type: task\ncommands:\n  - python train.py";
+  buttonByText(appRoot, "Plan").click();
+  await settle();
+  check(appRoot.textContent.includes("Plan: create"), "plan action rendered");
+  check(appRoot.textContent.includes("v5litepod-8"), "plan offers rendered");
+  const apply = buttonByText(appRoot, "Apply");
+  check(apply && apply.getAttribute("disabled") === null, "apply enabled after a plannable config");
+  apply.click();
+  await settle();
+  check(loc.hash === "#/p/main/runs/ui-run", `apply navigates to the run (${loc.hash})`);
+  const submits = fetchCalls.filter((c) => c.path.endsWith("/runs/submit"));
+  check(submits.length === 1, "exactly one submit call");
+  check(submits[0].body.run_spec.configuration.type === "task", "submit carries the parsed configuration");
+}
+
+// 4. Submit view surfaces a parse error instead of applying.
+{
+  loc.hash = "#/p/main/runs"; // reset
+  await settle();
+  loc.hash = "#/p/main/submit";
+  await settle();
+  const ta = byTag(appRoot, "textarea")[0];
+  ta.value = "not a config";
+  buttonByText(appRoot, "Plan").click();
+  await settle();
+  check(appRoot.textContent.includes("invalid configuration"), "parse error shown");
+  const apply = buttonByText(appRoot, "Apply");
+  check(apply.getAttribute("disabled") !== null, "apply stays disabled on error");
+}
+
+if (failures) {
+  console.error(`FAILED: ${failures} of ${checks} checks`);
+  process.exit(1);
+}
+console.log(`OK: ${checks} DOM checks passed`);

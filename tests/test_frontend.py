@@ -5,9 +5,12 @@ the contract statically: every endpoint the JS calls must be a registered
 route, and the shell/assets must serve. Parity: the reference serves its React
 SPA from server statics (ref: src/dstack/_internal/server/app.py:292-295)."""
 
+import asyncio
 import re
+import time
 from pathlib import Path
 
+from dstack_tpu.server.services import logs as logs_service
 from tests.common import api_server
 
 STATICS = Path(__file__).parent.parent / "dstack_tpu" / "server" / "statics"
@@ -46,8 +49,14 @@ class TestSpaContract:
                      "viewInstances", "viewVolumes", "viewGateways", "viewOffers",
                      "viewSecrets", "viewProjects", "viewUsers", "viewLogin"):
             assert f"async function {view}" in js, f"missing {view}"
-        # Live log tail + metrics sparklines are wired.
-        assert "logs/poll" in js and "metrics/job" in js and "sparkline" in js
+        # Live log tail (WS push, REST only as fallback), metrics sparklines,
+        # pagination, and UI run submission are wired.
+        assert "viewSubmit" in js and "configurations/parse" in js
+        assert "logs/ws" in js and "metrics/job" in js and "sparkline" in js
+        assert "paginated(" in js
+        # logs/poll remains only as the WS-failure fallback (gated on onerror).
+        assert "ws.onerror" in js
+        assert "setInterval(pollLogs" not in js
 
     async def test_shell_and_assets_served(self):
         async with api_server() as api:
@@ -59,6 +68,26 @@ class TestSpaContract:
             assert "javascript" in resp.content_type
             resp = await api.client.get("/statics/style.css")
             assert resp.status == 200
+
+    def test_dom_level_behavior_under_node(self):
+        """Execute the real app.js against a DOM/fetch/WebSocket shim
+        (tests/frontend/dom_test.mjs): list pagination, WS log push, and the
+        parse->plan->apply submit flow. Needs node (present in CI, absent in
+        the TPU image — skipped there)."""
+        import shutil
+        import subprocess
+
+        import pytest
+
+        node = shutil.which("node")
+        if node is None:
+            pytest.skip("node is not installed in this image; runs in CI")
+        proc = subprocess.run(
+            [node, str(Path(__file__).parent / "frontend" / "dom_test.mjs")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+        assert "OK:" in proc.stdout
 
     def test_js_brackets_balanced(self):
         """No JS runtime ships in this image; a string/comment-aware bracket
@@ -98,3 +127,79 @@ class TestSpaContract:
                 mode, i = None, i + 1
             i += 1
         assert not stack and mode is None
+
+
+class TestSpaEndpoints:
+    """The two endpoints added for the SPA: YAML parse and the WS log stream."""
+
+    async def test_configurations_parse(self):
+        async with api_server() as api:
+            conf = await api.post(
+                "/api/project/main/configurations/parse",
+                {"yaml": "type: task\ncommands:\n  - echo hi\n"},
+            )
+            assert conf["type"] == "task"
+            assert conf["commands"] == ["echo hi"]
+
+            headers = {"Authorization": f"Bearer {api.token}"}
+            resp = await api.client.post(
+                "/api/project/main/configurations/parse",
+                json={"yaml": "type: no-such-type"}, headers=headers,
+            )
+            assert resp.status == 400
+            body = await resp.json()
+            assert "invalid configuration" in str(body)
+
+            resp = await api.client.post(
+                "/api/project/main/configurations/parse",
+                json={"yaml": ": ["}, headers=headers,
+            )
+            assert resp.status == 400
+            assert "invalid YAML" in str(await resp.json())
+
+            resp = await api.client.post(
+                "/api/project/main/configurations/parse",
+                json={"yaml": ""}, headers=headers,
+            )
+            assert resp.status == 400
+
+    async def test_logs_ws_pushes_log_events(self, tmp_path):
+        from tests.test_services import _drive
+
+        logs_service.set_log_storage(logs_service.FileLogStorage(str(tmp_path)))
+        try:
+            async with api_server() as api:
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {"run_spec": {"run_name": "wslog", "configuration": {
+                        "type": "task", "commands": ["echo ws-log-line"]}}},
+                )
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    await _drive(api)
+                    run = await api.post(
+                        "/api/project/main/runs/get", {"run_name": "wslog"}
+                    )
+                    if run["status"] in ("done", "failed", "terminated"):
+                        break
+                    await asyncio.sleep(0.05)
+                assert run["status"] == "done", run
+
+                # Browser-style connect: token in the query, no auth header.
+                ws = await api.client.ws_connect(
+                    f"/api/project/main/logs/ws?run_name=wslog&token={api.token}"
+                )
+                msg = await ws.receive_json(timeout=10)
+                text = "".join(e["message"] for e in msg["logs"])
+                assert "ws-log-line" in text
+                assert msg["next_line"] >= 1
+                await ws.close()
+
+                # A bad token is rejected before the upgrade completes.
+                resp = await api.client.get(
+                    "/api/project/main/logs/ws?run_name=wslog&token=wrong",
+                    headers={"Upgrade": "websocket", "Connection": "Upgrade"},
+                )
+                assert resp.status in (401, 403)
+        finally:
+            logs_service.set_log_storage(None)
