@@ -223,9 +223,12 @@ def cmd_apply(args) -> None:
 
 def _confirm() -> bool:
     if not sys.stdin.isatty():
-        # Non-interactive without -y must not silently provision paid resources.
+        # Non-interactive without -y must not silently act on paid resources —
+        # and scripts must SEE the refusal, so this is an error exit, not a
+        # quiet False (a cron `stop` that exits 0 having stopped nothing would
+        # leave a billing run behind).
         print("error: not a terminal; pass -y to confirm", file=sys.stderr)
-        return False
+        sys.exit(1)
     answer = input("continue? [y/N] ").strip().lower()
     return answer in ("y", "yes")
 
@@ -313,12 +316,17 @@ def cmd_ps(args) -> None:
 
 
 def cmd_stop(args) -> None:
+    # Parity: the reference's stop prompts unless -y (cli/commands/stop.py).
+    if not args.yes and not _confirm():
+        return
     client = _client()
     client.runs.stop(args.runs, abort=args.abort)
     print(f"{'aborting' if args.abort else 'stopping'} {', '.join(args.runs)}")
 
 
 def cmd_delete(args) -> None:
+    if not args.yes and not _confirm():
+        return
     client = _client()
     client.runs.delete(args.runs)
     print(f"deleted {', '.join(args.runs)}")
@@ -581,10 +589,12 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("stop", help="stop runs")
     s.add_argument("runs", nargs="+")
     s.add_argument("-x", "--abort", action="store_true")
+    s.add_argument("-y", "--yes", action="store_true")
     s.set_defaults(func=cmd_stop)
 
     s = sub.add_parser("delete", help="delete finished runs")
     s.add_argument("runs", nargs="+")
+    s.add_argument("-y", "--yes", action="store_true")
     s.set_defaults(func=cmd_delete)
 
     s = sub.add_parser("logs", help="print run logs")

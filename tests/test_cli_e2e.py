@@ -81,6 +81,7 @@ def _cli(args, cwd, tmp_path, check=True, timeout=60):
         [sys.executable, "-m", "dstack_tpu.cli.main", *args],
         cwd=str(cwd),
         env=env,
+        stdin=subprocess.DEVNULL,  # pin non-TTY behavior even under pytest -s
         capture_output=True,
         text=True,
         timeout=timeout,
@@ -122,6 +123,16 @@ class TestCliE2E:
 
         fleets = _cli(["fleet", "list"], work, tmp_path)
         assert run_name in fleets.stdout  # auto-created run fleet
+
+        # stop/delete prompt unless -y (reference parity); non-interactive
+        # without -y refuses rather than acting silently.
+        refused = _cli(["delete", run_name], work, tmp_path, check=False)
+        assert refused.returncode != 0 and "pass -y" in refused.stderr
+        ps = _cli(["ps", "-a"], work, tmp_path)
+        assert run_name in ps.stdout  # still there
+        _cli(["delete", run_name, "-y"], work, tmp_path)
+        ps = _cli(["ps", "-a"], work, tmp_path)
+        assert run_name not in ps.stdout
 
     def test_offers_and_secrets(self, server, tmp_path):
         work = tmp_path / "w2"

@@ -1,0 +1,78 @@
+"""Docs-rot guards: the docs tree must keep describing the real surface.
+
+The reference maintains full user docs (ref mkdocs.yml, docs/); this pins the
+repo's docs/ to the implementation: every CLI command the CLI reference
+names must exist in the parser, every REST path the API reference names must
+be a registered route, and the nav must point at real files."""
+
+import re
+from pathlib import Path
+
+import yaml
+
+DOCS = Path(__file__).parent.parent / "docs"
+
+
+class TestDocs:
+    def test_nav_points_at_real_files(self):
+        nav = yaml.safe_load((DOCS.parent / "mkdocs.yml").read_text())["nav"]
+
+        def walk(node):
+            if isinstance(node, str):
+                yield node
+            elif isinstance(node, dict):
+                for v in node.values():
+                    yield from walk(v)
+            elif isinstance(node, list):
+                for item in node:
+                    yield from walk(item)
+
+        for page in walk(nav):
+            assert (DOCS / page).exists(), f"mkdocs nav names missing page {page}"
+
+    def test_cli_reference_commands_exist(self):
+        from dstack_tpu.cli.main import build_parser
+
+        parser = build_parser()
+        sub = next(
+            a for a in parser._actions
+            if a.__class__.__name__ == "_SubParsersAction"
+        )
+        real = set(sub.choices)
+        doc = (DOCS / "reference" / "cli.md").read_text()
+        documented = set(re.findall(r"`dstack-tpu (\w[\w-]*)", doc))
+        missing = documented - real
+        assert not missing, f"CLI docs name unknown commands: {sorted(missing)}"
+        undocumented = real - documented - {"stats"}  # alias of metrics
+        assert not undocumented, f"CLI commands missing from docs: {sorted(undocumented)}"
+
+    def test_api_reference_paths_registered(self):
+        from dstack_tpu.server.app import create_app
+
+        app = create_app(db_path=":memory:", run_background_tasks=False)
+        registered = {r.resource.canonical for r in app.router.routes() if r.resource}
+        doc = (DOCS / "reference" / "api.md").read_text()
+        checked = 0
+        for line in doc.splitlines():
+            m = re.match(r"^(?:POST|GET|\*)\s+(/\S+)", line.strip())
+            if not m:
+                continue
+            path = m.group(1).split("?")[0]
+            if path.startswith("/proxy/"):
+                continue  # data-plane wildcards; covered by proxy tests
+            # brace-expansion shorthand: /api/x/{a,b} means /api/x/a + /api/x/b
+            expansions = [path]
+            brace = re.search(r"\{([\w,/-]+,[\w,/-]+)\}", path)
+            if brace:
+                expansions = [
+                    path[: brace.start()] + part + path[brace.end():]
+                    for part in brace.group(1).split(",")
+                ]
+            for concrete in expansions:
+                concrete = (
+                    concrete.replace("{p}", "{project_name}")
+                    .replace("{run}", "{run_name}")
+                )
+                checked += 1
+                assert concrete in registered, f"api.md names unregistered path {concrete}"
+        assert checked >= 25, f"api.md path extraction broke (checked {checked})"
