@@ -1,0 +1,10 @@
+"""Shared request-stats window constants.
+
+The control plane's autoscaler (server/services/proxy.py) and the gateway
+appliance (gateway/app.py) must agree on bucket granularity: the server
+interprets the appliance's wall-clock bucket keys with these values when it
+pulls gateway request stats into the scaling window.
+"""
+
+STATS_WINDOW = 600.0  # seconds of request history kept per service
+STATS_BUCKET = 10.0  # bucket granularity (seconds)

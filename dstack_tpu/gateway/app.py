@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
 
 from aiohttp import web
@@ -35,6 +36,8 @@ from aiohttp import web
 from dstack_tpu.core.services.http_forward import forward
 
 logger = logging.getLogger(__name__)
+
+from dstack_tpu.core.services.stats_window import STATS_BUCKET, STATS_WINDOW
 
 
 class ServiceEntry:
@@ -50,6 +53,18 @@ class ServiceEntry:
         ]
         self.rate_limits: List[dict] = data.get("rate_limits") or []
         self._rr = 0
+        # Wall-clock bucket -> admitted request count; the control plane pulls
+        # these so gateway-routed traffic feeds the RPS autoscaler exactly like
+        # in-server proxy traffic (the reference's server pulls its gateway's
+        # nginx-access-log stats the same way).
+        self.request_buckets: Dict[int, int] = {}
+
+    def record_request(self) -> None:
+        bucket = int(time.time() // STATS_BUCKET) * int(STATS_BUCKET)
+        self.request_buckets[bucket] = self.request_buckets.get(bucket, 0) + 1
+        cutoff = bucket - int(STATS_WINDOW)
+        for b in [b for b in self.request_buckets if b < cutoff]:
+            del self.request_buckets[b]
 
     def pick_replica(self) -> Tuple[str, int]:
         replica = self.replicas[self._rr % len(self.replicas)]
@@ -77,6 +92,11 @@ class Registry:
 
     def register(self, data: dict) -> ServiceEntry:
         entry = ServiceEntry(data)
+        old = self._services.get((entry.project, entry.run_name))
+        if old is not None:
+            # Re-registration (replica set changed) must not zero the stats
+            # the autoscaler is about to pull.
+            entry.request_buckets = old.request_buckets
         self._services[(entry.project, entry.run_name)] = entry
         return entry
 
@@ -160,15 +180,30 @@ def create_app(token: str, tls_manager=None) -> web.Application:
         _auth(request)
         return web.json_response([e.to_dict() for e in registry.all()])
 
+    async def registry_stats(request: web.Request) -> web.Response:
+        """Per-service request buckets for the control plane's autoscaler."""
+        _auth(request)
+        return web.json_response([
+            {
+                "project": e.project,
+                "run_name": e.run_name,
+                "buckets": {str(b): c for b, c in sorted(e.request_buckets.items())},
+            }
+            for e in registry.all()
+        ])
+
     async def route_service(request: web.Request) -> web.StreamResponse:
         entry = registry.get(
             request.match_info["project"], request.match_info["run_name"]
         )
         if entry is None:
             raise web.HTTPNotFound(text="unknown service")
+        _rate_check(entry, "/" + request.match_info.get("tail", ""))
+        # Record BEFORE the replica check (like the in-server proxy): demand
+        # against a scaled-to-zero service is exactly what wakes it.
+        entry.record_request()
         if not entry.replicas:
             raise web.HTTPServiceUnavailable(text="service has no replicas")
-        _rate_check(entry, "/" + request.match_info.get("tail", ""))
         host, port = entry.pick_replica()
         return await forward(request, host, port, request.match_info.get("tail", ""))
 
@@ -195,10 +230,11 @@ def create_app(token: str, tls_manager=None) -> web.Application:
         entry = registry.by_model(project, model_name)
         if entry is None:
             raise web.HTTPNotFound(text=f"no service serves model {model_name}")
-        if not entry.replicas:
-            raise web.HTTPServiceUnavailable(text="service has no replicas")
         # Limits match the upstream path the request lands on, same as /services/.
         _rate_check(entry, f"{entry.model_prefix}/{tail}")
+        entry.record_request()  # before the replica check: wakes scaled-to-zero
+        if not entry.replicas:
+            raise web.HTTPServiceUnavailable(text="service has no replicas")
         host, port = entry.pick_replica()
         return await forward(
             request, host, port, f"{entry.model_prefix}/{tail}", body=body
@@ -208,9 +244,10 @@ def create_app(token: str, tls_manager=None) -> web.Application:
         entry = registry.by_domain(request.headers.get("Host", ""))
         if entry is None:
             raise web.HTTPNotFound(text="unknown host")
+        _rate_check(entry, request.path)
+        entry.record_request()  # before the replica check: wakes scaled-to-zero
         if not entry.replicas:
             raise web.HTTPServiceUnavailable(text="service has no replicas")
-        _rate_check(entry, request.path)
         host, port = entry.pick_replica()
         return await forward(request, host, port, request.match_info.get("tail", ""))
 
@@ -227,6 +264,7 @@ def create_app(token: str, tls_manager=None) -> web.Application:
     app.router.add_post("/api/registry/register", register)
     app.router.add_post("/api/registry/unregister", unregister)
     app.router.add_get("/api/registry/services", list_services)
+    app.router.add_get("/api/registry/stats", registry_stats)
     app.router.add_route("*", "/services/{project}/{run_name}/{tail:.*}", route_service)
     app.router.add_route("*", "/models/{project}/v1/{tail:.*}", route_model)
     # Domain-based routing is the catch-all: anything not matching the fixed

@@ -107,6 +107,10 @@ async def delete_gateways(db: Database, project_row, names: List[str]) -> None:
             except ResourceNotExistsError:
                 pass  # backend no longer configured; forget the row
         await db.execute("DELETE FROM gateways WHERE id = ?", (row["id"],))
+        # Its pulled request window must stop feeding the autoscaler.
+        from dstack_tpu.server.services import proxy as proxy_service
+
+        proxy_service.stats.drop_external(f"gw:{row['id']}")
 
 
 def gateway_token(row) -> Optional[str]:
@@ -171,6 +175,7 @@ async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> No
         }
         desired[run_row["run_name"]] = entry
 
+    run_ids = {row["run_name"]: row["id"] for row in run_rows}
     headers = {"Authorization": f"Bearer {token}"}
     timeout = aiohttp.ClientTimeout(total=10)
     try:
@@ -196,5 +201,23 @@ async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> No
                     headers=headers,
                 ) as resp:
                     resp.raise_for_status()
+            # Pull the appliance's request buckets so gateway-routed traffic
+            # feeds the RPS autoscaler like in-server proxy traffic does (the
+            # reference's server pulls its gateway's access-log stats the same
+            # way). Each pull replaces this gateway's window — no double count.
+            async with session.get(
+                f"{endpoint}/api/registry/stats", headers=headers
+            ) as resp:
+                if resp.status == 200:
+                    stats_rows = []
+                    for svc in await resp.json():
+                        run_id = run_ids.get(svc.get("run_name"))
+                        if run_id is None or svc.get("project") != project_row["name"]:
+                            continue
+                        for bucket, count in (svc.get("buckets") or {}).items():
+                            stats_rows.append((run_id, int(bucket), int(count)))
+                    proxy_service.stats.set_external(
+                        f"gw:{gateway_row['id']}", stats_rows
+                    )
     except (aiohttp.ClientError, OSError) as e:
         logger.warning("gateway %s sync failed: %s", gateway_row["name"], e)

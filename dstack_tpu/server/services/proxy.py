@@ -26,10 +26,10 @@ from dstack_tpu.server.services.runner import ssh as runner_ssh
 
 logger = logging.getLogger(__name__)
 
-STATS_WINDOW = 600.0  # seconds of request history kept per service
-
-
-STATS_BUCKET = 10.0  # persistence granularity (seconds)
+from dstack_tpu.core.services.stats_window import (  # noqa: F401 (re-export)
+    STATS_BUCKET,
+    STATS_WINDOW,
+)
 
 
 def _wall_offset() -> float:
@@ -50,19 +50,49 @@ class ServiceStats:
         # (run_id, bucket) -> count at last persist; lets each checkpoint write
         # only buckets that changed instead of re-upserting the whole window.
         self.persisted: Dict[Tuple[str, int], int] = {}
+        # source (e.g. "gw:<id>") -> {(run_id, wall_bucket): count} — request
+        # counts pulled from gateway appliances. Each pull REPLACES its
+        # source's map (the appliance keeps the authoritative window), so
+        # repeated polls never double-count; not persisted here — a server
+        # restart re-pulls from the appliances.
+        self._external: Dict[str, Dict[Tuple[str, int], int]] = {}
 
     def record(self, run_id: str, ts: Optional[float] = None) -> None:
         dq = self._requests.setdefault(run_id, collections.deque())
         dq.append(ts if ts is not None else time.monotonic())
         self._trim(dq)
 
+    def set_external(self, source: str, rows) -> None:
+        """Replace `source`'s pulled window: rows of (run_id, bucket, count)."""
+        self._external[source] = {
+            (run_id, int(bucket)): int(count) for run_id, bucket, count in rows
+        }
+
+    def drop_external(self, source: str) -> None:
+        self._external.pop(source, None)
+
     def rps(self, run_id: str, window: float = 60.0) -> float:
+        n = 0.0
         dq = self._requests.get(run_id)
-        if not dq:
-            return 0.0
-        self._trim(dq)
-        cutoff = time.monotonic() - window
-        n = sum(1 for t in dq if t >= cutoff)
+        if dq:
+            self._trim(dq)
+            cutoff = time.monotonic() - window
+            n += sum(1 for t in dq if t >= cutoff)
+        now = time.time()
+        wall_cutoff = now - window
+        for source_map in self._external.values():
+            for (rid, bucket), count in source_map.items():
+                if rid != run_id:
+                    continue
+                # Weight a bucket by how much of its ELAPSED span overlaps the
+                # window, so the pulled path matches the deque path's accuracy:
+                # a whole trailing-edge bucket would inflate a 60s window by up
+                # to STATS_BUCKET/window, while the in-progress bucket's
+                # requests all arrived within the window and count fully.
+                elapsed = min(bucket + STATS_BUCKET, now) - bucket
+                overlap = min(bucket + STATS_BUCKET, now) - max(bucket, wall_cutoff)
+                if overlap > 0 and elapsed > 0:
+                    n += count * min(overlap / elapsed, 1.0)
         return n / window
 
     def flush_rows(self) -> List[Tuple[str, int, int]]:
@@ -107,6 +137,7 @@ class ServiceStats:
     def reset(self) -> None:
         self._requests.clear()
         self.persisted.clear()
+        self._external.clear()
 
 
 stats = ServiceStats()

@@ -102,6 +102,29 @@ class TestApplianceRouting:
             resp = await gw_client.get("/infer", headers={"Host": "other.example.com"})
             assert resp.status == 404
 
+            # Request stats: path (4) + model completion (1) + domain (1)
+            # admitted requests are bucketed for the autoscaler pull; listing
+            # GETs, unknown models, and unknown hosts don't count.
+            resp = await gw_client.get("/api/registry/stats", headers=auth)
+            assert resp.status == 200
+            svc_stats = await resp.json()
+            assert svc_stats[0]["run_name"] == "llama"
+            assert sum(svc_stats[0]["buckets"].values()) == 6
+
+            # Re-registration (replica churn) keeps the window.
+            await gw_client.post("/api/registry/register", json=entry, headers=auth)
+            resp = await gw_client.get("/api/registry/stats", headers=auth)
+            assert sum((await resp.json())[0]["buckets"].values()) == 6
+
+            # Scaled-to-zero: a request against an empty replica set 503s but
+            # still RECORDS — that demand is what wakes the service.
+            entry_zero = dict(entry, replicas=[])
+            await gw_client.post("/api/registry/register", json=entry_zero, headers=auth)
+            resp = await gw_client.get("/services/main/llama/generate")
+            assert resp.status == 503
+            resp = await gw_client.get("/api/registry/stats", headers=auth)
+            assert sum((await resp.json())[0]["buckets"].values()) == 7
+
             # Unregister removes the routes.
             await gw_client.post(
                 "/api/registry/unregister",
@@ -195,6 +218,18 @@ class TestGatewayE2E:
                     headers={"Authorization": f"Bearer {api.token}"},
                 )
                 assert [m["id"] for m in (await resp.json())["data"]] == ["pong-model"]
+
+                # Gateway-routed traffic feeds the autoscaler: the next sync
+                # pass pulls the appliance's request buckets into the server's
+                # stats window, so scaling sees demand that never touched the
+                # in-server proxy.
+                run_row = await api.db.fetchone(
+                    "SELECT id FROM runs WHERE run_name = 'msvc'"
+                )
+                proxy_service.stats.reset()  # drop in-server-proxy counts
+                assert proxy_service.stats.rps(run_row["id"], window=600.0) == 0
+                await tasks.process_gateways(api.db)
+                assert proxy_service.stats.rps(run_row["id"], window=600.0) > 0
 
                 # Stop the run; the next sync unregisters it from the appliance.
                 await _stop_run(api, "msvc")
