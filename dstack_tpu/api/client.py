@@ -111,8 +111,21 @@ class RunsApi:
         data = self._c.post(self._c._p("/runs/update"), {"run_spec": run_spec})
         return Run.model_validate(data)
 
-    def list(self) -> List[Run]:
-        data = self._c.post(self._c._p("/runs/list"))
+    def list(
+        self,
+        only_active: bool = False,
+        limit: int = 1000,
+        prev_submitted_at: Optional[str] = None,
+        prev_run_id: Optional[str] = None,
+    ) -> List[Run]:
+        """Newest first; keyset-paginate by passing the last run's
+        submitted_at/id as prev_submitted_at/prev_run_id."""
+        body = {"only_active": only_active, "limit": limit}
+        if prev_submitted_at is not None:
+            body["prev_submitted_at"] = prev_submitted_at
+        if prev_run_id is not None:
+            body["prev_run_id"] = prev_run_id
+        data = self._c.post(self._c._p("/runs/list"), body)
         return [Run.model_validate(r) for r in data]
 
     def get(self, run_name: str) -> Run:

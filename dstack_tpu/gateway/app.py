@@ -181,16 +181,24 @@ def create_app(token: str, tls_manager=None) -> web.Application:
         return web.json_response([e.to_dict() for e in registry.all()])
 
     async def registry_stats(request: web.Request) -> web.Response:
-        """Per-service request buckets for the control plane's autoscaler."""
+        """Per-service request buckets for the control plane's autoscaler.
+        `now` is THIS host's wall clock: bucket keys are local timestamps, so
+        the puller rebases them by the clock delta — an appliance VM without
+        NTP must not silently suppress (or future-date) scaling signal."""
         _auth(request)
-        return web.json_response([
-            {
-                "project": e.project,
-                "run_name": e.run_name,
-                "buckets": {str(b): c for b, c in sorted(e.request_buckets.items())},
-            }
-            for e in registry.all()
-        ])
+        import time as _time
+
+        return web.json_response({
+            "now": _time.time(),
+            "services": [
+                {
+                    "project": e.project,
+                    "run_name": e.run_name,
+                    "buckets": {str(b): c for b, c in sorted(e.request_buckets.items())},
+                }
+                for e in registry.all()
+            ],
+        })
 
     async def route_service(request: web.Request) -> web.StreamResponse:
         entry = registry.get(

@@ -105,7 +105,21 @@ async def submit(request: web.Request) -> web.Response:
 @routes.post("/api/project/{project_name}/runs/list")
 async def list_runs(request: web.Request) -> web.Response:
     _, project_row = await auth_project(request)
-    runs = await runs_service.list_runs(request.app["db"], project_id=project_row["id"])
+    body = await body_dict(request)
+    from dstack_tpu.core.errors import ServerClientError
+
+    try:
+        limit = int(body.get("limit") or 1000)
+    except (TypeError, ValueError):
+        raise ServerClientError("limit must be an integer")
+    runs = await runs_service.list_runs(
+        request.app["db"],
+        project_id=project_row["id"],
+        only_active=bool(body.get("only_active")),
+        limit=max(1, min(limit, 1000)),  # negative LIMIT is unlimited in sqlite
+        prev_submitted_at=body.get("prev_submitted_at"),
+        prev_run_id=body.get("prev_run_id"),
+    )
     return model_response(runs)
 
 

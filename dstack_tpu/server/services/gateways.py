@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 import uuid as uuid_mod
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import aiohttp
 
@@ -127,6 +128,37 @@ def gateway_endpoint(row) -> Optional[str]:
     return f"http://{ip}:{port}"
 
 
+def stats_rows_from_payload(
+    payload,
+    run_ids: Dict[str, str],
+    project_name: str,
+    now: Optional[float] = None,
+) -> List[Tuple[str, int, int]]:
+    """(run_id, bucket, count) rows from an appliance's /api/registry/stats.
+
+    Bucket keys are the APPLIANCE's wall clock; they are rebased by the clock
+    delta (`now` - the payload's own `now`) so a skewed, e.g. NTP-less,
+    gateway VM can neither silently age its demand out of the scaling window
+    nor future-date it."""
+    now = time.time() if now is None else now
+    skew = 0.0
+    if isinstance(payload, dict):
+        appliance_now = payload.get("now")
+        services = payload.get("services") or []
+        if isinstance(appliance_now, (int, float)):
+            skew = now - appliance_now
+    else:  # older appliance: bare list, assume clocks agree
+        services = payload
+    rows: List[Tuple[str, int, int]] = []
+    for svc in services:
+        run_id = run_ids.get(svc.get("run_name"))
+        if run_id is None or svc.get("project") != project_name:
+            continue
+        for bucket, count in (svc.get("buckets") or {}).items():
+            rows.append((run_id, int(int(bucket) + skew), int(count)))
+    return rows
+
+
 async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> None:
     """Push every running service's replica endpoints to the appliance registry;
     unregister services that no longer run. Idempotent per pass."""
@@ -209,13 +241,9 @@ async def sync_services_to_gateway(db: Database, project_row, gateway_row) -> No
                 f"{endpoint}/api/registry/stats", headers=headers
             ) as resp:
                 if resp.status == 200:
-                    stats_rows = []
-                    for svc in await resp.json():
-                        run_id = run_ids.get(svc.get("run_name"))
-                        if run_id is None or svc.get("project") != project_row["name"]:
-                            continue
-                        for bucket, count in (svc.get("buckets") or {}).items():
-                            stats_rows.append((run_id, int(bucket), int(count)))
+                    stats_rows = stats_rows_from_payload(
+                        await resp.json(), run_ids, project_row["name"]
+                    )
                     proxy_service.stats.set_external(
                         f"gw:{gateway_row['id']}", stats_rows
                     )

@@ -359,7 +359,13 @@ async def list_runs(
     project_ids: Optional[List[str]] = None,
     only_active: bool = False,
     limit: int = 1000,
+    prev_submitted_at: Optional[str] = None,
+    prev_run_id: Optional[str] = None,
 ) -> List[Run]:
+    """Keyset pagination (reference schemas/runs.py:16-18 — prev_submitted_at
+    + prev_run_id cursor, newest first): pass the last row's values to get
+    the next page; the (submitted_at, id) pair totally orders rows even when
+    timestamps collide."""
     sql = "SELECT * FROM runs WHERE deleted = 0"
     params: list = []
     if project_id is not None:
@@ -372,7 +378,21 @@ async def list_runs(
         params.extend(project_ids)
     if only_active:
         sql += " AND status NOT IN ('terminated', 'failed', 'done')"
-    sql += " ORDER BY submitted_at DESC LIMIT ?"
+    if prev_submitted_at is not None:
+        # Normalize to the canonical storage format (UTC isoformat) so the
+        # lexicographic comparison is a correct time comparison whatever
+        # offset/precision the client echoed back.
+        try:
+            prev_submitted_at = to_iso(from_iso(prev_submitted_at))
+        except (TypeError, ValueError):  # non-string JSON raises TypeError
+            raise ServerClientError("prev_submitted_at must be an ISO timestamp")
+        if prev_run_id is not None:
+            sql += " AND (submitted_at < ? OR (submitted_at = ? AND id < ?))"
+            params.extend([prev_submitted_at, prev_submitted_at, str(prev_run_id)])
+        else:
+            sql += " AND submitted_at < ?"
+            params.append(prev_submitted_at)
+    sql += " ORDER BY submitted_at DESC, id DESC LIMIT ?"
     params.append(limit)
     rows = await db.fetchall(sql, params)
     return await rows_to_runs(db, rows)

@@ -107,14 +107,16 @@ class TestApplianceRouting:
             # GETs, unknown models, and unknown hosts don't count.
             resp = await gw_client.get("/api/registry/stats", headers=auth)
             assert resp.status == 200
-            svc_stats = await resp.json()
+            payload = await resp.json()
+            assert isinstance(payload["now"], float)  # for skew rebasing
+            svc_stats = payload["services"]
             assert svc_stats[0]["run_name"] == "llama"
             assert sum(svc_stats[0]["buckets"].values()) == 6
 
             # Re-registration (replica churn) keeps the window.
             await gw_client.post("/api/registry/register", json=entry, headers=auth)
             resp = await gw_client.get("/api/registry/stats", headers=auth)
-            assert sum((await resp.json())[0]["buckets"].values()) == 6
+            assert sum((await resp.json())["services"][0]["buckets"].values()) == 6
 
             # Scaled-to-zero: a request against an empty replica set 503s but
             # still RECORDS — that demand is what wakes the service.
@@ -123,7 +125,7 @@ class TestApplianceRouting:
             resp = await gw_client.get("/services/main/llama/generate")
             assert resp.status == 503
             resp = await gw_client.get("/api/registry/stats", headers=auth)
-            assert sum((await resp.json())[0]["buckets"].values()) == 7
+            assert sum((await resp.json())["services"][0]["buckets"].values()) == 7
 
             # Unregister removes the routes.
             await gw_client.post(
@@ -347,3 +349,30 @@ class TestRateLimits:
                 await _stop_run(api, "rlsvc")
         finally:
             logs_service.set_log_storage(None)
+
+
+class TestStatsSkewRebasing:
+    def test_buckets_rebase_by_clock_delta(self):
+        from dstack_tpu.server.services.gateways import stats_rows_from_payload
+
+        run_ids = {"svc": "run-1"}
+        payload = {
+            "now": 1_000_000.0,  # appliance clock 120s behind the server
+            "services": [
+                {"project": "main", "run_name": "svc", "buckets": {"999990": 5}},
+                {"project": "other", "run_name": "svc", "buckets": {"999990": 9}},
+                {"project": "main", "run_name": "ghost", "buckets": {"999990": 9}},
+            ],
+        }
+        rows = stats_rows_from_payload(payload, run_ids, "main", now=1_000_120.0)
+        # Only the matching project+run survives; bucket shifted by +120.
+        assert rows == [("run-1", 999990 + 120, 5)]
+
+    def test_legacy_list_payload_assumes_no_skew(self):
+        from dstack_tpu.server.services.gateways import stats_rows_from_payload
+
+        rows = stats_rows_from_payload(
+            [{"project": "main", "run_name": "svc", "buckets": {"100": 2}}],
+            {"svc": "run-1"}, "main", now=500.0,
+        )
+        assert rows == [("run-1", 100, 2)]

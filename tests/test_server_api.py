@@ -332,3 +332,79 @@ class TestApiCompatibility:
                 "/api/project/main/runs/list", json={}, headers=headers
             )
             assert resp.status == 200
+
+
+class TestRunsPagination:
+    """Keyset pagination on runs/list (reference schemas/runs.py:16-18)."""
+
+    async def test_cursor_walks_all_pages_without_overlap(self):
+        from tests.common import api_server
+
+        async with api_server() as api:
+            for i in range(7):
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {"run_spec": {"run_name": f"pg-{i}", "configuration": {
+                        "type": "task", "commands": ["true"]}}},
+                )
+            seen = []
+            cursor = {}
+            while True:
+                page = await api.post(
+                    "/api/project/main/runs/list", {"limit": 3, **cursor}
+                )
+                if not page:
+                    break
+                seen.extend(r["run_spec"]["run_name"] for r in page)
+                assert len(page) <= 3
+                cursor = {
+                    "prev_submitted_at": page[-1]["submitted_at"],
+                    "prev_run_id": page[-1]["id"],
+                }
+            assert sorted(seen) == sorted(f"pg-{i}" for i in range(7))
+            assert len(seen) == len(set(seen)), "pages overlapped"
+
+    async def test_bad_cursor_is_client_error(self):
+        from tests.common import api_server
+
+        async with api_server() as api:
+            headers = {"Authorization": f"Bearer {api.token}"}
+            for bad_body in (
+                {"prev_submitted_at": "not-a-time"},
+                {"prev_submitted_at": 123},     # non-string cursor
+                {"limit": "abc"},               # non-numeric limit
+            ):
+                resp = await api.client.post(
+                    "/api/project/main/runs/list", json=bad_body, headers=headers
+                )
+                assert resp.status == 400, bad_body
+            # Negative limit must not become sqlite's "unlimited".
+            resp = await api.client.post(
+                "/api/project/main/runs/list", json={"limit": -1}, headers=headers
+            )
+            assert resp.status == 200
+            assert len(await resp.json()) <= 1
+
+    async def test_only_active_filter(self):
+        from tests.common import api_server
+        from tests.test_services import _drive
+
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/runs/submit",
+                {"run_spec": {"run_name": "act-1", "configuration": {
+                    "type": "task", "commands": ["true"]}}},
+            )
+            import asyncio
+            import time
+
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                await _drive(api)
+                run = await api.post("/api/project/main/runs/get", {"run_name": "act-1"})
+                if run["status"] in ("done", "failed"):
+                    break
+                await asyncio.sleep(0.05)
+            assert run["status"] == "done"
+            active = await api.post("/api/project/main/runs/list", {"only_active": True})
+            assert all(r["run_spec"]["run_name"] != "act-1" for r in active)
