@@ -234,6 +234,7 @@ def ring_attention(
     *,
     causal: bool = True,
     block_size: int = 512,
+    batch_axes=("dp", "fsdp"),
 ) -> jax.Array:
     """Sequence-parallel attention over the ``sp`` mesh axis.
 
@@ -246,7 +247,7 @@ def ring_attention(
     if sp_size == 1:
         return blockwise_attention(q, k, v, causal=causal, block_size=block_size)
 
-    qspec = P(("dp", "fsdp"), "sp", "tp", None)
+    qspec = P(batch_axes, "sp", "tp", None)
 
     @functools.partial(
         shard_map,

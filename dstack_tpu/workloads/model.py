@@ -71,7 +71,11 @@ def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
 
 
 def _embed_lookup(
-    embed: jax.Array, tokens: jax.Array, mesh: Optional[Mesh], adt
+    embed: jax.Array,
+    tokens: jax.Array,
+    mesh: Optional[Mesh],
+    adt,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
 ) -> jax.Array:
     """Token embedding lookup, partition-aware.
 
@@ -95,7 +99,7 @@ def _embed_lookup(
         # result moves), so constrain just the output.
         return jax.lax.with_sharding_constraint(
             embed.astype(adt)[tokens],
-            NamedSharding(mesh, P(("dp", "fsdp"), "sp", None)),
+            NamedSharding(mesh, P(batch_axes, "sp", None)),
         )
     if v % tp != 0:
         # tp-sharded but indivisible vocab: SPMD would replicate the table as a
@@ -105,7 +109,7 @@ def _embed_lookup(
             embed.astype(adt), NamedSharding(mesh, P(None, None))
         )
         return jax.lax.with_sharding_constraint(
-            emb[tokens], NamedSharding(mesh, P(("dp", "fsdp"), "sp", None))
+            emb[tokens], NamedSharding(mesh, P(batch_axes, "sp", None))
         )
     v_loc = v // tp
     emb = jax.lax.with_sharding_constraint(
@@ -123,8 +127,8 @@ def _embed_lookup(
     return shard_map(
         local_lookup,
         mesh=mesh,
-        in_specs=(P("tp", None), P(("dp", "fsdp"), "sp")),
-        out_specs=P(("dp", "fsdp"), "sp", None),
+        in_specs=(P("tp", None), P(batch_axes, "sp")),
+        out_specs=P(batch_axes, "sp", None),
     )(emb, tokens)
 
 
@@ -141,6 +145,86 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def attention_sublayer(
+    x: jax.Array,
+    layer: Params,
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    mesh: Optional[Mesh],
+    act_constraint,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+) -> jax.Array:
+    """Pre-norm attention + residual. Module-level so the pipeline-parallel
+    stage (pipeline.py) and the MoE decoder (moe.py) run the exact same
+    attention path as the dense model. `batch_axes` names the mesh axes the
+    batch dim is sharded over (MoE adds "ep")."""
+    adt = x.dtype
+    b, t = x.shape[0], x.shape[1]
+    name = checkpoint_name
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+
+    h_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = name(jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
+                        preferred_element_type=jnp.float32).astype(adt), "proj")
+    k = name(jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
+                        preferred_element_type=jnp.float32).astype(adt), "proj")
+    v = name(jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
+                        preferred_element_type=jnp.float32).astype(adt), "proj")
+    q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = act_constraint(q, P(batch_axes, "sp", "tp", None))
+    k = act_constraint(k, P(batch_axes, "sp", "tp", None))
+    v = act_constraint(v, P(batch_axes, "sp", "tp", None))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if use_sp:
+        o = ring_attention(q, k, v, mesh, batch_axes=batch_axes)
+    elif cfg.attn_impl == "flash" and mesh is None and flash_available():
+        # Flash only without a mesh: a Pallas tpu_custom_call has no SPMD
+        # partitioning rule, so under a sharded jit it would force operand
+        # replication. Sharded runs use blockwise/ring (shard_map) instead.
+        o = flash_attention_tpu(q, k, v)
+    elif cfg.attn_impl == "plain":
+        o = plain_attention(q, k, v)
+    else:
+        o = blockwise_attention(q, k, v)
+    o = name(o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim), "proj")
+    attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
+                          preferred_element_type=jnp.float32).astype(adt)
+    return x + act_constraint(attn_out, P(batch_axes, "sp", None))
+
+
+def transformer_block(
+    x: jax.Array,
+    layer: Params,
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """One dense decoder block (attention + SwiGLU MLP, both pre-norm residual)."""
+    adt = x.dtype
+    name = checkpoint_name
+
+    def act_constraint(a, spec):
+        if mesh is None:
+            return a
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
+    x = attention_sublayer(x, layer, cfg, positions, mesh, act_constraint)
+
+    h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = name(jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt), "proj")
+    up = name(jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
+                         preferred_element_type=jnp.float32).astype(adt), "proj")
+    hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
+    hidden = act_constraint(hidden, P(("dp", "fsdp"), "sp", "tp"))
+    mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
+                         preferred_element_type=jnp.float32).astype(adt)
+    return x + act_constraint(mlp_out, P(("dp", "fsdp"), "sp", None))
+
+
 def forward(
     params: Params,
     tokens: jax.Array,  # [B, T] int32
@@ -154,8 +238,7 @@ def forward(
     `mesh` is given, activation sharding constraints are inserted and attention
     runs ring-parallel over `sp`."""
     adt = jnp.dtype(cfg.dtype)
-    b, t = tokens.shape
-    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+    t = tokens.shape[1]
 
     def act_constraint(x, spec):
         if mesh is None:
@@ -166,51 +249,8 @@ def forward(
     x = act_constraint(x, P(("dp", "fsdp"), "sp", None))
     positions = jnp.arange(t)
 
-    name = checkpoint_name
-
     def block(x, layer):
-        h_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = name(jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
-                            preferred_element_type=jnp.float32).astype(adt), "proj")
-        k = name(jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
-                            preferred_element_type=jnp.float32).astype(adt), "proj")
-        v = name(jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
-                            preferred_element_type=jnp.float32).astype(adt), "proj")
-        q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-        q = act_constraint(q, P(("dp", "fsdp"), "sp", "tp", None))
-        k = act_constraint(k, P(("dp", "fsdp"), "sp", "tp", None))
-        v = act_constraint(v, P(("dp", "fsdp"), "sp", "tp", None))
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        if use_sp:
-            o = ring_attention(q, k, v, mesh)
-        elif cfg.attn_impl == "flash" and mesh is None and flash_available():
-            # Flash only without a mesh: a Pallas tpu_custom_call has no SPMD
-            # partitioning rule, so under a sharded jit it would force operand
-            # replication. Sharded runs use blockwise/ring (shard_map) instead.
-            o = flash_attention_tpu(q, k, v)
-        elif cfg.attn_impl == "plain":
-            o = plain_attention(q, k, v)
-        else:
-            o = blockwise_attention(q, k, v)
-        o = name(o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim), "proj")
-        attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
-                              preferred_element_type=jnp.float32).astype(adt)
-        x = x + act_constraint(attn_out, P(("dp", "fsdp"), "sp", None))
-
-        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = name(jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
-                               preferred_element_type=jnp.float32).astype(adt), "proj")
-        up = name(jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
-                             preferred_element_type=jnp.float32).astype(adt), "proj")
-        hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
-        hidden = act_constraint(hidden, P(("dp", "fsdp"), "sp", "tp"))
-        mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
-                             preferred_element_type=jnp.float32).astype(adt)
-        x = x + act_constraint(mlp_out, P(("dp", "fsdp"), "sp", None))
-        return x
+        return transformer_block(x, layer, cfg, positions, mesh)
 
     layer_params = {
         k: params[k]
@@ -277,6 +317,38 @@ def _chunked_nll(
     return total_nll, total_cnt
 
 
+def pick_loss_chunk(cfg: LlamaConfig, seq_len: int) -> int:
+    """Largest divisor of seq_len that is <= cfg.loss_chunk, keeping the
+    chunked path (and its HBM saving) for any length; 0 = use full logits
+    (either loss_chunk is off or no usable divisor exists)."""
+    if not cfg.loss_chunk:
+        return 0
+    chunk = next(
+        (c for c in range(min(cfg.loss_chunk, seq_len), 0, -1)
+         if seq_len % c == 0),
+        1,
+    )
+    if chunk < max(1, cfg.loss_chunk // 8):
+        import warnings
+
+        warnings.warn(
+            f"loss_chunk={cfg.loss_chunk} has no usable divisor of seq_len="
+            f"{seq_len} (best {chunk}); falling back to full logits",
+            stacklevel=3,
+        )
+        return 0
+    return chunk
+
+
+def masked_ce(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean cross-entropy over targets >= 0 (-1 = ignore); logits fp32."""
+    mask = targets >= 0
+    safe_targets = jnp.where(mask, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
 def loss_fn(
     params: Params,
     tokens: jax.Array,   # [B, T]
@@ -284,31 +356,10 @@ def loss_fn(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
-    if cfg.loss_chunk:
-        # Largest divisor of T that is <= loss_chunk keeps the chunked path (and
-        # its HBM saving) for any length instead of silently materializing
-        # [B,T,V] fp32 logits when T % loss_chunk != 0.
-        chunk = next(
-            (c for c in range(min(cfg.loss_chunk, tokens.shape[1]), 0, -1)
-             if tokens.shape[1] % c == 0),
-            1,
-        )
-        if chunk < max(1, cfg.loss_chunk // 8):
-            import warnings
-
-            warnings.warn(
-                f"loss_chunk={cfg.loss_chunk} has no usable divisor of seq_len="
-                f"{tokens.shape[1]} (best {chunk}); falling back to full logits",
-                stacklevel=2,
-            )
-        else:
-            hidden = forward(params, tokens, cfg, mesh, return_hidden=True)
-            lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
-            total_nll, total_cnt = _chunked_nll(hidden, lm_head, targets, chunk)
-            return total_nll / jnp.maximum(total_cnt, 1)
-    logits = forward(params, tokens, cfg, mesh)
-    mask = targets >= 0
-    safe_targets = jnp.where(mask, targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    chunk = pick_loss_chunk(cfg, tokens.shape[1])
+    if chunk:
+        hidden = forward(params, tokens, cfg, mesh, return_hidden=True)
+        lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
+        total_nll, total_cnt = _chunked_nll(hidden, lm_head, targets, chunk)
+        return total_nll / jnp.maximum(total_cnt, 1)
+    return masked_ce(forward(params, tokens, cfg, mesh), targets)

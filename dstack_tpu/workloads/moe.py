@@ -1,0 +1,345 @@
+"""Mixture-of-Experts decoder with expert parallelism over an ``ep`` mesh axis.
+
+The Mixtral-class model family the dense Llama workload doesn't cover: each
+block keeps the dense attention path (model.attention_sublayer — same ring/sp
+behavior) but replaces the SwiGLU MLP with a token-choice top-k router over E
+experts.
+
+TPU-first design (GShard/Switch dense-dispatch, the scaling-book MoE recipe):
+routing is expressed as two einsums against a capacity-bounded one-hot
+dispatch/combine tensor — static shapes, no data-dependent gather/scatter, so
+XLA tiles everything onto the MXU and SPMD-partitions it. Experts carry a
+leading E axis sharded over ``ep``; tokens are sharded over (dp, fsdp, ep).
+The dispatch einsum's output is expert-sharded while its input is
+token-sharded, which is exactly the annotation that makes XLA insert the
+canonical all-to-all pair (tokens -> experts -> tokens) over ICI. Tokens
+beyond an expert's capacity are dropped (standard Switch behavior); the
+load-balancing auxiliary loss keeps the router from collapsing onto few
+experts so drops stay rare.
+
+Parity: the reference orchestrates MoE workloads (Mixtral examples) but ships
+no parallelism of its own; this is the workload-side ep counterpart, like
+model.py is for dp/fsdp/tp/sp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads.config import LlamaConfig
+
+Params = Dict[str, jax.Array]
+
+MOE_MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    # Per-expert slots = top_k * T * capacity_factor / E (rounded up): 1.0 is
+    # exact under perfect balance; >1 absorbs imbalance at the cost of padding.
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def num_params(self) -> int:
+        d, v = self.d_model, self.vocab_size
+        attn = d * self.n_heads * self.head_dim + 2 * d * self.n_kv_heads * self.head_dim
+        attn += self.n_heads * self.head_dim * d
+        moe = self.n_experts * 3 * d * self.d_ff + d * self.n_experts  # experts + router
+        per_layer = attn + moe + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def active_params(self) -> int:
+        """Params touched per token (top_k experts) — the MoE efficiency claim."""
+        d, v = self.d_model, self.vocab_size
+        attn = d * self.n_heads * self.head_dim + 2 * d * self.n_kv_heads * self.head_dim
+        attn += self.n_heads * self.head_dim * d
+        moe = self.top_k * 3 * d * self.d_ff + d * self.n_experts
+        per_layer = attn + moe + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+
+MOE_PRESETS = {
+    "moe_test": MoeConfig(
+        vocab_size=4096, d_model=256, n_layers=2, n_heads=8, n_kv_heads=4, d_ff=512,
+        max_seq_len=2048, param_dtype="float32", n_experts=4, top_k=2,
+    ),
+    # Mixtral-8x7B-class geometry (the reference's MoE example family);
+    # loss_chunk keeps [B,T,V] fp32 logits from ever materializing.
+    "mixtral_8x7b": MoeConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192, n_experts=8, top_k=2, loss_chunk=512,
+    ),
+}
+
+
+def make_moe_mesh(
+    dp: int = 1,
+    fsdp: int = 1,
+    ep: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    devices=None,
+) -> Mesh:
+    """(dp, fsdp, ep, tp, sp) mesh; ep=None absorbs the remaining devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if ep is None:
+        denom = dp * fsdp * tp * sp
+        if n % denom != 0:
+            raise ValueError(f"{n} devices not divisible by dp*fsdp*tp*sp={denom}")
+        ep = n // denom
+    if dp * fsdp * ep * tp * sp != n:
+        raise ValueError(f"mesh {dp}x{fsdp}x{ep}x{tp}x{sp} != {n} devices")
+    arr = np.array(devices).reshape(dp, fsdp, ep, tp, sp)
+    return Mesh(arr, MOE_MESH_AXES)
+
+
+# Tokens/activations shard over ALL data-like axes (ep included — outside the
+# expert computation ep behaves as extra data parallelism, so attention is
+# never replicated across it); experts shard over ep, their hidden over tp.
+MOE_BATCH = P(("dp", "fsdp", "ep"), "sp")
+MOE_ACT = P(("dp", "fsdp", "ep"), "sp", None)
+
+MOE_PARAM_SPECS: Dict[str, P] = {
+    "embed": P("tp", ("dp", "fsdp")),
+    "wq": P(None, ("dp", "fsdp"), "tp"),
+    "wk": P(None, ("dp", "fsdp"), "tp"),
+    "wv": P(None, ("dp", "fsdp"), "tp"),
+    "wo": P(None, "tp", ("dp", "fsdp")),
+    "router": P(None, None, None),                  # [L, D, E] tiny, replicated
+    "w_gate": P(None, "ep", ("dp", "fsdp"), "tp"),  # [L, E, D, F]
+    "w_up": P(None, "ep", ("dp", "fsdp"), "tp"),
+    "w_down": P(None, "ep", "tp", ("dp", "fsdp")),  # [L, E, F, D]
+    "attn_norm": P(None, None),
+    "mlp_norm": P(None, None),
+    "final_norm": P(None),
+    "lm_head": P(("dp", "fsdp"), "tp"),
+}
+
+
+def init_moe_params(cfg: MoeConfig, key: jax.Array) -> Params:
+    pdt = jnp.dtype(cfg.param_dtype)
+    d, v, f, e = cfg.d_model, cfg.vocab_size, cfg.d_ff, cfg.n_experts
+    h, kh, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    keys = jax.random.split(key, 12)
+
+    def dense(k, *shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(pdt)
+
+    return {
+        "embed": dense(keys[0], v, d, fan_in=d),
+        "wq": dense(keys[1], L, d, h * hd, fan_in=d),
+        "wk": dense(keys[2], L, d, kh * hd, fan_in=d),
+        "wv": dense(keys[3], L, d, kh * hd, fan_in=d),
+        "wo": dense(keys[4], L, h * hd, d, fan_in=h * hd),
+        "router": dense(keys[5], L, d, e, fan_in=d),
+        "w_gate": dense(keys[6], L, e, d, f, fan_in=d),
+        "w_up": dense(keys[7], L, e, d, f, fan_in=d),
+        "w_down": dense(keys[8], L, e, f, d, fan_in=f),
+        "attn_norm": jnp.ones((L, d), pdt),
+        "mlp_norm": jnp.ones((L, d), pdt),
+        "final_norm": jnp.ones((d,), pdt),
+        "lm_head": dense(keys[9], d, v, fan_in=d),
+    }
+
+
+def expert_capacity(cfg: MoeConfig, tokens_per_group: int) -> int:
+    cap = int(np.ceil(cfg.top_k * tokens_per_group * cfg.capacity_factor / cfg.n_experts))
+    return max(cap, 1)
+
+
+def top_k_routing(
+    router_logits: jax.Array,  # [G, S, E] fp32
+    top_k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(combine [G,S,E,C], dispatch [G,S,E,C] bool, aux_loss scalar).
+
+    Token-choice top-k with per-expert capacity: each token's k chosen gates
+    are renormalized; tokens claim expert slots in slot-major priority (all
+    first choices before any second choice — Switch's policy) and a token that
+    overflows its expert's capacity is dropped for that expert. The aux loss
+    is Switch eq.4: E * sum_e(fraction_routed_e * mean_prob_e), minimized at
+    uniform load."""
+    g, s, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)            # [G,S,E]
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)         # [G,S,K]
+    gate_vals = gate_vals / (jnp.sum(gate_vals, -1, keepdims=True) + 1e-9)
+
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)   # [G,S,K,E]
+
+    # Aux loss uses the FIRST choice as "routed to" (Switch counts top-1).
+    frac_routed = jnp.mean(onehot[:, :, 0, :], axis=1)        # [G,E]
+    mean_prob = jnp.mean(probs, axis=1)                       # [G,E]
+    aux = e * jnp.mean(jnp.sum(frac_routed * mean_prob, -1))
+
+    # Slot-major priority: flatten [K,S] so every slot-0 claim precedes any
+    # slot-1 claim, then a cumulative count per expert assigns positions.
+    oh_flat = onehot.transpose(0, 2, 1, 3).reshape(g, top_k * s, e)
+    pos = jnp.cumsum(oh_flat, axis=1) * oh_flat - 1.0         # [G,K*S,E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    disp_flat = oh_flat[..., None] * pos_oh * keep[..., None]  # [G,K*S,E,C]
+    disp = disp_flat.reshape(g, top_k, s, e, capacity).transpose(0, 2, 1, 3, 4)
+    gates = gate_vals[..., None, None]                         # [G,S,K,1,1]
+    combine = jnp.sum(disp.reshape(g, s, top_k, e, capacity) * gates, axis=2)
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+def moe_mlp(
+    x: jax.Array,        # [G, S, D] (activation dtype)
+    layer: Params,       # router [D,E], w_gate/w_up [E,D,F], w_down [E,F,D]
+    cfg: MoeConfig,
+    mesh: Optional[Mesh],
+) -> Tuple[jax.Array, jax.Array]:
+    """(out [G,S,D], aux_loss). The two dispatch einsums below are where SPMD
+    inserts the token<->expert all-to-alls: x is token-sharded, expert_in is
+    expert-sharded."""
+    adt = x.dtype
+    g, s, d = x.shape
+    cap = expert_capacity(cfg, s)
+
+    router_logits = jnp.einsum(
+        "gsd,de->gse", x, layer["router"].astype(adt),
+        preferred_element_type=jnp.float32,
+    )
+    combine, dispatch, aux = top_k_routing(router_logits, cfg.top_k, cap)
+
+    def constrain(a, spec):
+        if mesh is None:
+            return a
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
+    combine = constrain(combine, P(("dp", "fsdp", "ep"), "sp", None, None))
+
+    # tokens -> experts (all-to-all over ep happens here)
+    expert_in = jnp.einsum("gsec,gsd->egcd", dispatch.astype(adt), x)
+    expert_in = constrain(expert_in, P("ep", ("dp", "fsdp"), None, None))
+
+    gate = jnp.einsum("egcd,edf->egcf", expert_in, layer["w_gate"].astype(adt),
+                      preferred_element_type=jnp.float32).astype(adt)
+    up = jnp.einsum("egcd,edf->egcf", expert_in, layer["w_up"].astype(adt),
+                    preferred_element_type=jnp.float32).astype(adt)
+    hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
+    hidden = constrain(hidden, P("ep", ("dp", "fsdp"), None, "tp"))
+    expert_out = jnp.einsum("egcf,efd->egcd", hidden, layer["w_down"].astype(adt),
+                            preferred_element_type=jnp.float32).astype(adt)
+    expert_out = constrain(expert_out, P("ep", ("dp", "fsdp"), None, None))
+
+    # experts -> tokens (the return all-to-all), weighted by the gates
+    out = jnp.einsum("gsec,egcd->gsd", combine.astype(adt), expert_out)
+    return constrain(out, MOE_ACT), aux
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [G, S]
+    cfg: MoeConfig,
+    mesh: Optional[Mesh] = None,
+    return_hidden: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """(logits [G,S,V] fp32, total_aux_loss) — or (hidden [G,S,D], aux) when
+    `return_hidden` (feeds the chunked cross-entropy)."""
+    adt = jnp.dtype(cfg.dtype)
+    t = tokens.shape[1]
+
+    def constrain(a, spec):
+        if mesh is None:
+            return a
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
+    # Embedding: the dense model's partitioned lookup (vocab tp-sharded; a
+    # plain gather would trigger SPMD's involuntary full rematerialization);
+    # ep joins the batch axes.
+    x = model_lib._embed_lookup(
+        params["embed"], tokens, mesh, adt, batch_axes=("dp", "fsdp", "ep")
+    )
+    x = constrain(x, MOE_ACT)
+    positions = jnp.arange(t)
+
+    def block(x, layer):
+        # Same attention path as the dense model, with ep in the batch axes so
+        # ring attention (sp>1) and the flash-vs-mesh guard behave identically.
+        x = model_lib.attention_sublayer(
+            x, layer, cfg, positions, mesh, constrain,
+            batch_axes=("dp", "fsdp", "ep"),
+        )
+        x = constrain(x, MOE_ACT)
+        h = model_lib._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        moe_out, aux = moe_mlp(h, layer, cfg, mesh)
+        return x + moe_out, aux
+
+    block_fn = jax.checkpoint(block, prevent_cse=True) if cfg.remat else block
+
+    layer_params = {
+        k: params[k]
+        for k in ("wq", "wk", "wv", "wo", "router", "w_gate", "w_up", "w_down",
+                  "attn_norm", "mlp_norm")
+    }
+
+    def scan_body(x, layer):
+        x, aux = block_fn(x, layer)
+        return x, aux
+
+    x, aux_per_layer = jax.lax.scan(scan_body, x, layer_params)
+    x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
+    aux_total = jnp.sum(aux_per_layer)
+    if return_hidden:
+        return x, aux_total
+    logits = jnp.einsum("gsd,dv->gsv", x, params["lm_head"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return constrain(logits, MOE_ACT), aux_total
+
+
+def loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: MoeConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    chunk = model_lib.pick_loss_chunk(cfg, tokens.shape[1])
+    if chunk:
+        hidden, aux = forward(params, tokens, cfg, mesh, return_hidden=True)
+        lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
+        total_nll, total_cnt = model_lib._chunked_nll(hidden, lm_head, targets, chunk)
+        ce = total_nll / jnp.maximum(total_cnt, 1)
+    else:
+        logits, aux = forward(params, tokens, cfg, mesh)
+        ce = model_lib.masked_ce(logits, targets)
+    return ce + cfg.aux_loss_weight * aux
+
+
+def moe_param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, spec) for k, spec in MOE_PARAM_SPECS.items()}
+
+
+def shard_moe_params(params: Params, mesh: Mesh) -> Params:
+    shardings = moe_param_sharding(mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def make_moe_train_step(cfg: MoeConfig, optimizer, mesh: Optional[Mesh] = None):
+    """jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss)."""
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg, mesh)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    bspec = NamedSharding(mesh, MOE_BATCH)
+    return jax.jit(step, donate_argnums=(0, 1),
+                   in_shardings=(None, None, bspec, bspec))

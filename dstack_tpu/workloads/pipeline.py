@@ -1,0 +1,194 @@
+"""Pipeline parallelism: GPipe microbatching over a ``pp`` mesh axis.
+
+The model's stacked layers split into S contiguous stages sharded over
+``pp``; microbatches flow stage-to-stage via ``ppermute`` on ICI inside one
+``shard_map`` (the scaling-book pipelining recipe: a rotating buffer, S-1
+bubble ticks, collectives explicit so XLA overlaps the permute with the next
+tick's compute). The stage computation is model.transformer_block — the SAME
+block the dense path runs, so pipelined and non-pipelined forward agree
+numerically (tests assert this).
+
+When to use: pp trades the all-gather bandwidth FSDP needs for point-to-point
+activation transfers — the right axis once a model's layers no longer fit
+even fully sharded, or across slower links. The mesh here is (dp, pp): data
+parallelism composes outside the pp axis; within a stage the non-layer params
+are replicated — composing fsdp/tp/sp INSIDE stages (per-stage sub-meshes) is
+not implemented.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads.config import LlamaConfig
+
+Params = Dict[str, jax.Array]
+
+PP_MESH_AXES = ("dp", "pp")
+
+LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+              "attn_norm", "mlp_norm")
+
+
+def make_pp_mesh(dp: int = 1, pp: Optional[int] = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if pp is None:
+        if n % dp != 0:
+            raise ValueError(f"{n} devices not divisible by dp={dp}")
+        pp = n // dp
+    if dp * pp != n:
+        raise ValueError(f"mesh {dp}x{pp} != {n} devices")
+    return Mesh(np.array(devices).reshape(dp, pp), PP_MESH_AXES)
+
+
+def stage_params_spec() -> Dict[str, P]:
+    """Layer-stacked tensors shard their leading L axis over pp (L/S layers
+    per stage, contiguous); everything else replicates."""
+    specs = {k: P("pp") for k in LAYER_KEYS}
+    specs.update({
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    })
+    return specs
+
+
+def shard_params_pp(params: Params, mesh: Mesh) -> Params:
+    specs = stage_params_spec()
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()
+    }
+
+
+def pipelined_forward(
+    params: Params,
+    tokens: jax.Array,  # [B, T]; B must divide into n_micro microbatches
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    n_micro: int,
+    return_hidden: bool = False,
+) -> jax.Array:
+    """Logits [B, T, V] fp32 — or the post-final-norm hidden [B, T, D] when
+    `return_hidden` (feeds the chunked cross-entropy) — computed with the pp
+    stages in a GPipe schedule.
+
+    Schedule: n_micro + S - 1 ticks. At tick i, stage s processes microbatch
+    (i - s) when 0 <= i - s < n_micro; activations hop one stage per tick via
+    ppermute. Bubble ticks compute on garbage and are masked out — on TPU the
+    uniform schedule (every shard does identical work every tick) is what lets
+    XLA compile ONE tick body and overlap the permute with compute.
+    """
+    if cfg.n_layers % mesh.shape["pp"] != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={mesh.shape['pp']}"
+        )
+    b, t = tokens.shape
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+    mb = b // n_micro
+    adt = jnp.dtype(cfg.dtype)
+    positions = jnp.arange(t)
+
+    # Embed outside the pipeline (replicated over pp; sharded over dp).
+    x = params["embed"].astype(adt)[tokens]  # [B,T,D]
+    x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P("dp", None, None)))
+    micro = x.reshape(n_micro, mb, t, -1)
+
+    layer_stack = {k: params[k] for k in LAYER_KEYS}
+
+    from jax.experimental.shard_map import shard_map
+
+    def stage_run(stage_layers, xs):
+        """Apply this stage's L/S layers to one microbatch activation."""
+
+        def body(h, layer):
+            return model_lib.transformer_block(h, layer, cfg, positions, None), None
+
+        # Honor cfg.remat like the dense forward: without it the backward pass
+        # stores every layer's residuals for every microbatch and tick —
+        # defeating pp's purpose of fitting models that don't fit.
+        body_fn = jax.checkpoint(body, prevent_cse=True) if cfg.remat else body
+        out, _ = jax.lax.scan(body_fn, xs, stage_layers)
+        return out
+
+    def pipeline_body(stage_layers, micro_local):
+        # Inside shard_map: stage_layers has the LOCAL [L/S, ...] slice;
+        # micro_local is the dp-local microbatch stream, replicated over pp.
+        pp = jax.lax.axis_size("pp")
+        sid = jax.lax.axis_index("pp")
+        n_mb = micro_local.shape[0]
+        ticks = n_mb + pp - 1
+
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, i):
+            recv, outputs = carry
+            feed_idx = jnp.clip(i, 0, n_mb - 1)
+            inp = jnp.where(sid == 0, micro_local[feed_idx], recv)
+            out = stage_run(stage_layers, inp)
+            # Hop to the next stage; the wrap-around into stage 0 is ignored
+            # (stage 0 always feeds from `micro_local`).
+            recv_next = jax.lax.ppermute(out, "pp", perm_fwd)
+            out_idx = i - (pp - 1)
+            valid = (sid == pp - 1) & (out_idx >= 0)
+            outputs = jax.lax.cond(
+                valid,
+                lambda o: o.at[jnp.clip(out_idx, 0, n_mb - 1)].set(out),
+                lambda o: o,
+                outputs,
+            )
+            return (recv_next, outputs), None
+
+        init = (
+            jnp.zeros_like(micro_local[0]),
+            jnp.zeros_like(micro_local),
+        )
+        (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # Only the last stage holds real outputs; replicate across pp so the
+        # caller sees one coherent [n_micro, mb, T, D].
+        return jax.lax.psum(
+            jnp.where(sid == pp - 1, outputs, jnp.zeros_like(outputs)), "pp"
+        )
+
+    outputs = shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=({k: P("pp") for k in LAYER_KEYS}, P(None, "dp", None, None)),
+        out_specs=P(None, "dp", None, None),
+        check_rep=False,
+    )(layer_stack, micro)
+
+    h = outputs.reshape(b, t, -1)
+    h = model_lib._rms_norm(h, params["final_norm"], cfg.norm_eps)
+    if return_hidden:
+        return h
+    logits = jnp.einsum("btd,dv->btv", h, params["lm_head"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def pipelined_loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    n_micro: int,
+) -> jax.Array:
+    chunk = model_lib.pick_loss_chunk(cfg, tokens.shape[1])
+    if chunk:
+        hidden = pipelined_forward(params, tokens, cfg, mesh, n_micro,
+                                   return_hidden=True)
+        lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
+        total_nll, total_cnt = model_lib._chunked_nll(hidden, lm_head, targets, chunk)
+        return total_nll / jnp.maximum(total_cnt, 1)
+    return model_lib.masked_ce(
+        pipelined_forward(params, tokens, cfg, mesh, n_micro), targets
+    )
