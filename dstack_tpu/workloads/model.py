@@ -225,6 +225,19 @@ def transformer_block(
     return x + act_constraint(mlp_out, P(("dp", "fsdp"), "sp", None))
 
 
+def remat_policy_of(cfg: LlamaConfig):
+    """cfg.remat_policy -> jax.checkpoint policy, shared by the dense, MoE,
+    and pipeline forwards so one config means one HBM/recompute profile.
+    "save_proj" saves the projection-matmul outputs (checkpoint-named "proj"
+    in attention_sublayer/transformer_block); backward then re-runs only
+    cheap elementwise ops + the score matmuls."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "save_proj":
+        return jax.checkpoint_policies.save_only_these_names("proj")
+    return None
+
+
 def forward(
     params: Params,
     tokens: jax.Array,  # [B, T] int32
@@ -258,16 +271,7 @@ def forward(
     }
     block_fn = block
     if cfg.remat:
-        policy = None
-        if cfg.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        elif cfg.remat_policy == "save_proj":
-            # Save the six projection-matmul outputs (named below); recompute
-            # everything else — attention scores/softmax and elementwise ops.
-            # Backward then re-runs only cheap ops + the score matmuls, for
-            # ~B*T*d*2 bytes/layer of HBM instead of the full residual set.
-            policy = jax.checkpoint_policies.save_only_these_names("proj")
-        block_fn = jax.checkpoint(block, prevent_cse=True, policy=policy)
+        block_fn = jax.checkpoint(block, prevent_cse=True, policy=remat_policy_of(cfg))
 
     def scan_body(x, layer):
         return block_fn(x, layer), None

@@ -44,10 +44,15 @@ MOE_MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp")
 class MoeConfig(LlamaConfig):
     n_experts: int = 8
     top_k: int = 2
-    # Per-expert slots = top_k * T * capacity_factor / E (rounded up): 1.0 is
-    # exact under perfect balance; >1 absorbs imbalance at the cost of padding.
+    # Per-expert slots = top_k * group * capacity_factor / E (rounded up): 1.0
+    # is exact under perfect balance; >1 absorbs imbalance at padding cost.
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # Routing group size (tokens): tokens are regrouped to ~this many before
+    # dispatch so the [groups, group, E, C] tensors stay O(group^2) instead of
+    # O(seq_len^2) — the GShard group trick. The largest divisor of the local
+    # token count <= this is used.
+    router_group: int = 1024
 
     def num_params(self) -> int:
         d, v = self.d_model, self.vocab_size
@@ -197,17 +202,23 @@ def top_k_routing(
 
 
 def moe_mlp(
-    x: jax.Array,        # [G, S, D] (activation dtype)
+    x: jax.Array,        # [B, S, D] (activation dtype)
     layer: Params,       # router [D,E], w_gate/w_up [E,D,F], w_down [E,F,D]
     cfg: MoeConfig,
     mesh: Optional[Mesh],
 ) -> Tuple[jax.Array, jax.Array]:
-    """(out [G,S,D], aux_loss). The two dispatch einsums below are where SPMD
+    """(out [B,S,D], aux_loss). The two dispatch einsums below are where SPMD
     inserts the token<->expert all-to-alls: x is token-sharded, expert_in is
-    expert-sharded."""
+    expert-sharded. Tokens are regrouped to ~router_group before dispatch so
+    the one-hot tensors scale with the group size, not the sequence length."""
     adt = x.dtype
-    g, s, d = x.shape
-    cap = expert_capacity(cfg, s)
+    b, s, d = x.shape
+    group = next(
+        (c for c in range(min(cfg.router_group, s), 0, -1) if s % c == 0), s
+    )
+    g = b * (s // group)
+    x = x.reshape(g, group, d)
+    cap = expert_capacity(cfg, group)
 
     router_logits = jnp.einsum(
         "gsd,de->gse", x, layer["router"].astype(adt),
@@ -220,7 +231,9 @@ def moe_mlp(
             return a
         return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
 
-    combine = constrain(combine, P(("dp", "fsdp", "ep"), "sp", None, None))
+    # Grouped tensors shard their group dim over the data axes (the group dim
+    # folds batch x sequence-chunks, so sp stays out of these specs).
+    combine = constrain(combine, P(("dp", "fsdp", "ep"), None, None, None))
 
     # tokens -> experts (all-to-all over ep happens here)
     expert_in = jnp.einsum("gsec,gsd->egcd", dispatch.astype(adt), x)
@@ -238,6 +251,7 @@ def moe_mlp(
 
     # experts -> tokens (the return all-to-all), weighted by the gates
     out = jnp.einsum("gsec,egcd->gsd", combine.astype(adt), expert_out)
+    out = out.reshape(b, s, d)
     return constrain(out, MOE_ACT), aux
 
 
@@ -279,7 +293,11 @@ def forward(
         moe_out, aux = moe_mlp(h, layer, cfg, mesh)
         return x + moe_out, aux
 
-    block_fn = jax.checkpoint(block, prevent_cse=True) if cfg.remat else block
+    block_fn = (
+        jax.checkpoint(block, prevent_cse=True,
+                       policy=model_lib.remat_policy_of(cfg))
+        if cfg.remat else block
+    )
 
     layer_params = {
         k: params[k]

@@ -111,10 +111,14 @@ def pipelined_forward(
         def body(h, layer):
             return model_lib.transformer_block(h, layer, cfg, positions, None), None
 
-        # Honor cfg.remat like the dense forward: without it the backward pass
-        # stores every layer's residuals for every microbatch and tick —
-        # defeating pp's purpose of fitting models that don't fit.
-        body_fn = jax.checkpoint(body, prevent_cse=True) if cfg.remat else body
+        # Honor cfg.remat (and its policy) like the dense forward: without it
+        # the backward pass stores every layer's residuals for every
+        # microbatch and tick — defeating pp's purpose of fitting models.
+        body_fn = (
+            jax.checkpoint(body, prevent_cse=True,
+                           policy=model_lib.remat_policy_of(cfg))
+            if cfg.remat else body
+        )
         out, _ = jax.lax.scan(body_fn, xs, stage_layers)
         return out
 

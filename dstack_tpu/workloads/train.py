@@ -96,6 +96,78 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _timed_loop(steps: int, batch: int, seq: int, do_step,
+                flops_per_step: float = 0.0) -> None:
+    """Shared throughput loop: `do_step()` advances state and returns loss."""
+    import time
+
+    t0 = time.time()
+    for i in range(steps):
+        loss = do_step()
+        if i == 0 or (i + 1) % 10 == 0:
+            jax.block_until_ready(loss)
+            dt = time.time() - t0
+            steps_done = 1 if i == 0 else 10
+            tok_s = steps_done * batch * seq / max(dt, 1e-9)
+            tf = (f" {steps_done * flops_per_step / max(dt, 1e-9) / 1e12:.1f} TF/s"
+                  if flops_per_step else "")
+            print(f"step {i + 1}/{steps} loss={float(loss):.4f} "
+                  f"{tok_s:,.0f} tok/s{tf}", flush=True)
+            t0 = time.time()
+    print("training done", flush=True)
+
+
+def _moe_main(args, moe_lib) -> None:
+    """MoE training entrypoint branch: experts over ep, the rest on dp."""
+    import math
+
+    if args.multislice:
+        raise SystemExit("--multislice is not supported for MoE configs yet")
+    devices = jax.devices()
+    n = len(devices)
+    cfg = moe_lib.MOE_PRESETS[args.config]
+    # ep must divide both the device count and the expert count; the default
+    # is the largest such axis (gcd), degrading to pure dp on odd fits.
+    ep = args.ep or math.gcd(n, cfg.n_experts)
+    if n % ep != 0:
+        raise SystemExit(f"{n} devices not divisible by ep={ep}")
+    if cfg.n_experts % ep != 0:
+        raise SystemExit(
+            f"n_experts={cfg.n_experts} not divisible by ep={ep};"
+            f" pick --ep from the divisors of both"
+        )
+    mesh = moe_lib.make_moe_mesh(dp=n // ep, fsdp=1, ep=ep, tp=1, sp=1,
+                                 devices=devices)
+    data_shards = mesh.shape["dp"] * mesh.shape["fsdp"] * mesh.shape["ep"]
+    batch = args.batch or 2 * data_shards
+    seq = args.seq or cfg.max_seq_len
+    print(f"config={args.config} devices={n} mesh={dict(mesh.shape)} "
+          f"experts={cfg.n_experts} top_k={cfg.top_k} batch={batch} seq={seq}",
+          flush=True)
+    optimizer = make_optimizer()
+    with mesh:
+        params = moe_lib.shard_moe_params(
+            moe_lib.init_moe_params(cfg, jax.random.PRNGKey(0)), mesh
+        )
+        opt_state = optimizer.init(params)
+        step_fn = moe_lib.make_moe_train_step(cfg, optimizer, mesh)
+        bspec = jax.sharding.NamedSharding(mesh, moe_lib.MOE_BATCH)
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                               cfg.vocab_size),
+            bspec,
+        )
+        state = {"params": params, "opt": opt_state}
+
+        def do_step():
+            state["params"], state["opt"], loss = step_fn(
+                state["params"], state["opt"], tokens, tokens
+            )
+            return loss
+
+        _timed_loop(args.steps, batch, seq, do_step)
+
+
 def main() -> None:
     """`python -m dstack_tpu.workloads.train` — the runnable training entrypoint
     the example configurations submit (examples/*.dstack.yml). Synthetic data;
@@ -106,14 +178,24 @@ def main() -> None:
     from dstack_tpu.workloads.config import PRESETS, get_config
     from dstack_tpu.workloads.sharding import make_mesh, make_multislice_mesh
 
+    from dstack_tpu.workloads import moe as moe_lib
+
     parser = argparse.ArgumentParser(prog="dstack_tpu.workloads.train")
-    parser.add_argument("--config", default="test", choices=sorted(PRESETS))
+    parser.add_argument("--config", default="test",
+                        choices=sorted(PRESETS) + sorted(moe_lib.MOE_PRESETS))
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--batch", type=int, default=0, help="global batch (0 = 2 per data shard)")
     parser.add_argument("--seq", type=int, default=0, help="sequence length (0 = config max)")
     parser.add_argument("--multislice", action="store_true",
                         help="leading dp axis over the MEGASCALE slice count")
+    parser.add_argument("--ep", type=int, default=0,
+                        help="expert-parallel axis size for MoE configs"
+                             " (0 = all devices on ep)")
     args = parser.parse_args()
+
+    if args.config in moe_lib.MOE_PRESETS:
+        _moe_main(args, moe_lib)
+        return
 
     cfg = get_config(args.config)
     devices = jax.devices()
@@ -140,21 +222,13 @@ def main() -> None:
             jax.random.randint(key, (batch, seq), 0, cfg.vocab_size), bspec
         )
         flops_per_step = cfg.flops_per_token(seq) * batch * seq
-        t0 = time.time()
-        for i in range(args.steps):
-            state, metrics = step_fn(state, tokens, tokens)
-            if i == 0 or (i + 1) % 10 == 0:
-                jax.block_until_ready(metrics["loss"])
-                dt = time.time() - t0
-                steps_done = 1 if i == 0 else 10
-                tok_s = steps_done * batch * seq / max(dt, 1e-9)
-                print(
-                    f"step {i + 1}/{args.steps} loss={float(metrics['loss']):.4f} "
-                    f"{tok_s:,.0f} tok/s {steps_done * flops_per_step / max(dt, 1e-9) / 1e12:.1f} TF/s",
-                    flush=True,
-                )
-                t0 = time.time()
-    print("training done", flush=True)
+        box = {"state": state}
+
+        def do_step():
+            box["state"], metrics = step_fn(box["state"], tokens, tokens)
+            return metrics["loss"]
+
+        _timed_loop(args.steps, batch, seq, do_step, flops_per_step)
 
 
 if __name__ == "__main__":
