@@ -173,7 +173,6 @@ def main() -> None:
     the example configurations submit (examples/*.dstack.yml). Synthetic data;
     prints per-step throughput and MFU so `dstack-tpu logs` shows live numbers."""
     import argparse
-    import time
 
     from dstack_tpu.workloads.config import PRESETS, get_config
     from dstack_tpu.workloads.sharding import make_mesh, make_multislice_mesh
