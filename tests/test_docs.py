@@ -46,6 +46,16 @@ class TestDocs:
         undocumented = real - documented - {"stats"}  # alias of metrics
         assert not undocumented, f"CLI commands missing from docs: {sorted(undocumented)}"
 
+    def test_repo_paths_in_docs_exist(self):
+        repo = DOCS.parent
+        pattern = re.compile(r"`((?:dstack_tpu|runner|tests|docker|examples)/[\w./-]+)`")
+        for page in DOCS.rglob("*.md"):
+            for match in pattern.finditer(page.read_text()):
+                path = match.group(1).rstrip("/.")
+                assert (repo / path).exists(), (
+                    f"{page.relative_to(repo)} references missing path {path}"
+                )
+
     def test_api_reference_paths_registered(self):
         from dstack_tpu.server.app import create_app
 
