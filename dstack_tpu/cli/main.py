@@ -300,19 +300,44 @@ def _attach_stream(client: Client, run_name: str) -> None:
         print(f"\ndetached; `dstack-tpu stop {run_name}` to stop the run", file=sys.stderr)
 
 
+def _watch_loop(render, watch: bool, interval: float) -> None:
+    """Run `render()` once, or top(1)-style on an interval until Ctrl-C.
+    The whole loop sits under the KeyboardInterrupt handler: an interrupt
+    mid-request (slow server) must exit as cleanly as one mid-sleep."""
+    try:
+        while True:
+            render()
+            if not watch:
+                return
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return
+
+
+def _clear_screen() -> None:
+    sys.stdout.write("\033[2J\033[H")
+
+
 def cmd_ps(args) -> None:
+    # -w refreshes top(1)-style until Ctrl-C (reference cli/commands/ps.py:35).
     client = _client()
-    runs = client.runs.list()
-    if not args.all:
-        runs = [r for r in runs if not r.status.is_finished()] or runs[:5]
-    rows = []
-    for r in runs:
-        conf = r.run_spec.configuration
-        resources = conf.resources.pretty() if conf.resources else ""
-        rows.append(
-            [r.run_name, conf.type, resources, r.status.value, f"${r.cost:.2f}", _age(r.submitted_at)]
-        )
-    print(_table(["NAME", "TYPE", "RESOURCES", "STATUS", "COST", "AGE"], rows))
+
+    def render() -> None:
+        runs = client.runs.list()
+        if not args.all:
+            runs = [r for r in runs if not r.status.is_finished()] or runs[:5]
+        rows = []
+        for r in runs:
+            conf = r.run_spec.configuration
+            resources = conf.resources.pretty() if conf.resources else ""
+            rows.append(
+                [r.run_name, conf.type, resources, r.status.value, f"${r.cost:.2f}", _age(r.submitted_at)]
+            )
+        if args.watch:
+            _clear_screen()
+        print(_table(["NAME", "TYPE", "RESOURCES", "STATUS", "COST", "AGE"], rows), flush=True)
+
+    _watch_loop(render, args.watch, 2.0)
 
 
 def cmd_stop(args) -> None:
@@ -352,7 +377,7 @@ def cmd_logs(args) -> None:
 
 def cmd_metrics(args) -> None:
     client = _client()
-    while True:
+    def render() -> None:
         m = client.metrics.get_job(
             args.run_name, replica_num=args.replica, job_num=args.job, limit=args.limit
         )
@@ -373,14 +398,10 @@ def cmd_metrics(args) -> None:
                 ]
             )
         if args.watch:
-            sys.stdout.write("\033[2J\033[H")  # clear + home, top(1)-style
-        print(_table(["TIME", "CPU", "MEM", "TPU DUTY", "HBM"], rows))
-        if not args.watch:
-            return
-        try:
-            time.sleep(args.interval)
-        except KeyboardInterrupt:
-            return
+            _clear_screen()
+        print(_table(["TIME", "CPU", "MEM", "TPU DUTY", "HBM"], rows), flush=True)
+
+    _watch_loop(render, args.watch, args.interval)
 
 
 def cmd_offer(args) -> None:
@@ -584,6 +605,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("ps", help="list runs")
     s.add_argument("-a", "--all", action="store_true")
+    s.add_argument("-w", "--watch", action="store_true", help="refresh continuously")
     s.set_defaults(func=cmd_ps)
 
     s = sub.add_parser("stop", help="stop runs")
