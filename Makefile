@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler release publish clean
 
 all: runner wheel
 
@@ -35,6 +35,12 @@ test-python:
 
 bench:
 	python bench.py
+
+# Control-plane throughput only (forces the CPU path even on a TPU host):
+# prints one JSON line — {"metric": "runs_scheduled_to_done_per_min", ...} —
+# so a scheduler regression is one command to check.
+bench-scheduler:
+	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_scheduler()))"
 
 release: runner wheel
 	@mkdir -p $(DIST)

@@ -24,6 +24,7 @@ Usage::
 
 from __future__ import annotations
 
+import shlex
 from typing import Dict, List, Optional
 
 from dstack_tpu.core.models.configurations import TaskConfiguration
@@ -74,10 +75,12 @@ def SFTFineTuningTask(
         raise ValueError(f"unsupported report_to: {report_to!r}")
 
     output_dir = "./sft-output"
+    # User-provided names land in a shell command line: quote them so a name
+    # with spaces/metacharacters can't break or alter the generated command.
     args: List[str] = [
-        f"--model_name_or_path {model_name}",
-        f"--dataset_name {dataset_name}",
-        f"--output_dir {output_dir}",
+        f"--model_name_or_path {shlex.quote(model_name)}",
+        f"--dataset_name {shlex.quote(dataset_name)}",
+        f"--output_dir {shlex.quote(output_dir)}",
         f"--per_device_train_batch_size {per_device_train_batch_size}",
         f"--gradient_accumulation_steps {gradient_accumulation_steps}",
         f"--learning_rate {learning_rate}",
@@ -106,7 +109,7 @@ def SFTFineTuningTask(
     if report_to:
         args.append(f"--report_to {report_to}")
     if new_model_name:
-        args += ["--push_to_hub", f"--hub_model_id {new_model_name}"]
+        args += ["--push_to_hub", f"--hub_model_id {shlex.quote(new_model_name)}"]
 
     arg_str = " ".join(args)
     commands = [

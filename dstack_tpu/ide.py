@@ -113,8 +113,26 @@ tree();
 class IdeHandler(BaseHTTPRequestHandler):
     root: str = "."
     server_version = "dstack-tpu-ide"
+    # Host-header allowlist (ADVICE r5): DNS rebinding defeats the Origin==Host
+    # CSRF check — a site rebound to 127.0.0.1:<port> sends its own domain in
+    # BOTH headers, so they match. The IDE is only ever addressed as localhost
+    # through the attach tunnel (the forwarded local port may differ from the
+    # bound port, so only the hostname is pinned); any other Host value means a
+    # browser was tricked into sending the request here. serve() extends this
+    # with a custom --host binding.
+    allowed_hosts = frozenset({"127.0.0.1", "localhost", "::1"})
 
     # -- helpers ----------------------------------------------------------
+    def _host_allowed(self) -> bool:
+        host = self.headers.get("Host")
+        if not host:
+            return False  # every real browser sends Host; refuse ambiguity
+        try:
+            hostname = urllib.parse.urlsplit(f"//{host}").hostname
+        except ValueError:
+            return False
+        return hostname in self.allowed_hosts
+
     def _send(self, code: int, body: bytes, ctype: str = "text/plain") -> None:
         self.send_response(code)
         self.send_header("Content-Type", f"{ctype}; charset=utf-8")
@@ -143,6 +161,9 @@ class IdeHandler(BaseHTTPRequestHandler):
 
     # -- routes -----------------------------------------------------------
     def do_GET(self) -> None:
+        if not self._host_allowed():
+            self._send(403, b"host not allowed")
+            return
         route = urllib.parse.urlparse(self.path).path
         if route in ("/", "/index.html"):
             self._send(200, PAGE.encode(), "text/html")
@@ -157,6 +178,9 @@ class IdeHandler(BaseHTTPRequestHandler):
             self._send(404, b"not found")
 
     def do_PUT(self) -> None:
+        if not self._host_allowed():
+            self._send(403, b"host not allowed")
+            return
         if urllib.parse.urlparse(self.path).path != "/api/file":
             self._send(404, b"not found")
             return
@@ -245,7 +269,17 @@ class IdeHandler(BaseHTTPRequestHandler):
 
 
 def serve(port: int, root: str, host: str = "127.0.0.1") -> ThreadingHTTPServer:
-    handler = type("BoundIdeHandler", (IdeHandler,), {"root": root})
+    # A non-default binding (e.g. a pod-internal IP) is reached by that name;
+    # localhost spellings stay allowed for tunnel access. A wildcard bind is
+    # reachable under any address the host owns — there the rebinding defense
+    # (a localhost-tunnel concern) cannot enumerate valid names, so the Host
+    # check is disabled rather than 403ing every legitimate remote client.
+    bound = host.strip("[]")
+    if bound in ("", "0.0.0.0", "::"):
+        overrides = {"root": root, "_host_allowed": lambda self: True}
+    else:
+        overrides = {"root": root, "allowed_hosts": IdeHandler.allowed_hosts | {bound}}
+    handler = type("BoundIdeHandler", (IdeHandler,), overrides)
     server = ThreadingHTTPServer((host, port), handler)
     return server
 

@@ -11,13 +11,23 @@ jobs are gang-placed onto whole slices (all hosts of each slice at once), never 
 independent VMs. Multislice replicas (tpu.count > 1) place one slice at a time; partial
 placements park provisioned slices in the pool as idle so the next pass completes the
 gang instead of leaking capacity.
+
+Concurrency model: each pass fans out over independent runs/gangs with a bounded
+asyncio.gather (settings.SCHEDULER_CONCURRENCY in flight); per-run keyed locks
+(services/locking) serialize same-run work, and every work item re-fetches its rows
+fresh under the lock so an overlapping pass degrades to a no-op instead of a double
+placement. Cross-run races on pool slices are settled in the DB: mark_slice_busy_tx
+claims a slice conditionally and the losing transaction rolls back (SliceBusyError).
+Hot queries are batched (grouped IN fetches / executemany) and identical offer
+queries are served from a TTL cache (services/offers).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
-from typing import Dict, List, Optional, Tuple
+from typing import Awaitable, Dict, Iterable, List, Optional, Tuple
 
 from dstack_tpu.core.errors import BackendError, NoCapacityError
 from dstack_tpu.core.models.instances import InstanceOffer, InstanceStatus
@@ -39,7 +49,7 @@ from dstack_tpu.core.models.runs import (
     RunTerminationReason,
 )
 from dstack_tpu.server import settings
-from dstack_tpu.server.db import Database, loads, new_id
+from dstack_tpu.server.db import Database, in_clause, loads, new_id
 from dstack_tpu.server.services import backends as backends_service
 from dstack_tpu.server.services import fleets as fleets_service
 from dstack_tpu.server.services import instances as instances_service
@@ -53,6 +63,7 @@ from dstack_tpu.server.services.jobs import (
     job_spec as load_job_spec,
     set_job_status,
     terminate_job,
+    touch_jobs,
 )
 from dstack_tpu.server.services.locking import get_locker
 from dstack_tpu.server.services.runner.client import get_runner_client
@@ -70,6 +81,37 @@ _REASON_TO_RETRY_EVENT = {
     JobTerminationReason.CREATING_CONTAINER_ERROR: RetryEvent.ERROR,
     JobTerminationReason.PORTS_BINDING_FAILED: RetryEvent.ERROR,
 }
+
+
+async def _fan_out(coros: Iterable[Awaitable]) -> None:
+    """Run a pass's independent work items concurrently, capped at
+    settings.SCHEDULER_CONCURRENCY in flight. Every item is awaited even when one
+    fails (no leaked tasks); the first exception re-raises after the pass drains,
+    preserving the serial loops' propagation behavior."""
+    coros = list(coros)
+    if not coros:
+        return
+    if len(coros) == 1 or settings.SCHEDULER_CONCURRENCY <= 1:
+        first: Optional[BaseException] = None
+        for c in coros:
+            try:
+                await c
+            except BaseException as e:
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first
+        return
+    sem = asyncio.Semaphore(settings.SCHEDULER_CONCURRENCY)
+
+    async def _run(coro: Awaitable):
+        async with sem:
+            return await coro
+
+    results = await asyncio.gather(*(_run(c) for c in coros), return_exceptions=True)
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
 
 
 # =====================================================================================
@@ -92,9 +134,15 @@ async def process_submitted_jobs(db: Database, batch: Optional[int] = None) -> N
     groups: Dict[Tuple[str, int, int], List] = {}
     for r in rows:
         groups.setdefault((r["run_id"], r["replica_num"], r["submission_num"]), []).append(r)
-    for (run_id, replica_num, submission_num), _ in list(groups.items())[:batch]:
+
+    async def _one(run_id: str, replica_num: int, submission_num: int) -> None:
+        # Keyed lock + fresh gang re-fetch inside _place_replica: an overlapping
+        # pass (or a sibling replica task of the same run) placing the same gang
+        # first turns this item into a no-op.
         async with get_locker().lock(f"run:{run_id}"):
             await _place_replica(db, run_id, replica_num, submission_num)
+
+    await _fan_out(_one(*key) for key in list(groups)[:batch])
 
 
 async def _place_replica(db: Database, run_id: str, replica_num: int, submission_num: int) -> None:
@@ -147,8 +195,7 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
                     )
                 return
             if vrow["status"] != "active":
-                for j in job_rows:
-                    await _touch(db, j)
+                await touch_jobs(db, job_rows)
                 return
             run_volumes.append(
                 await volumes_service.row_to_volume(db, vrow, project_row["name"])
@@ -178,12 +225,9 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
         # TPU data disks attach at slice-create time only: a volume-backed gang can
         # reuse a slice only if that slice already carries ALL its volumes.
         if run_volumes and idle_slices:
-            idle_slices = [
-                ws
-                for ws in idle_slices
-                if await _slice_has_volumes(db, ws, run_volumes)
-            ]
-        if idle_slices:
+            idle_slices = await _slices_with_volumes(db, idle_slices, run_volumes)
+        assigned = False
+        while idle_slices:
             workers = idle_slices.pop(0)
 
             def _assign_pool(conn, workers=workers, slice_jobs=slice_jobs):
@@ -191,7 +235,16 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
                 for w_row, j_row in zip(workers, slice_jobs):
                     _assign_job_tx(conn, j_row, w_row["id"], loads(w_row["job_provisioning_data"]))
 
-            await db.run(_assign_pool)
+            try:
+                await db.run(_assign_pool)
+            except instances_service.SliceBusyError:
+                # A concurrent placement (another run's task holds a different
+                # lock) won this slice; the transaction rolled back whole — try
+                # the next candidate.
+                continue
+            assigned = True
+            break
+        if assigned:
             continue
         # Phase 2: provision a new slice (reference :415 _run_job_on_new_instance).
         if profile.creation_policy == CreationPolicy.REUSE:
@@ -220,18 +273,23 @@ def _assign_job_tx(conn, job_row, instance_id: str, jpd_dict: dict) -> None:
     )
 
 
-async def _slice_has_volumes(db: Database, workers: List, volumes: List) -> bool:
-    """True when every volume is attached to every worker of the slice."""
-    ids = [w["id"] for w in workers]
-    for vol in volumes:
-        rows = await db.fetchall(
-            f"SELECT instance_id FROM volume_attachments WHERE volume_id = ?"
-            f" AND instance_id IN ({','.join('?' for _ in ids)})",
-            [str(vol.id), *ids],
-        )
-        if len(rows) < len(ids):
-            return False
-    return True
+async def _slices_with_volumes(db: Database, slices: List[List], volumes: List) -> List[List]:
+    """The subset of slices where every volume is attached to every worker —
+    one grouped attachment fetch (was: one query per slice per volume)."""
+    worker_ids = [w["id"] for workers in slices for w in workers]
+    vol_ids = [str(v.id) for v in volumes]
+    rows = await db.fetchall(
+        f"SELECT volume_id, instance_id FROM volume_attachments"
+        f" WHERE volume_id IN ({in_clause(vol_ids)})"
+        f" AND instance_id IN ({in_clause(worker_ids)})",
+        [*vol_ids, *worker_ids],
+    )
+    attached = {(r["volume_id"], r["instance_id"]) for r in rows}
+    return [
+        workers
+        for workers in slices
+        if all((v, w["id"]) in attached for v in vol_ids for w in workers)
+    ]
 
 
 def _volume_attachment_data(volume, index: int = 0) -> dict:
@@ -381,27 +439,49 @@ async def process_running_jobs(db: Database, batch: Optional[int] = None) -> Non
         " ORDER BY last_processed_at LIMIT ?",
         (batch,),
     )
+    by_run: Dict[str, List] = {}
     for row in rows:
-        async with get_locker().lock(f"run:{row['run_id']}"):
-            fresh = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (row["id"],))
-            if fresh is None or fresh["status"] not in ("provisioning", "pulling", "running"):
-                continue
-            try:
-                await _process_active_job(db, fresh)
-            except Exception:
-                logger.exception("job %s processing failed", row["id"])
-                await db.execute(
-                    "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
-                    (to_iso(now_utc()), row["id"]),
-                )
+        by_run.setdefault(row["run_id"], []).append(row)
+
+    async def _one_run(run_id: str, run_rows: List) -> None:
+        async with get_locker().lock(f"run:{run_id}"):
+            # One grouped re-fetch under the lock replaces the per-job SELECT;
+            # the run row (immutable run_spec) is shared by the whole gang.
+            fresh_rows = await db.fetch_in(
+                "SELECT * FROM jobs WHERE id IN ({in})", [r["id"] for r in run_rows]
+            )
+            fresh_by_id = {r["id"]: r for r in fresh_rows}
+            run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+            processed = False
+            for row in run_rows:
+                fresh = fresh_by_id.get(row["id"])
+                if processed and fresh is not None:
+                    # Processing a gang member can terminate its siblings
+                    # (backend provisioning failure): later members of the same
+                    # group re-check singly against the live row.
+                    fresh = await db.fetchone(
+                        "SELECT * FROM jobs WHERE id = ?", (row["id"],)
+                    )
+                if fresh is None or fresh["status"] not in (
+                    "provisioning", "pulling", "running",
+                ):
+                    continue
+                try:
+                    await _process_active_job(db, fresh, run_row)
+                except Exception:
+                    logger.exception("job %s processing failed", row["id"])
+                    await touch_jobs(db, [row])
+                processed = True
+
+    await _fan_out(_one_run(rid, rr) for rid, rr in by_run.items())
 
 
-async def _process_active_job(db: Database, job_row) -> None:
+async def _process_active_job(db: Database, job_row, run_row=None) -> None:
     status = JobStatus(job_row["status"])
     if status == JobStatus.PROVISIONING:
-        await _process_provisioning(db, job_row)
+        await _process_provisioning(db, job_row, run_row)
     else:
-        await _process_pulling_or_running(db, job_row)
+        await _process_pulling_or_running(db, job_row, run_row)
 
 
 async def _replica_rows(db: Database, job_row) -> List:
@@ -412,7 +492,7 @@ async def _replica_rows(db: Database, job_row) -> List:
     )
 
 
-async def _process_provisioning(db: Database, job_row) -> None:
+async def _process_provisioning(db: Database, job_row, run_row=None) -> None:
     """Wait for the whole gang to be placed and the runner to come up, then submit the
     job spec + TPU cluster contract (reference _submit_job_to_runner :855)."""
     replica = await _replica_rows(db, job_row)
@@ -423,7 +503,8 @@ async def _process_provisioning(db: Database, job_row) -> None:
         await _check_provisioning_deadline(db, job_row)
         return
 
-    run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (job_row["run_id"],))
+    if run_row is None:
+        run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (job_row["run_id"],))
     run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
     conf = run_spec.configuration
 
@@ -432,11 +513,11 @@ async def _process_provisioning(db: Database, job_row) -> None:
     if order == StartupOrder.MASTER_FIRST and spec.job_num != 0:
         master = replica[0]
         if master["status"] not in ("running",):
-            await _touch(db, job_row)
+            await touch_jobs(db, [job_row])
             return
     if order == StartupOrder.WORKERS_FIRST and spec.job_num == 0:
         if any(r["status"] not in ("running",) for r in replica[1:]):
-            await _touch(db, job_row)
+            await touch_jobs(db, [job_row])
             return
 
     jpd = job_jpd(job_row)
@@ -457,7 +538,7 @@ async def _process_provisioning(db: Database, job_row) -> None:
     # (each peer resolves its own endpoint on its own pass).
     replica = await _replica_rows(db, job_row)
     if any((p := job_jpd(r)) is None or p.hostname is None for r in replica):
-        await _touch(db, job_row)
+        await touch_jobs(db, [job_row])
         return
 
     client = get_runner_client(jpd, jrd)
@@ -521,7 +602,7 @@ async def _process_provisioning(db: Database, job_row) -> None:
     )
 
 
-async def _process_pulling_or_running(db: Database, job_row) -> None:
+async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> None:
     jpd = job_jpd(job_row)
     jrd = job_jrd(job_row) or JobRuntimeData()
     spec = load_job_spec(job_row)
@@ -537,7 +618,10 @@ async def _process_pulling_or_running(db: Database, job_row) -> None:
     await db.execute(
         "UPDATE jobs SET disconnected_at = NULL WHERE id = ?", (job_row["id"],)
     )
-    run_row = await db.fetchone("SELECT run_name, project_id FROM runs WHERE id = ?", (job_row["run_id"],))
+    if run_row is None:
+        run_row = await db.fetchone(
+            "SELECT run_name, project_id FROM runs WHERE id = ?", (job_row["run_id"],)
+        )
 
     # Drain the paginated backlog, persisting each page's logs + offset as it lands so
     # a mid-drain failure never discards progress (the next tick resumes where this
@@ -639,7 +723,7 @@ async def _handle_runner_disconnect(db: Database, job_row) -> None:
             f"runner unreachable for {settings.RUNNER_DISCONNECT_TIMEOUT}s",
         )
     else:
-        await _touch(db, job_row)
+        await touch_jobs(db, [job_row])
 
 
 async def _check_provisioning_deadline(db: Database, job_row) -> None:
@@ -650,7 +734,7 @@ async def _check_provisioning_deadline(db: Database, job_row) -> None:
             f"instance did not become reachable within {settings.PROVISIONING_TIMEOUT}s",
         )
     else:
-        await _touch(db, job_row)
+        await touch_jobs(db, [job_row])
 
 
 async def _update_jpd_from_backend(db: Database, job_row, jpd) -> Optional[JobProvisioningData]:
@@ -666,7 +750,7 @@ async def _update_jpd_from_backend(db: Database, job_row, jpd) -> Optional[JobPr
     try:
         compute = await backends_service.get_compute(db, project_row, jpd.backend)
     except Exception:
-        await _touch(db, job_row)
+        await touch_jobs(db, [job_row])
         return jpd
     try:
         updated = await compute.update_provisioning_data(jpd)
@@ -689,15 +773,8 @@ async def _update_jpd_from_backend(db: Database, job_row, jpd) -> Optional[JobPr
                 (jpd_json, job_row["instance_id"]),
             )
         return updated
-    await _touch(db, job_row)
+    await touch_jobs(db, [job_row])
     return updated
-
-
-async def _touch(db: Database, job_row) -> None:
-    await db.execute(
-        "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
-        (to_iso(now_utc()), job_row["id"]),
-    )
 
 
 async def _resolve_job_secrets(db: Database, project_id: str, spec: JobSpec):
@@ -773,15 +850,26 @@ async def process_terminating_jobs(db: Database, batch: Optional[int] = None) ->
         "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at LIMIT ?",
         (batch,),
     )
+    by_run: Dict[str, List] = {}
     for row in rows:
-        async with get_locker().lock(f"run:{row['run_id']}"):
-            fresh = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (row["id"],))
-            if fresh is None or fresh["status"] != "terminating":
-                continue
-            try:
-                await _process_terminating_job(db, fresh)
-            except Exception:
-                logger.exception("terminating job %s failed", row["id"])
+        by_run.setdefault(row["run_id"], []).append(row)
+
+    async def _one_run(run_id: str, run_rows: List) -> None:
+        async with get_locker().lock(f"run:{run_id}"):
+            # Grouped re-fetch is safe for the whole gang here: terminating one
+            # job never rewrites its siblings' rows.
+            fresh_rows = await db.fetch_in(
+                "SELECT * FROM jobs WHERE id IN ({in})", [r["id"] for r in run_rows]
+            )
+            for fresh in fresh_rows:
+                if fresh["status"] != "terminating":
+                    continue
+                try:
+                    await _process_terminating_job(db, fresh)
+                except Exception:
+                    logger.exception("terminating job %s failed", fresh["id"])
+
+    await _fan_out(_one_run(rid, rr) for rid, rr in by_run.items())
 
 
 async def _process_terminating_job(db: Database, job_row) -> None:
@@ -820,11 +908,12 @@ async def process_runs(db: Database, batch: Optional[int] = None) -> None:
         " ORDER BY last_processed_at IS NOT NULL, last_processed_at LIMIT ?",
         (batch,),
     )
-    for row in rows:
+
+    async def _one(row) -> None:
         async with get_locker().lock(f"run:{row['id']}"):
             fresh = await db.fetchone("SELECT * FROM runs WHERE id = ?", (row["id"],))
             if fresh is None or RunStatus(fresh["status"]).is_finished():
-                continue
+                return
             try:
                 if fresh["status"] == "terminating":
                     await _process_terminating_run(db, fresh)
@@ -836,6 +925,8 @@ async def process_runs(db: Database, batch: Optional[int] = None) -> None:
                 "UPDATE runs SET last_processed_at = ? WHERE id = ?",
                 (to_iso(now_utc()), row["id"]),
             )
+
+    await _fan_out(_one(row) for row in rows)
 
 
 def _latest_submissions(job_rows: List) -> Dict[Tuple[int, int], object]:

@@ -366,6 +366,18 @@ class Database:
 
         return await self.run(_do)
 
+    async def fetch_in(
+        self, sql_template: str, values: Sequence, params: Iterable = ()
+    ) -> List[Any]:
+        """Grouped ``IN (...)`` fetch — the scheduler's N+1 killer. `sql_template`
+        holds one ``{in}`` slot that expands to placeholders for `values`
+        (bound after `params`); empty `values` returns [] without touching the DB."""
+        values = list(values)
+        if not values:
+            return []
+        sql = sql_template.format(**{"in": in_clause(values)})
+        return await self.fetchall(sql, [*params, *values])
+
     def tx_advisory_lock(self, conn, name: str) -> None:
         """Inside a db.run() closure: serialize a critical section across
         server replicas (transaction-scoped; released at commit/rollback)."""
@@ -398,6 +410,11 @@ def _set_result(fut: "asyncio.Future", result: Any) -> None:
 def _set_exc(fut: "asyncio.Future", e: Exception) -> None:
     if not fut.cancelled():
         fut.set_exception(e)
+
+
+def in_clause(values: Sequence) -> str:
+    """Placeholders for an ``IN (...)`` clause: ``in_clause([a, b, c])`` -> ``"?,?,?"``."""
+    return ",".join("?" for _ in values)
 
 
 def new_id() -> str:

@@ -43,6 +43,7 @@ async def create_backend(db: Database, project_row, config: BackendConfig) -> No
         ),
     )
     _compute_cache.pop((project_row["id"], config.type.value), None)
+    _invalidate_offers(project_row["id"])
 
 
 async def delete_backends(db: Database, project_row, types: List[str]) -> None:
@@ -51,6 +52,7 @@ async def delete_backends(db: Database, project_row, types: List[str]) -> None:
             "DELETE FROM backends WHERE project_id = ? AND type = ?", (project_row["id"], t)
         )
         _compute_cache.pop((project_row["id"], t), None)
+    _invalidate_offers(project_row["id"])
 
 
 async def list_backends(db: Database, project_row) -> List[BackendConfig]:
@@ -81,5 +83,13 @@ async def get_compute(db: Database, project_row, backend_type: str) -> Compute:
     raise ResourceNotExistsError(f"backend {backend_type} not configured")
 
 
+def _invalidate_offers(project_id: Optional[str] = None) -> None:
+    # Late import: offers imports this module at the top level.
+    from dstack_tpu.server.services import offers as offers_service
+
+    offers_service.invalidate_offer_cache(project_id)
+
+
 def reset_compute_cache() -> None:
     _compute_cache.clear()
+    _invalidate_offers()

@@ -200,13 +200,28 @@ def _slice_matches(worker_row, requirements: Requirements, profile) -> bool:
     return True
 
 
+class SliceBusyError(Exception):
+    """A slice believed idle was claimed by a concurrent placement; the enclosing
+    transaction must roll back and the caller should try another slice."""
+
+
 def mark_slice_busy_tx(conn, instance_ids: List[str]) -> None:
+    """Claim a whole idle slice inside a placement transaction.
+
+    Conditional on every worker still being idle: with concurrent scheduler
+    passes (background/tasks fan-out), two placements can race for the same
+    pool slice — the UPDATE's idle guard makes exactly one win, and the loser's
+    transaction rolls back via SliceBusyError instead of double-assigning."""
     q = ",".join("?" for _ in instance_ids)
-    conn.execute(
+    cur = conn.execute(
         f"UPDATE instances SET status = 'busy', busy_blocks = 1, idle_since = NULL"
-        f" WHERE id IN ({q})",
+        f" WHERE id IN ({q}) AND status = 'idle' AND busy_blocks = 0",
         instance_ids,
     )
+    if cur.rowcount != len(instance_ids):
+        raise SliceBusyError(
+            f"slice workers concurrently claimed ({cur.rowcount}/{len(instance_ids)} still idle)"
+        )
 
 
 async def release_instance(db: Database, instance_id: str) -> None:

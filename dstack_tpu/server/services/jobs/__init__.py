@@ -68,6 +68,18 @@ async def set_job_status(
     )
 
 
+async def touch_jobs(db: Database, job_rows: List) -> None:
+    """Bump last_processed_at for a set of jobs in one executemany round trip
+    (was: one UPDATE per job from the scheduler's park-for-next-pass paths)."""
+    if not job_rows:
+        return
+    now = to_iso(now_utc())
+    await db.executemany(
+        "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
+        [(now, r["id"]) for r in job_rows],
+    )
+
+
 async def terminate_job(
     db: Database,
     job_row,

@@ -3,7 +3,12 @@
 Parity: reference server/services/locking.py (sqlite lockset / postgres advisory locks).
 This server is single-process (sqlite single-writer model), so named asyncio locks are
 sufficient and cheaper: they serialize FSM transitions on one resource (a run, an
-instance slice) across concurrently-running background loops without DB round-trips.
+instance slice) without DB round-trips — both across concurrently-running background
+loops AND across the concurrent work items each loop now fans out (background/tasks
+bounded-gather passes). The contract for every scheduler work item is
+lock(f"run:{run_id}") -> re-fetch fresh rows -> act: the keyed lock serializes
+same-resource passes, and the fresh re-read under the lock is what makes an
+overlapping pass a no-op instead of a double placement.
 """
 
 from __future__ import annotations
@@ -19,6 +24,12 @@ class Locker:
 
     def lock(self, name: str) -> "_LockCtx":
         return _LockCtx(self, name)
+
+    def locked(self, name: str) -> bool:
+        """True while any task holds the named lock (tests/diagnostics only —
+        by the time a caller branches on it, the answer may be stale)."""
+        lock = self._locks.get(name)
+        return lock is not None and lock.locked()
 
     def _acquire_obj(self, name: str) -> asyncio.Lock:
         lock = self._locks.get(name)

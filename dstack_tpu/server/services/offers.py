@@ -1,19 +1,74 @@
 """Offer fan-in across project backends (parity: reference server/services/offers.py:
-get_offers_by_requirements:26-154)."""
+get_offers_by_requirements:26-154), fronted by a small TTL cache.
+
+The scheduler's placement loop re-queries offers once per gang; under load most of
+those queries are identical (N submissions of the same instance shape in one project),
+so the fan-in to every backend is memoized for OFFER_CACHE_TTL seconds keyed on
+(project, requirements, profile fingerprint). A backend config change invalidates the
+project's entries immediately via the reset_compute_cache path in services/backends."""
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 from dstack_tpu.core.models.instances import InstanceOffer
 from dstack_tpu.core.models.profiles import Profile, SpotPolicy
 from dstack_tpu.core.models.runs import Requirements
+from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database
 from dstack_tpu.server.services import backends as backends_service
 
 logger = logging.getLogger(__name__)
+
+# (project_id, requirements fingerprint, profile fingerprint) -> (monotonic ts, offers)
+_offer_cache: Dict[Tuple[str, str, str], Tuple[float, List[InstanceOffer]]] = {}
+# Same key -> the fan-in currently resolving it: concurrent cold-cache misses
+# (the scheduler fans out up to SCHEDULER_CONCURRENCY placements at once) await
+# one backend query instead of issuing N identical ones.
+_inflight: Dict[Tuple[str, str, str], "asyncio.Task"] = {}
+_OFFER_CACHE_MAX_ENTRIES = 512
+
+
+def invalidate_offer_cache(project_id: Optional[str] = None) -> None:
+    """Drop cached offers — for one project (its backend config changed) or all.
+    In-flight queries are detached (not cancelled): current awaiters get their
+    result, but it is no longer cached, so the next caller re-queries."""
+    keys = [
+        k
+        for k in set(_offer_cache) | set(_inflight)
+        if project_id is None or k[0] == project_id
+    ]
+    for key in keys:
+        _offer_cache.pop(key, None)
+        _inflight.pop(key, None)
+
+
+def _cache_get(key) -> Optional[List[InstanceOffer]]:
+    hit = _offer_cache.get(key)
+    if hit is None:
+        return None
+    ts, offers = hit
+    if time.monotonic() - ts > settings.OFFER_CACHE_TTL:
+        _offer_cache.pop(key, None)
+        return None
+    return offers
+
+
+def _cache_put(key, offers: List[InstanceOffer]) -> None:
+    if len(_offer_cache) >= _OFFER_CACHE_MAX_ENTRIES:
+        # Unbounded distinct shapes would leak; drop expired first, then oldest.
+        now = time.monotonic()
+        for k in [
+            k for k, (ts, _) in _offer_cache.items()
+            if now - ts > settings.OFFER_CACHE_TTL
+        ]:
+            _offer_cache.pop(k, None)
+        while len(_offer_cache) >= _OFFER_CACHE_MAX_ENTRIES:
+            _offer_cache.pop(next(iter(_offer_cache)), None)
+    _offer_cache[key] = (time.monotonic(), offers)
 
 
 async def get_offers_by_requirements(
@@ -23,6 +78,39 @@ async def get_offers_by_requirements(
     profile: Optional[Profile] = None,
 ) -> List[InstanceOffer]:
     profile = profile or Profile()
+    if settings.OFFER_CACHE_TTL <= 0:
+        return await _query_offers(db, project_row, requirements, profile)
+    key = (
+        project_row["id"],
+        requirements.model_dump_json(),
+        profile.model_dump_json(),
+    )
+    cached = _cache_get(key)
+    if cached is not None:
+        # Shallow copy: callers filter/slice their view without corrupting
+        # the cached list (InstanceOffer objects themselves are not mutated).
+        return list(cached)
+    fut = _inflight.get(key)
+    if fut is not None:
+        return list(await asyncio.shield(fut))
+    fut = asyncio.ensure_future(_query_offers(db, project_row, requirements, profile))
+    _inflight[key] = fut
+    try:
+        offers = await asyncio.shield(fut)
+        if _inflight.get(key) is fut:  # not invalidated while querying
+            _cache_put(key, offers)
+    finally:
+        if _inflight.get(key) is fut:
+            _inflight.pop(key, None)
+    return list(offers)
+
+
+async def _query_offers(
+    db: Database,
+    project_row,
+    requirements: Requirements,
+    profile: Profile,
+) -> List[InstanceOffer]:
     computes = await backends_service.get_project_computes(db, project_row)
     if profile.backends:
         computes = [(t, c) for t, c in computes if t in profile.backends]

@@ -49,6 +49,18 @@ PROCESS_SERVICES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_SERVICES_INTERVA
 PROCESS_BATCH_SIZE = int(os.getenv("DSTACK_TPU_PROCESS_BATCH_SIZE", "10"))
 METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL", "3600"))
 
+# Concurrent scheduler fan-out: each background pass processes up to this many
+# independent runs/gangs at once (bounded asyncio.gather); per-run keyed locks
+# (services/locking.py) keep same-run work serialized. 1 restores the old
+# strictly-serial passes.
+SCHEDULER_CONCURRENCY = int(os.getenv("DSTACK_TPU_SCHEDULER_CONCURRENCY", "16"))
+
+# Offer cache TTL (seconds): identical (project, requirements, profile) offer
+# queries within the window reuse the last catalog fan-in instead of re-querying
+# every backend (150 identical v5e-8 submissions hit the catalog once). 0
+# disables. Invalidated early when a project's backend config changes.
+OFFER_CACHE_TTL = float(os.getenv("DSTACK_TPU_OFFER_CACHE_TTL", "30"))
+
 # Scheduler FSM knobs.
 MAX_OFFERS_TRIED = int(os.getenv("DSTACK_TPU_MAX_OFFERS_TRIED", "5"))
 PROVISIONING_TIMEOUT = float(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))

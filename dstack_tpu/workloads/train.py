@@ -189,7 +189,8 @@ def main() -> None:
                         help="leading dp axis over the MEGASCALE slice count")
     parser.add_argument("--ep", type=int, default=0,
                         help="expert-parallel axis size for MoE configs"
-                             " (0 = all devices on ep)")
+                             " (0 = largest ep dividing both the device count"
+                             " and n_experts, i.e. their gcd)")
     args = parser.parse_args()
 
     if args.config in moe_lib.MOE_PRESETS:

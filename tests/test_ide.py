@@ -119,6 +119,41 @@ class TestIde:
         assert status == 501  # no POST handler at all
         assert not (tmp_path / "evil.py").exists()
 
+    def test_dns_rebinding_host_rejected(self, ide_server):
+        """DNS rebinding sends Origin == Host == attacker.example to 127.0.0.1:
+        the Host allowlist must refuse it on every route, reads included."""
+        import http.client
+
+        base, tmp_path = ide_server
+        addr = base[len("http://"):]
+
+        for method, path, body in (
+            ("GET", "/api/tree", None),
+            ("GET", "/api/file?path=README.md", None),
+            ("PUT", "/api/file?path=evil.py", b"pwned"),
+        ):
+            conn = http.client.HTTPConnection(addr, timeout=5)
+            conn.putrequest(method, path, skip_host=True, skip_accept_encoding=True)
+            conn.putheader("Host", "attacker.example")
+            conn.putheader("Origin", "http://attacker.example")
+            if body is not None:
+                conn.putheader("Content-Length", str(len(body)))
+            conn.endheaders()
+            if body is not None:
+                conn.send(body)
+            assert conn.getresponse().status == 403, f"{method} {path} not rejected"
+            conn.close()
+        assert not (tmp_path / "evil.py").exists()
+
+        # localhost spellings (any port — the attach tunnel's local forward port
+        # differs from the bound port) keep working.
+        conn = http.client.HTTPConnection(addr, timeout=5)
+        conn.putrequest("GET", "/healthcheck", skip_host=True, skip_accept_encoding=True)
+        conn.putheader("Host", "localhost:54321")
+        conn.endheaders()
+        assert conn.getresponse().status == 200
+        conn.close()
+
     def test_same_origin_write_allowed(self, ide_server):
         base, tmp_path = ide_server
         host = base[len("http://"):]
