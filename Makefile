@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy release publish clean
 
 all: runner wheel
 
@@ -41,6 +41,12 @@ bench:
 # so a scheduler regression is one command to check.
 bench-scheduler:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_scheduler()))"
+
+# Service-proxy data-plane throughput: one JSON line —
+# {"metric": "proxy_requests_per_sec", ...} — vs_baseline is the speedup over
+# the legacy per-request-session/per-request-DB path.
+bench-proxy:
+	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_proxy()))"
 
 release: runner wheel
 	@mkdir -p $(DIST)

@@ -128,6 +128,173 @@ def bench_scheduler() -> dict:
     }
 
 
+async def _seed_bench_service(db, run_name: str, replica_port: int) -> None:
+    """Insert a ready service run + running replica pointing at a local stub
+    (no cloud, no runner): the proxy's own overhead is what's measured."""
+    import json
+
+    proj = await db.fetchone("SELECT * FROM projects LIMIT 1")
+    run_spec = {
+        "run_name": run_name,
+        "configuration": {
+            "type": "service",
+            "commands": ["serve"],
+            "port": 8000,
+            "auth": False,
+        },
+    }
+    await db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
+        " run_spec) VALUES (?, ?, ?, ?, '2026-01-01', 'running', ?)",
+        (f"run-{run_name}", proj["id"], proj["owner_id"], run_name, json.dumps(run_spec)),
+    )
+    job_spec = {
+        "job_name": f"{run_name}-0-0",
+        "image_name": "stub",
+        "requirements": {"resources": {}},
+        "service_port": 8000,
+    }
+    jpd = {
+        "backend": "local",  # direct endpoint: no SSH tunnel in the loop
+        "instance_type": {"name": "local", "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1}},
+        "instance_id": f"i-{run_name}",
+        "hostname": "127.0.0.1",
+        "region": "local",
+    }
+    jrd = {"ports_mapping": {"8000": replica_port}, "probe_ready": True}
+    await db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, job_spec, status,"
+        " submitted_at, job_provisioning_data, job_runtime_data)"
+        " VALUES (?, ?, ?, ?, 0, ?, 'running', '2026-01-01', ?, ?)",
+        (f"job-{run_name}", proj["id"], f"run-{run_name}", run_name,
+         json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
+    )
+
+
+def bench_proxy() -> dict:
+    """Requests/sec through the in-server service proxy against a local stub
+    replica: the fast path (route-table cache + pooled keep-alive upstream
+    session) vs the legacy per-request-DB/per-request-session path."""
+    import asyncio
+
+    from aiohttp import web as aioweb
+
+    from dstack_tpu.core.services import http_forward
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.services import proxy as proxy_service
+    from tests.common import api_server
+
+    N = 250
+    CONCURRENCY = 16
+    # Paired rounds with the mode order flipped each time: medians cancel
+    # host-load drift in either direction (shared CI hosts throttle).
+    ROUNDS = 6
+
+    async def run() -> dict:
+        async def pong(request):
+            return aioweb.Response(text="pong")
+
+        stub = aioweb.Application()
+        stub.router.add_route("*", "/{tail:.*}", pong)
+        stub_runner = aioweb.AppRunner(stub)
+        await stub_runner.setup()
+        site = aioweb.TCPSite(stub_runner, "127.0.0.1", 0)
+        await site.start()
+        stub_port = site._server.sockets[0].getsockname()[1]
+
+        saved_ttl = settings.PROXY_ROUTE_CACHE_TTL
+        try:
+            async with api_server() as api:
+                await _seed_bench_service(api.db, "bench-svc", stub_port)
+                proxy_port = api.client.server.port
+                request_bytes = (
+                    b"GET /proxy/services/main/bench-svc/ping HTTP/1.1\r\n"
+                    b"Host: 127.0.0.1\r\nConnection: keep-alive\r\n\r\n"
+                )
+
+                async def hammer(n: int) -> float:
+                    # Raw-socket keep-alive clients: the measurement is the
+                    # proxy's cost, not an HTTP client library's.
+                    per_worker = n // CONCURRENCY
+
+                    async def worker() -> None:
+                        reader, writer = await asyncio.open_connection(
+                            "127.0.0.1", proxy_port
+                        )
+                        try:
+                            for _ in range(per_worker):
+                                writer.write(request_bytes)
+                                await writer.drain()
+                                header = await reader.readuntil(b"\r\n\r\n")
+                                status = header.split(b" ", 2)[1]
+                                assert status == b"200", header[:200]
+                                length = 0
+                                for line in header.split(b"\r\n"):
+                                    if line.lower().startswith(b"content-length:"):
+                                        length = int(line.split(b":")[1])
+                                await reader.readexactly(length)
+                        finally:
+                            writer.close()
+
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*(worker() for _ in range(CONCURRENCY)))
+                    return per_worker * CONCURRENCY / (time.perf_counter() - t0)
+
+                import statistics
+
+                def set_mode(fast: bool) -> None:
+                    settings.PROXY_ROUTE_CACHE_TTL = 3600 if fast else 0
+                    http_forward.set_pooling(fast)
+                    proxy_service.route_table.clear()
+
+                async def measure(fast: bool) -> float:
+                    # fast: cached routes + pooled keep-alive connections;
+                    # legacy: per-request DB resolution + fresh session.
+                    set_mode(fast)
+                    await hammer(16)  # warmup (fast: builds route entry + pool)
+                    return await hammer(N)
+
+                # Paired design: each round measures both modes back to back
+                # (order flipped), and the speedup is the median of PER-ROUND
+                # ratios — correlated host-load drift hits both measurements
+                # of a pair and cancels out of the ratio.
+                legacy_rates, fast_rates, ratios = [], [], []
+                for i in range(ROUNDS):
+                    pair = {}
+                    for fast in ((False, True) if i % 2 == 0 else (True, False)):
+                        pair[fast] = await measure(fast)
+                    legacy_rates.append(pair[False])
+                    fast_rates.append(pair[True])
+                    ratios.append(pair[True] / pair[False])
+                return {
+                    "before": statistics.median(legacy_rates),
+                    "after": statistics.median(fast_rates),
+                    "speedup": statistics.median(ratios),
+                }
+        finally:
+            settings.PROXY_ROUTE_CACHE_TTL = saved_ttl
+            http_forward.set_pooling(True)
+            proxy_service.route_table.clear()
+            proxy_service.stats.reset()
+            await http_forward.close_session()
+            await stub_runner.cleanup()
+
+    r = asyncio.run(run())
+    return {
+        "metric": "proxy_requests_per_sec",
+        "value": round(r["after"], 1),
+        "unit": "req/s",
+        # Baseline = the legacy per-request-session/per-request-DB path;
+        # median of per-round paired ratios (host drift cancels per pair).
+        "vs_baseline": round(r["speedup"], 2),
+        "extra": {
+            "legacy_req_per_sec": round(r["before"], 1),
+            "requests": N,
+            "concurrency": CONCURRENCY,
+        },
+    }
+
+
 def main() -> None:
     try:
         import jax

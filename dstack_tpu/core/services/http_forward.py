@@ -2,11 +2,21 @@
 proxy (server/services/proxy.py) and the gateway appliance (gateway/app.py).
 
 Streams the upstream response chunk-by-chunk, so SSE/chunked inference output
-(the OpenAI-compatible streaming path) flows through unbuffered."""
+(the OpenAI-compatible streaming path) flows through unbuffered.
+
+Upstream connections come from one shared keep-alive ClientSession (lazily
+created per event loop): replicas see a warm connection pool instead of a
+fresh TCP handshake per request. DSTACK_TPU_PROXY_POOL_SIZE caps concurrent
+connections per replica host; the session must be closed on shutdown via
+``close_session()`` (the server's cleanup hook and the gateway's serve loop
+both do)."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
+import os
+from typing import Optional
 
 import aiohttp
 from aiohttp import web
@@ -27,13 +37,77 @@ HOP_HEADERS = {
     "content-length",
 }
 
+# How long an idle keep-alive connection stays pooled before the connector
+# drops it. Short enough that replica churn doesn't accumulate dead sockets.
+KEEPALIVE_TIMEOUT = 30.0
+
+DEFAULT_TIMEOUT_TOTAL = 300.0
+_DEFAULT_TIMEOUT = aiohttp.ClientTimeout(total=DEFAULT_TIMEOUT_TOTAL)
+
+# Responses with a known Content-Length at or below this are relayed as one
+# buffered write instead of the chunk-streaming path — typical JSON inference
+# responses skip StreamResponse.prepare + per-chunk writes. SSE/chunked
+# output has no Content-Length and always streams, unbuffered.
+SMALL_BODY_MAX = 64 * 1024
+
+_session: Optional[aiohttp.ClientSession] = None
+_session_loop: Optional[asyncio.AbstractEventLoop] = None
+_pooling = True
+
+
+def pool_size() -> int:
+    """Per-replica-host connection cap for the shared session."""
+    return int(os.getenv("DSTACK_TPU_PROXY_POOL_SIZE", "100"))
+
+
+def set_pooling(enabled: bool) -> None:
+    """Disable to restore the legacy one-session-per-request path (bench/tests
+    measure the pooled path against exactly this)."""
+    global _pooling
+    _pooling = enabled
+
+
+def pooling_enabled() -> bool:
+    return _pooling
+
+
+def get_session() -> aiohttp.ClientSession:
+    """The shared keep-alive session for the current event loop, created on
+    first use. A session left over from a different (test) loop is abandoned —
+    its sockets died with that loop — and replaced."""
+    global _session, _session_loop
+    loop = asyncio.get_running_loop()
+    if _session is None or _session.closed or _session_loop is not loop:
+        connector = aiohttp.TCPConnector(
+            limit=0,  # total is unbounded; per-host is the real knob
+            limit_per_host=pool_size(),
+            keepalive_timeout=KEEPALIVE_TIMEOUT,
+        )
+        _session = aiohttp.ClientSession(connector=connector)
+        _session_loop = loop
+    return _session
+
+
+async def close_session() -> None:
+    """Close the shared session (server shutdown / test teardown). Safe to call
+    with no session, and from a different loop than the one that created it
+    (the stale session is dropped without touching the dead loop)."""
+    global _session, _session_loop
+    session, loop = _session, _session_loop
+    _session = None
+    _session_loop = None
+    if session is None or session.closed:
+        return
+    if loop is asyncio.get_running_loop():
+        await session.close()
+
 
 async def forward(
     request: web.Request,
     host: str,
     port: int,
     tail: str,
-    timeout_total: float = 300.0,
+    timeout_total: float = DEFAULT_TIMEOUT_TOTAL,
     body: bytes = None,
 ) -> web.StreamResponse:
     """Forward `request` to http://host:port/<tail> (+query), streaming back."""
@@ -43,21 +117,51 @@ async def forward(
     headers = {k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS}
     if body is None:
         body = await request.read()
+    timeout = (
+        _DEFAULT_TIMEOUT
+        if timeout_total == DEFAULT_TIMEOUT_TOTAL
+        else aiohttp.ClientTimeout(total=timeout_total)
+    )
+
+    async def _stream(upstream: aiohttp.ClientResponse) -> web.StreamResponse:
+        length = upstream.headers.get("Content-Length")
+        if length is not None and int(length) <= SMALL_BODY_MAX:
+            payload = await upstream.read()
+            return web.Response(
+                status=upstream.status,
+                body=payload,
+                headers={
+                    k: v
+                    for k, v in upstream.headers.items()
+                    if k.lower() not in HOP_HEADERS
+                },
+            )
+        resp = web.StreamResponse(status=upstream.status)
+        for k, v in upstream.headers.items():
+            if k.lower() not in HOP_HEADERS:
+                resp.headers[k] = v
+        await resp.prepare(request)
+        async for chunk in upstream.content.iter_chunked(64 * 1024):
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
     try:
-        timeout = aiohttp.ClientTimeout(total=timeout_total)
-        async with aiohttp.ClientSession(timeout=timeout) as session:
-            async with session.request(
-                request.method, url, headers=headers, data=body, allow_redirects=False
+        if _pooling:
+            # Timeout rides on the request, not the shared session: each
+            # forwarded request keeps its own budget.
+            async with get_session().request(
+                request.method, url, headers=headers, data=body,
+                allow_redirects=False, timeout=timeout,
             ) as upstream:
-                resp = web.StreamResponse(status=upstream.status)
-                for k, v in upstream.headers.items():
-                    if k.lower() not in HOP_HEADERS:
-                        resp.headers[k] = v
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_chunked(64 * 1024):
-                    await resp.write(chunk)
-                await resp.write_eof()
-                return resp
-    except (aiohttp.ClientError, OSError) as e:
+                return await _stream(upstream)
+        else:
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                async with session.request(
+                    request.method, url, headers=headers, data=body,
+                    allow_redirects=False,
+                ) as upstream:
+                    return await _stream(upstream)
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as e:
         logger.warning("forward to %s:%s failed: %s", host, port, e)
         raise web.HTTPBadGateway(text="upstream request failed")

@@ -50,5 +50,10 @@ class RateLimiter:
             bucket = self._buckets[key] = TokenBucket(float(matched["rps"]), burst)
         return bucket.allow()
 
+    def drop_scope(self, scope: str) -> None:
+        """Forget every bucket for one service (its run was deleted)."""
+        for key in [k for k in self._buckets if k[0] == scope]:
+            del self._buckets[key]
+
     def reset(self) -> None:
         self._buckets.clear()

@@ -314,8 +314,14 @@ async def serve(
         # Renewal runs even without a TLS listener: issued certs may be
         # consumed from --certs-dir by an external terminator.
         tls_manager.start_renewal()
-    while True:
-        await asyncio.sleep(3600)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        # Drain the shared upstream keep-alive pool on shutdown/cancellation.
+        from dstack_tpu.core.services.http_forward import close_session
+
+        await close_session()
 
 
 def main() -> None:

@@ -116,6 +116,14 @@ async def _on_cleanup(app: web.Application) -> None:
         await runner_ssh.close_all_tunnels()
     except Exception:
         logger.exception("closing tunnels during shutdown failed")
+    # Drain the proxy's pooled upstream connections (keep-alive sockets would
+    # otherwise linger until GC).
+    try:
+        from dstack_tpu.core.services import http_forward
+
+        await http_forward.close_session()
+    except Exception:
+        logger.exception("closing the proxy connection pool failed")
     await app["db"].close()
 
 

@@ -676,6 +676,8 @@ async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> No
                 reason = JobTerminationReason.TERMINATED_BY_SERVER
                 reason_msg = ev.get("message")
 
+    from dstack_tpu.server.services import proxy as proxy_service
+
     now = to_iso(now_utc())
     if new_status == JobStatus.TERMINATING:
         await db.execute(
@@ -685,6 +687,7 @@ async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> No
             (reason.value if reason else None, reason_msg, exit_status,
              jrd.model_dump_json(), now, job_row["id"]),
         )
+        proxy_service.route_table.invalidate_run(job_row["run_id"])
         return
     status_val = (
         new_status.value
@@ -695,6 +698,10 @@ async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> No
         "UPDATE jobs SET status = ?, job_runtime_data = ?, last_processed_at = ? WHERE id = ?",
         (status_val, jrd.model_dump_json(), now, job_row["id"]),
     )
+    if status_val != job_row["status"]:
+        # The run's replica set changed (e.g. a replica just turned RUNNING
+        # with its ports_mapping): refresh the proxy's cached route.
+        proxy_service.route_table.invalidate_run(job_row["run_id"])
 
     # max_duration enforcement, measured from the observed RUNNING transition so queue
     # and provisioning time don't count against the run-time budget.

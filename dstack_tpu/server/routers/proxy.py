@@ -9,7 +9,6 @@ from __future__ import annotations
 
 from aiohttp import web
 
-from dstack_tpu.core.models.runs import RunSpec
 from dstack_tpu.server.db import loads
 from dstack_tpu.server.routers._common import auth_project
 from dstack_tpu.server.services import proxy as proxy_service
@@ -23,27 +22,16 @@ async def _handle(request: web.Request) -> web.StreamResponse:
     run_name = request.match_info["run_name"]
     tail = request.match_info.get("tail", "")
 
-    project_row = await db.fetchone(
-        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
-    )
-    if project_row is None:
-        raise web.HTTPNotFound(text=f"no project {project_name}")
-    run_row = await db.fetchone(
-        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
-        (project_row["id"], run_name),
-    )
-    if run_row is None:
-        raise web.HTTPNotFound(text=f"no run {run_name}")
-    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
-    conf = run_spec.configuration
-    if getattr(conf, "type", None) != "service":
+    # The route table makes the steady-state data plane DB-free: run row,
+    # parsed spec, and resolved replica endpoints all come from one cached
+    # entry, invalidated on scheduler state transitions + a short TTL.
+    entry = await proxy_service.resolve_route(db, project_name, run_name)
+    if not entry.is_service:
         raise web.HTTPBadRequest(text=f"run {run_name} is not a service")
-    if getattr(conf, "auth", True):
+    if entry.auth:
         await auth_project(request)
 
-    return await proxy_service.proxy_request(
-        request, db, project_row, run_name, tail, conf=conf
-    )
+    return await proxy_service.proxy_request(request, db, entry, tail)
 
 
 routes.route("*", "/proxy/services/{project_name}/{run_name}/{tail:.*}")(_handle)
@@ -100,10 +88,9 @@ async def model_route(request: web.Request) -> web.StreamResponse:
         raise web.HTTPNotFound(text=f"no service serves model {model_name!r}")
     run_row, model = models[model_name]
     prefix = (model.prefix or "/v1").strip("/")
-    serving_conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
+    entry = await proxy_service.resolve_route(db, project_name, run_row["run_name"])
     return await proxy_service.proxy_request(
-        request, db, project_row, run_row["run_name"], f"{prefix}/{tail}",
-        body=body, conf=serving_conf,
+        request, db, entry, f"{prefix}/{tail}", body=body
     )
 
 

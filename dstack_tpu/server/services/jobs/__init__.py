@@ -66,6 +66,16 @@ async def set_job_status(
             job_row["id"],
         ),
     )
+    # Every job transition drops the run's cached proxy route (no-op for runs
+    # never proxied). Import is deferred: proxy imports this module.
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    try:
+        run_id = job_row["run_id"]
+    except (KeyError, IndexError):
+        run_id = None
+    if run_id:
+        proxy_service.route_table.invalidate_run(run_id)
 
 
 async def touch_jobs(db: Database, job_rows: List) -> None:

@@ -14,6 +14,8 @@ import os
 from pathlib import Path
 from typing import List, Optional
 
+import pydantic
+
 from dstack_tpu.core.models.logs import LogEvent
 from dstack_tpu.server import settings
 
@@ -36,6 +38,17 @@ class LogStorage(abc.ABC):
 class FileLogStorage(LogStorage):
     def __init__(self, root: Optional[str] = None):
         self.root = Path(root) if root else settings.LOGS_DIR
+        # Per-stream (line_count, byte_offset) memo of the furthest point a
+        # poll has consumed, so tail-polling seeks straight to the new bytes
+        # instead of re-reading the file from line 0 every call. A shrunk file
+        # resets the memo up front; a same-or-larger replacement is caught by
+        # the decode-error rescan fallback in poll_logs.
+        # Bounded: least-recently-polled streams are evicted past the cap, so
+        # long-dead jobs' memos don't accumulate forever (eviction only costs
+        # that stream one full rescan if it is ever polled again).
+        self._offsets: dict = {}
+
+    _OFFSETS_CAP = 4096
 
     def _path(self, project_id: str, run_name: str, job_id: str) -> Path:
         return self.root / project_id / run_name / f"{job_id}.jsonl"
@@ -58,19 +71,53 @@ class FileLogStorage(LogStorage):
         limit: int = 1000,
     ) -> List[LogEvent]:
         path = self._path(project_id, run_name, job_id)
-        if not path.exists():
+        key = (project_id, run_name, job_id)
+        try:
+            size = path.stat().st_size
+        except OSError:
+            self._offsets.pop(key, None)
             return []
-        out: List[LogEvent] = []
-        with open(path, "r", encoding="utf-8") as f:
-            for i, line in enumerate(f):
-                if i < start_line:
-                    continue
-                if len(out) >= limit:
-                    break
-                line = line.strip()
-                if line:
-                    out.append(LogEvent.model_validate(json.loads(line)))
+        line_i, byte_off = self._offsets.get(key, (0, 0))
+        if byte_off > size or line_i > start_line:
+            # File shrank (rotated/truncated) or the caller rewound behind the
+            # memo: fall back to a full scan and rebuild the memo.
+            line_i, byte_off = 0, 0
+        try:
+            out, line_i, byte_off = self._scan(path, start_line, limit, line_i, byte_off)
+        except (ValueError, pydantic.ValidationError):
+            if (line_i, byte_off) == (0, 0):
+                raise  # genuinely corrupt file: same failure as a memo-less scan
+            # The file was replaced by one of equal-or-larger size (shrink
+            # detection can't see that): the memo'd seek landed mid-line.
+            # Rescan from the top; only this recovery pass pays the full read.
+            out, line_i, byte_off = self._scan(path, start_line, limit, 0, 0)
+        # Re-insert at the back: dict order doubles as the LRU for eviction.
+        self._offsets.pop(key, None)
+        self._offsets[key] = (line_i, byte_off)
+        while len(self._offsets) > self._OFFSETS_CAP:
+            self._offsets.pop(next(iter(self._offsets)))
         return out
+
+    @staticmethod
+    def _scan(path: Path, start_line: int, limit: int, line_i: int, byte_off: int):
+        out: List[LogEvent] = []
+        # Binary mode so byte offsets are exact (text mode counts decoded chars).
+        with open(path, "rb") as f:
+            f.seek(byte_off)
+            for raw in f:
+                if not raw.endswith(b"\n"):
+                    break  # partial trailing write; re-read it next poll
+                if line_i >= start_line:
+                    if len(out) >= limit:
+                        break
+                    stripped = raw.strip()
+                    if stripped:
+                        out.append(
+                            LogEvent.model_validate(json.loads(stripped.decode("utf-8")))
+                        )
+                line_i += 1
+                byte_off += len(raw)
+        return out, line_i, byte_off
 
 
 class GcpLogStorage(LogStorage):

@@ -133,4 +133,37 @@ async def render_metrics(db: Database) -> str:
         )
     )
 
+    # Service data-plane window (services/proxy.py ServiceStats): the same RPS
+    # the autoscaler scales on, plus mean proxied latency over the last minute.
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    run_ids = proxy_service.stats.run_ids()
+    svc_rps, svc_latency = [], []
+    if run_ids:
+        rows = await db.fetch_in(
+            "SELECT id, run_name FROM runs WHERE deleted = 0 AND id IN ({in})", run_ids
+        )
+        for r in rows:
+            labels = {"run": r["run_name"]}
+            svc_rps.append((labels, proxy_service.stats.rps(r["id"])))
+            latency = proxy_service.stats.avg_latency(r["id"])
+            if latency is not None:
+                svc_latency.append((labels, latency))
+    sections.append(
+        _fmt(
+            "dstack_tpu_service_requests_per_second",
+            "Proxied service RPS over the trailing minute",
+            "gauge",
+            svc_rps,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_service_request_latency_seconds",
+            "Mean proxied request latency over the trailing minute",
+            "gauge",
+            svc_latency,
+        )
+    )
+
     return "\n".join(sections) + "\n"

@@ -12,6 +12,7 @@ local backend each replica gets an ephemeral port assigned at submit time
 
 from __future__ import annotations
 
+import asyncio
 import collections
 import logging
 import time
@@ -20,8 +21,10 @@ from typing import Deque, Dict, List, Optional, Tuple
 from aiohttp import web
 
 from dstack_tpu.core.models.runs import JobProvisioningData, JobRuntimeData
+from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, loads
 from dstack_tpu.server.services.jobs import job_jpd, job_jrd, job_spec as load_job_spec
+from dstack_tpu.server.services.locking import get_locker
 from dstack_tpu.server.services.runner import ssh as runner_ssh
 
 logger = logging.getLogger(__name__)
@@ -47,6 +50,9 @@ class ServiceStats:
 
     def __init__(self) -> None:
         self._requests: Dict[str, Deque[float]] = {}
+        # (ts, seconds) per completed proxied request: the autoscaler's future
+        # latency signal (scale on p50/mean latency, not just RPS).
+        self._latencies: Dict[str, Deque[Tuple[float, float]]] = {}
         # (run_id, bucket) -> count at last persist; lets each checkpoint write
         # only buckets that changed instead of re-upserting the whole window.
         self.persisted: Dict[Tuple[str, int], int] = {}
@@ -61,6 +67,40 @@ class ServiceStats:
         dq = self._requests.setdefault(run_id, collections.deque())
         dq.append(ts if ts is not None else time.monotonic())
         self._trim(dq)
+
+    def record_latency(self, run_id: str, seconds: float) -> None:
+        dq = self._latencies.setdefault(run_id, collections.deque())
+        dq.append((time.monotonic(), seconds))
+        cutoff = time.monotonic() - STATS_WINDOW
+        while dq and dq[0][0] < cutoff:
+            dq.popleft()
+
+    def avg_latency(self, run_id: str, window: float = 60.0) -> Optional[float]:
+        """Mean end-to-end proxied latency (seconds) over `window`, or None
+        when no request completed in it."""
+        dq = self._latencies.get(run_id)
+        if not dq:
+            return None
+        cutoff = time.monotonic() - window
+        samples = [lat for ts, lat in dq if ts >= cutoff]
+        if not samples:
+            return None
+        return sum(samples) / len(samples)
+
+    def run_ids(self) -> List[str]:
+        """Runs with any window state (requests or latencies) — the public
+        surface for exporters; the internal deque layout is not a contract."""
+        return sorted(set(self._requests) | set(self._latencies))
+
+    def drop_run(self, run_id: str) -> None:
+        """Forget a deleted run's window so per-run state can't grow unbounded."""
+        self._requests.pop(run_id, None)
+        self._latencies.pop(run_id, None)
+        for key in [k for k in self.persisted if k[0] == run_id]:
+            del self.persisted[key]
+        for source_map in self._external.values():
+            for key in [k for k in source_map if k[0] == run_id]:
+                del source_map[key]
 
     def set_external(self, source: str, rows) -> None:
         """Replace `source`'s pulled window: rows of (run_id, bucket, count)."""
@@ -136,6 +176,7 @@ class ServiceStats:
 
     def reset(self) -> None:
         self._requests.clear()
+        self._latencies.clear()
         self.persisted.clear()
         self._external.clear()
 
@@ -178,8 +219,189 @@ from dstack_tpu.core.services.rate_limit import RateLimiter
 
 rate_limiter = RateLimiter()
 
-# Round-robin cursor per run.
+# Round-robin cursor per run (swept by forget_run with the rest of the
+# per-run state when a run is deleted).
 _rr: Dict[str, int] = {}
+
+
+class RouteEntry:
+    """Everything the data plane needs to forward one request, resolved once:
+    run identity, parsed configuration bits (auth flag, rate limits), and the
+    ready replicas' endpoints AFTER ports_mapping/tunnel resolution — so the
+    steady-state request path is an in-memory lookup, zero DB round trips.
+
+    Endpoints are populated lazily, on the first ADMITTED request
+    (proxy_request), never at resolve time: an unauthenticated request must
+    not cause replica listing or SSH tunnel establishment."""
+
+    __slots__ = (
+        "key", "run_id", "project_id", "conf", "limits", "auth", "is_service",
+        "endpoints", "n_running", "n_ready", "built_at",
+    )
+
+    def __init__(self, key, run_id, project_id, conf) -> None:
+        self.key: Tuple[str, str] = key  # (project_name, run_name)
+        self.run_id: str = run_id
+        self.project_id: str = project_id
+        self.conf = conf
+        self.limits: List[dict] = [
+            l.model_dump(mode="json") for l in getattr(conf, "rate_limits", []) or []
+        ]
+        self.auth: bool = getattr(conf, "auth", True)
+        self.is_service: bool = getattr(conf, "type", None) == "service"
+        # None = not yet populated (post-auth, first admitted request).
+        self.endpoints: Optional[List[Tuple[str, int]]] = None
+        self.n_running: int = 0  # running replicas (ready or not)
+        self.n_ready: int = 0    # passed (or not yet given) a readiness probe
+        self.built_at: float = time.monotonic()
+
+
+class RouteTable:
+    """Per-run route cache for the service proxy. Entries are invalidated on
+    job/run state transitions (set_job_status, scaling, probe flips, run
+    deletion) and bounded by a TTL fallback (DSTACK_TPU_PROXY_ROUTE_CACHE_TTL)
+    so a missed invalidation hook can only serve stale routes briefly."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[Tuple[str, str], RouteEntry] = {}
+        self._run_index: Dict[str, Tuple[str, str]] = {}
+        # PER-RUN invalidation sequence, keyed only for runs whose endpoint
+        # resolution has ever started (mark_build). Fences the awaited part of
+        # a build: an invalidation of THIS run mid-resolve discards the result
+        # from the cache; unrelated runs' transitions don't touch it. Swept by
+        # forget_run along with the other per-run state.
+        self._run_seq: Dict[str, int] = {}
+
+    @property
+    def ttl(self) -> float:
+        return settings.PROXY_ROUTE_CACHE_TTL
+
+    def mark_build(self, run_id: str) -> int:
+        """Start fencing `run_id`: returns the current sequence; compare with
+        run_seq() after awaited work to detect a concurrent invalidation."""
+        return self._run_seq.setdefault(run_id, 0)
+
+    def run_seq(self, run_id: str) -> int:
+        return self._run_seq.get(run_id, 0)
+
+    def _bump(self, run_id: str) -> None:
+        if run_id in self._run_seq:
+            self._run_seq[run_id] += 1
+
+    def get(self, project_name: str, run_name: str) -> Optional[RouteEntry]:
+        if self.ttl <= 0:
+            return None
+        entry = self._entries.get((project_name, run_name))
+        if entry is None:
+            return None
+        if time.monotonic() - entry.built_at > self.ttl:
+            self.invalidate(project_name, run_name)
+            return None
+        return entry
+
+    def put(self, entry: RouteEntry) -> None:
+        if self.ttl <= 0:
+            return
+        self._entries[entry.key] = entry
+        self._run_index[entry.run_id] = entry.key
+
+    def invalidate(self, project_name: str, run_name: str) -> None:
+        entry = self._entries.pop((project_name, run_name), None)
+        if entry is not None:
+            self._run_index.pop(entry.run_id, None)
+            self._bump(entry.run_id)
+
+    def invalidate_run(self, run_id: str) -> None:
+        """Drop the route of the run that just changed state. Cheap no-op for
+        runs that were never proxied — every scheduler transition calls this."""
+        self._bump(run_id)
+        key = self._run_index.pop(run_id, None)
+        if key is not None:
+            self._entries.pop(key, None)
+
+    def forget_seq(self, run_id: str) -> None:
+        self._run_seq.pop(run_id, None)
+
+    def clear(self) -> None:
+        for run_id in self._run_seq:
+            self._run_seq[run_id] += 1
+        self._entries.clear()
+        self._run_index.clear()
+
+
+route_table = RouteTable()
+
+
+def forget_run(run_id: str) -> None:
+    """Run deleted: drop ALL its per-run proxy state (route entry, build fence,
+    round-robin cursor, stats window, rate-limit buckets) so none of it grows
+    unbounded."""
+    route_table.invalidate_run(run_id)
+    route_table.forget_seq(run_id)
+    _rr.pop(run_id, None)
+    stats.drop_run(run_id)
+    rate_limiter.drop_scope(run_id)
+
+
+async def resolve_route(db: Database, project_name: str, run_name: str) -> RouteEntry:
+    """Cached route lookup; on miss, rebuilds the identity/spec half of the
+    entry (two fetches + one spec validation — the same pre-auth cost the
+    legacy path paid). Replica endpoints are NOT resolved here: that happens
+    post-auth in proxy_request, so unauthenticated traffic can't drive tunnel
+    establishment. Raises 404 for unknown project/run (negatives not cached)."""
+    entry = route_table.get(project_name, run_name)
+    if entry is not None:
+        return entry
+
+    # No fence needed here: after the run row lands there is no await before
+    # put(), so an invalidation can't interleave (single-threaded loop), and
+    # the DB reads themselves always reflect post-transition state.
+    project_row = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project_row is None:
+        raise web.HTTPNotFound(text=f"no project {project_name}")
+    run_row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise web.HTTPNotFound(text=f"no run {run_name}")
+
+    from dstack_tpu.core.models.runs import RunSpec
+
+    conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
+    entry = RouteEntry(
+        (project_name, run_name), run_row["id"], project_row["id"], conf
+    )
+    route_table.put(entry)
+    return entry
+
+
+async def _populate_endpoints(db: Database, entry: RouteEntry) -> None:
+    """Resolve the entry's ready-replica endpoints (ports_mapping + tunnels).
+    Runs once per cached entry, on the first admitted request; if THIS run's
+    state transitioned mid-resolve (per-run fence — unrelated runs' churn
+    doesn't count), the result serves this request only and the entry is
+    dropped so the next request rebuilds fresh."""
+    seq = route_table.mark_build(entry.run_id)
+    replicas = await list_service_replicas(db, entry.project_id, entry.key[1])
+    entry.n_running = len(replicas)
+    ready = [
+        (jpd, port)
+        for _, jpd, jrd, port in replicas
+        if jrd is None or jrd.probe_ready is not False
+    ]
+    entry.n_ready = len(ready)
+    endpoints: List[Tuple[str, int]] = []
+    for jpd, port in ready:
+        try:
+            endpoints.append(await replica_endpoint(jpd, port))
+        except Exception as e:
+            logger.warning("proxy: tunnel to %s failed: %s", jpd.hostname, e)
+    entry.endpoints = endpoints
+    if seq != route_table.run_seq(entry.run_id):
+        route_table.invalidate(*entry.key)
 
 async def list_service_replicas(
     db: Database, project_id: str, run_name: str, ready_only: bool = False
@@ -224,11 +446,8 @@ async def probe_service_replicas(db: Database, project_id: str, run_name: str) -
     channel — so after connecting we read briefly: immediate EOF = not ready,
     open-and-quiet (or data) = ready. Writes re-read the row under the run lock
     and change ONLY probe_ready, so they never clobber the pull loop's
-    concurrent jrd updates."""
-    import asyncio
-
-    from dstack_tpu.server.services.locking import get_locker
-
+    concurrent jrd updates. A flip also refreshes the route table: the next
+    request rebuilds its replica endpoints instead of waiting out the TTL."""
     replicas = await list_service_replicas(db, project_id, run_name)
     if not replicas:
         return
@@ -265,11 +484,19 @@ async def probe_service_replicas(db: Database, project_id: str, run_name: str) -
                 continue
             jrd = job_jrd(fresh) or JobRuntimeData()
             if jrd.probe_ready != ready:
+                logger.info(
+                    "service %s replica job %s probe flip: %s -> %s",
+                    run_name, fresh["id"],
+                    "ready" if jrd.probe_ready else
+                    ("unprobed" if jrd.probe_ready is None else "not-ready"),
+                    "ready" if ready else "not-ready",
+                )
                 jrd.probe_ready = ready
                 await db.execute(
                     "UPDATE jobs SET job_runtime_data = ? WHERE id = ?",
                     (jrd.model_dump_json(), fresh["id"]),
                 )
+                route_table.invalidate_run(row["run_id"])
 
 
 async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, int]:
@@ -281,59 +508,63 @@ async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, in
 async def proxy_request(
     request: web.Request,
     db: Database,
-    project_row,
-    run_name: str,
+    entry: RouteEntry,
     tail: str,
     body: bytes = None,
-    conf=None,
 ) -> web.StreamResponse:
     """Forward one HTTP request to a replica; admitted requests are recorded for
     autoscaling (even when no replica is up, so scale-from-zero sees demand).
-    `conf` is the already-parsed run configuration when the caller has it —
-    the hot path must not re-validate the spec per request."""
-    run_row = await db.fetchone(
-        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
-        (project_row["id"], run_name),
-    )
-    if run_row is None:
-        raise web.HTTPNotFound(text=f"no service run {run_name}")
-
+    `entry` is the resolved route (resolve_route) — the steady-state hot path
+    touches only in-memory state before the upstream forward."""
+    run_name = entry.key[1]
+    if route_table.ttl <= 0:
+        # Cache disabled = the pre-fast-path behavior, including its
+        # per-request existence guard (with caching on, the deletion hooks
+        # own this: forget_run drops the route the moment the run goes).
+        run_row = await db.fetchone(
+            "SELECT id FROM runs WHERE id = ? AND deleted = 0", (entry.run_id,)
+        )
+        if run_row is None:
+            raise web.HTTPNotFound(text=f"no service run {run_name}")
     # rate_limits: token buckets per configured prefix (reference nginx
     # limit_req). Throttled requests are rejected BEFORE autoscaler accounting —
     # throttled demand must not drive scale-up it can never reach.
-    if conf is None:
-        from dstack_tpu.core.models.runs import RunSpec
-
-        conf = RunSpec.model_validate(loads(run_row["run_spec"])).configuration
-    limits = [
-        l.model_dump(mode="json") for l in getattr(conf, "rate_limits", []) or []
-    ]
-    if limits and not rate_limiter.check(run_row["id"], "/" + tail, limits):
+    if entry.limits and not rate_limiter.check(entry.run_id, "/" + tail, entry.limits):
         raise web.HTTPTooManyRequests(text="rate limit exceeded")
-    stats.record(run_row["id"])
+    stats.record(entry.run_id)
 
-    replicas = await list_service_replicas(
-        db, project_row["id"], run_name, ready_only=True
-    )
-    if not replicas:
-        any_replicas = await list_service_replicas(db, project_row["id"], run_name)
+    if entry.endpoints is None:
+        await _populate_endpoints(db, entry)
+    if not entry.endpoints:
+        if entry.n_ready:
+            # Replicas looked ready but no tunnel resolved at build time; drop
+            # the entry so the next request retries establishment.
+            route_table.invalidate(*entry.key)
+            raise web.HTTPBadGateway(text="replica unreachable")
         raise web.HTTPServiceUnavailable(
             text=(
                 f"service {run_name} replicas are starting (readiness probe pending)"
-                if any_replicas
+                if entry.n_running
                 else f"service {run_name} has no running replicas"
             )
         )
-    cursor = _rr.get(run_row["id"], 0)
-    _rr[run_row["id"]] = cursor + 1
-    row, jpd, jrd, port = replicas[cursor % len(replicas)]
-
-    try:
-        host, local_port = await replica_endpoint(jpd, port)
-    except Exception as e:
-        logger.warning("proxy: tunnel to %s failed: %s", jpd.hostname, e)
-        raise web.HTTPBadGateway(text="replica unreachable")
+    cursor = _rr.get(entry.run_id, 0)
+    _rr[entry.run_id] = cursor + 1
+    host, local_port = entry.endpoints[cursor % len(entry.endpoints)]
 
     from dstack_tpu.core.services.http_forward import forward
 
-    return await forward(request, host, local_port, tail, body=body)
+    t0 = time.monotonic()
+    try:
+        resp = await forward(request, host, local_port, tail, body=body)
+    except web.HTTPBadGateway:
+        # A cached endpoint went dark (replica died, tunnel dropped): rebuild
+        # the route on the next request instead of pinning traffic to it.
+        route_table.invalidate(*entry.key)
+        raise
+    if isinstance(resp, web.Response):
+        # Buffered (known-length) responses only: for streamed/SSE output
+        # forward() returns after the WHOLE stream, and a 120s held-open
+        # completion would poison the mean-latency autoscaler signal.
+        stats.record_latency(entry.run_id, time.monotonic() - t0)
+    return resp

@@ -320,6 +320,13 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
             )
 
     await db.run(_tx)
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    if existing is not None:
+        # The old (soft-deleted) run's proxy state goes with it; the route for
+        # this run name must rebuild against the fresh run id.
+        proxy_service.forget_run(existing["id"])
+    proxy_service.route_table.invalidate(project_row["name"], run_name)
     run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
     return await run_model_to_run(db, run_row)
 
@@ -436,6 +443,11 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
         if not RunStatus(row["status"]).is_finished():
             raise ServerClientError(f"run {name} is {row['status']}; stop it first")
         await db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))
+        # Sweep ALL the proxy's per-run state (route entry, rr cursor, stats
+        # window, rate-limit buckets): deleted runs must not leak memory.
+        from dstack_tpu.server.services import proxy as proxy_service
+
+        proxy_service.forget_run(row["id"])
 
 
 def _validate_run_name(name: str) -> None:
@@ -547,6 +559,10 @@ async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
             next_num += 1
             scheduled += 1
 
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    proxy_service.route_table.invalidate_run(run_row["id"])
+
 
 # =====================================================================================
 # In-place update (parity: reference runs.py:896-944 _check_can_update_run_spec —
@@ -609,6 +625,9 @@ async def update_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
         "UPDATE runs SET run_spec = ? WHERE id = ?",
         (run_spec.model_dump_json(), row["id"]),
     )
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    proxy_service.route_table.invalidate_run(row["id"])  # rate_limits may have changed
     conf = run_spec.configuration
     if conf.type == "service" and conf.scaling is None:
         # Manual replica count: converge now (autoscaled services converge via

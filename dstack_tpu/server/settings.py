@@ -61,6 +61,16 @@ SCHEDULER_CONCURRENCY = int(os.getenv("DSTACK_TPU_SCHEDULER_CONCURRENCY", "16"))
 # disables. Invalidated early when a project's backend config changes.
 OFFER_CACHE_TTL = float(os.getenv("DSTACK_TPU_OFFER_CACHE_TTL", "30"))
 
+# Service-proxy fast path. Route cache TTL (seconds): the staleness bound for
+# cached run-row/spec/replica-endpoint routes when an invalidation hook is
+# missed; state transitions (job status, probe flips, scaling, deletion)
+# invalidate eagerly, so this is a fallback, not the refresh mechanism. 0
+# disables the cache (per-request DB resolution, the pre-fast-path behavior).
+PROXY_ROUTE_CACHE_TTL = float(os.getenv("DSTACK_TPU_PROXY_ROUTE_CACHE_TTL", "10"))
+# The upstream keep-alive pool's per-replica-host cap lives in
+# DSTACK_TPU_PROXY_POOL_SIZE, read directly by core/services/http_forward
+# (core must not depend on server settings — the gateway appliance uses it too).
+
 # Scheduler FSM knobs.
 MAX_OFFERS_TRIED = int(os.getenv("DSTACK_TPU_MAX_OFFERS_TRIED", "5"))
 PROVISIONING_TIMEOUT = float(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))

@@ -23,6 +23,18 @@ def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(fn(**kwargs))
+
+        async def _run():
+            try:
+                return await fn(**kwargs)
+            finally:
+                # The proxy's pooled upstream session is per event loop; close
+                # it before asyncio.run tears the loop down so keep-alive
+                # sockets don't leak across tests.
+                from dstack_tpu.core.services import http_forward
+
+                await http_forward.close_session()
+
+        asyncio.run(_run())
         return True
     return None

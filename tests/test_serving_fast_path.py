@@ -1,0 +1,515 @@
+"""Serving fast path: route-table cache, pooled keep-alive upstream
+connections, and the per-run state sweeps that keep the proxy's memory bounded.
+
+These tests drive the REAL in-server proxy (router -> route table -> pooled
+forward) against local stub replicas — no native runner, no cloud: the service
+run + running replica rows are written straight into the DB, exactly the shape
+the scheduler leaves behind."""
+
+import asyncio
+import json
+
+import pytest
+
+from dstack_tpu.core.models.runs import JobStatus, JobTerminationReason
+from dstack_tpu.core.services import http_forward
+from dstack_tpu.server import settings
+from dstack_tpu.server.services import logs as logs_service
+from dstack_tpu.server.services import proxy as proxy_service
+from dstack_tpu.server.services.jobs import set_job_status
+from tests.common import api_server
+
+
+async def seed_service(db, run_name: str, replica_port: int, auth: bool = False,
+                       rate_limits=None):
+    """Insert a ready service run + one running replica pointing at
+    127.0.0.1:replica_port (local backend: the proxy dials it directly)."""
+    proj = await db.fetchone("SELECT * FROM projects LIMIT 1")
+    conf = {
+        "type": "service",
+        "commands": ["serve"],
+        "port": 8000,
+        "auth": auth,
+    }
+    if rate_limits:
+        conf["rate_limits"] = rate_limits
+    await db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
+        " run_spec) VALUES (?, ?, ?, ?, '2026-01-01', 'running', ?)",
+        (f"run-{run_name}", proj["id"], proj["owner_id"], run_name,
+         json.dumps({"run_name": run_name, "configuration": conf})),
+    )
+    job_spec = {
+        "job_name": f"{run_name}-0-0",
+        "image_name": "stub",
+        "requirements": {"resources": {}},
+        "service_port": 8000,
+    }
+    jpd = {
+        "backend": "local",
+        "instance_type": {"name": "local", "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1}},
+        "instance_id": f"i-{run_name}",
+        "hostname": "127.0.0.1",
+        "region": "local",
+    }
+    jrd = {"ports_mapping": {"8000": replica_port}, "probe_ready": True}
+    await db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, job_spec, status,"
+        " submitted_at, job_provisioning_data, job_runtime_data)"
+        " VALUES (?, ?, ?, ?, 0, ?, 'running', '2026-01-01', ?, ?)",
+        (f"job-{run_name}", proj["id"], f"run-{run_name}", run_name,
+         json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
+    )
+    return f"run-{run_name}", f"job-{run_name}"
+
+
+class _StubReplica:
+    """Minimal keep-alive HTTP/1.1 server that counts distinct TCP connections
+    — the ground truth for connection reuse through the pooled session."""
+
+    def __init__(self) -> None:
+        self.connections = 0
+        self.requests = 0
+        self._server = None
+        self._writers = []
+        self.port = None
+
+    async def _handle(self, reader, writer):
+        self.connections += 1
+        self._writers.append(writer)
+        try:
+            while True:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = await reader.read(4096)
+                    if not chunk:
+                        return
+                    data += chunk
+                self.requests += 1
+                body = b"pong"
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: 4\r\n"
+                    b"Connection: keep-alive\r\n\r\n" + body
+                )
+                await writer.drain()
+        finally:
+            writer.close()
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        self._server.close()
+        # Established keep-alive connections outlive the listener; kill them
+        # too so "replica died" means the pooled sockets actually go dark.
+        for writer in self._writers:
+            writer.close()
+        await self._server.wait_closed()
+
+
+class _Fixture:
+    """Pin the route cache TTL high and reset proxy state around each test."""
+
+    def __enter__(self):
+        self._ttl = settings.PROXY_ROUTE_CACHE_TTL
+        settings.PROXY_ROUTE_CACHE_TTL = 3600.0
+        proxy_service.route_table.clear()
+        proxy_service.stats.reset()
+        proxy_service.rate_limiter.reset()
+        proxy_service._rr.clear()
+        http_forward.set_pooling(True)
+        return self
+
+    def __exit__(self, *exc):
+        settings.PROXY_ROUTE_CACHE_TTL = self._ttl
+        proxy_service.route_table.clear()
+        proxy_service.stats.reset()
+        proxy_service.rate_limiter.reset()
+        proxy_service._rr.clear()
+        http_forward.set_pooling(True)
+        return False
+
+
+class TestRouteCache:
+    async def test_steady_state_issues_zero_db_queries(self):
+        """The acceptance bar: after the first (cache-building) request, N
+        proxied requests to a ready service touch the DB zero times."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "fast", stub.port)
+                    resp = await api.client.get("/proxy/services/main/fast/ping")
+                    assert resp.status == 200 and await resp.text() == "pong"
+
+                    counts = {"queries": 0}
+                    orig_all, orig_one = api.db.fetchall, api.db.fetchone
+
+                    async def counted_all(*a, **k):
+                        counts["queries"] += 1
+                        return await orig_all(*a, **k)
+
+                    async def counted_one(*a, **k):
+                        counts["queries"] += 1
+                        return await orig_one(*a, **k)
+
+                    api.db.fetchall, api.db.fetchone = counted_all, counted_one
+                    try:
+                        for _ in range(20):
+                            resp = await api.client.get("/proxy/services/main/fast/ping")
+                            assert resp.status == 200
+                    finally:
+                        api.db.fetchall, api.db.fetchone = orig_all, orig_one
+                    assert counts["queries"] == 0, (
+                        f"steady-state proxying hit the DB {counts['queries']} times"
+                    )
+                    # The window fed the autoscaler along the way: RPS and latency.
+                    assert proxy_service.stats.rps("run-fast") > 0
+                    assert proxy_service.stats.avg_latency("run-fast") is not None
+            finally:
+                await stub.stop()
+
+    async def test_invalidation_on_replica_stop_and_start(self):
+        """A replica stopping (job leaves RUNNING) must drop the cached route
+        immediately — not after the TTL — and its restart must restore it."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    run_id, job_id = await seed_service(api.db, "flap", stub.port)
+                    resp = await api.client.get("/proxy/services/main/flap/ping")
+                    assert resp.status == 200
+
+                    job_row = await api.db.fetchone(
+                        "SELECT * FROM jobs WHERE id = ?", (job_id,)
+                    )
+                    await set_job_status(
+                        api.db, job_row, JobStatus.TERMINATING,
+                        JobTerminationReason.TERMINATED_BY_USER,
+                    )
+                    resp = await api.client.get("/proxy/services/main/flap/ping")
+                    assert resp.status == 503, (
+                        "stopped replica still served from a stale cached route"
+                    )
+
+                    await set_job_status(api.db, job_row, JobStatus.RUNNING)
+                    resp = await api.client.get("/proxy/services/main/flap/ping")
+                    assert resp.status == 200
+            finally:
+                await stub.stop()
+
+    async def test_run_deletion_sweeps_all_per_run_state(self):
+        """forget_run: route entry, rr cursor, stats window, persisted marks,
+        and rate-limit buckets all go when the run does."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    run_id, job_id = await seed_service(
+                        api.db, "doomed", stub.port,
+                        rate_limits=[{"prefix": "/", "rps": 1000, "burst": 100}],
+                    )
+                    for _ in range(3):
+                        resp = await api.client.get("/proxy/services/main/doomed/ping")
+                        assert resp.status == 200
+                    proxy_service.stats.persisted[(run_id, 0)] = 3
+
+                    assert run_id in proxy_service._rr
+                    assert proxy_service.stats.rps(run_id) > 0
+                    assert proxy_service.route_table.get("main", "doomed") is not None
+
+                    # Finish the jobs, then delete through the real service path.
+                    await api.db.execute(
+                        "UPDATE jobs SET status = 'done' WHERE run_id = ?", (run_id,)
+                    )
+                    await api.db.execute(
+                        "UPDATE runs SET status = 'done' WHERE id = ?", (run_id,)
+                    )
+                    from dstack_tpu.server.services import runs as runs_service
+
+                    proj = await api.db.fetchone("SELECT * FROM projects LIMIT 1")
+                    await runs_service.delete_runs(api.db, proj, ["doomed"])
+
+                    assert run_id not in proxy_service._rr
+                    assert run_id not in proxy_service.stats._requests
+                    assert run_id not in proxy_service.stats._latencies
+                    assert not any(
+                        k[0] == run_id for k in proxy_service.stats.persisted
+                    )
+                    assert not any(
+                        k[0] == run_id for k in proxy_service.rate_limiter._buckets
+                    )
+                    assert proxy_service.route_table.get("main", "doomed") is None
+            finally:
+                await stub.stop()
+
+    async def test_ttl_fallback_bounds_staleness(self):
+        """With hooks out of the picture (direct UPDATE, no set_job_status),
+        the TTL still expires the stale route."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "ttl", stub.port)
+                    resp = await api.client.get("/proxy/services/main/ttl/ping")
+                    assert resp.status == 200
+                    # Bypass every hook: raw status flip.
+                    await api.db.execute(
+                        "UPDATE jobs SET status = 'terminating' WHERE run_id = ?",
+                        ("run-ttl",),
+                    )
+                    # Cached route still serves (that's the point of the cache)...
+                    resp = await api.client.get("/proxy/services/main/ttl/ping")
+                    assert resp.status == 200
+                    # ...until the TTL expires it.
+                    settings.PROXY_ROUTE_CACHE_TTL = 0.01
+                    await asyncio.sleep(0.05)
+                    resp = await api.client.get("/proxy/services/main/ttl/ping")
+                    assert resp.status == 503
+            finally:
+                await stub.stop()
+
+
+class TestPooledUpstream:
+    async def test_keepalive_reuses_one_tcp_connection(self):
+        """N sequential proxied requests ride ONE upstream TCP connection."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "pooled", stub.port)
+                    for _ in range(8):
+                        resp = await api.client.get("/proxy/services/main/pooled/ping")
+                        assert resp.status == 200
+                        assert await resp.text() == "pong"
+                    assert stub.requests == 8
+                    assert stub.connections == 1, (
+                        f"expected 1 keep-alive connection, saw {stub.connections}"
+                    )
+            finally:
+                await stub.stop()
+
+    async def test_legacy_mode_dials_per_request(self):
+        """set_pooling(False) restores the old one-connection-per-request path
+        (what bench_proxy measures against)."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "unpooled", stub.port)
+                    http_forward.set_pooling(False)
+                    for _ in range(3):
+                        resp = await api.client.get("/proxy/services/main/unpooled/ping")
+                        assert resp.status == 200
+                    assert stub.connections == 3
+            finally:
+                await stub.stop()
+
+    async def test_sse_streams_unbuffered_through_pool(self):
+        """Chunked/SSE output must flow through the pooled session chunk by
+        chunk: the client sees the first event while the upstream is still
+        holding the stream open."""
+        from aiohttp import web as aioweb
+
+        with _Fixture():
+            release = asyncio.Event()
+
+            async def sse(request):
+                resp = aioweb.StreamResponse(
+                    headers={"Content-Type": "text/event-stream"}
+                )
+                await resp.prepare(request)
+                await resp.write(b"data: one\n\n")
+                # Hold the stream open until the client confirms receipt of the
+                # first event — if forwarding buffered, this deadlocks (and the
+                # wait_for below fails the test instead of hanging it).
+                await asyncio.wait_for(release.wait(), timeout=10)
+                await resp.write(b"data: two\n\n")
+                await resp.write_eof()
+                return resp
+
+            upstream = aioweb.Application()
+            upstream.router.add_get("/{tail:.*}", sse)
+            runner = aioweb.AppRunner(upstream)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "sse", port)
+                    resp = await api.client.get("/proxy/services/main/sse/events")
+                    assert resp.status == 200
+                    first = await asyncio.wait_for(
+                        resp.content.readuntil(b"\n\n"), timeout=5
+                    )
+                    assert first == b"data: one\n\n"
+                    release.set()
+                    rest = await resp.content.read()
+                    assert rest == b"data: two\n\n"
+            finally:
+                await runner.cleanup()
+
+    async def test_dead_endpoint_invalidates_route(self):
+        """A cached endpoint that stops answering 502s once, then the rebuilt
+        route reflects reality (no more running replicas -> 503)."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "dark", stub.port)
+                    resp = await api.client.get("/proxy/services/main/dark/ping")
+                    assert resp.status == 200
+                    await stub.stop()
+                    resp = await api.client.get("/proxy/services/main/dark/ping")
+                    assert resp.status == 502
+                    # Entry was dropped: the rebuild still sees a 'running' job
+                    # row, resolves the (dead) endpoint, and 502s again — but
+                    # through a FRESH entry each time, never a pinned socket.
+                    assert proxy_service.route_table.get("main", "dark") is None
+            finally:
+                pass
+
+
+class TestFileLogOffsets:
+    def _events(self, n, start=0):
+        return [
+            logs_service.LogEvent.model_validate(
+                {"timestamp": "2026-01-01T00:00:00+00:00", "message": f"line-{i}\n",
+                 "log_source": "stdout"}
+            )
+            for i in range(start, start + n)
+        ]
+
+    def test_tail_poll_seeks_instead_of_rescanning(self, tmp_path):
+        storage = logs_service.FileLogStorage(str(tmp_path))
+        storage.write_logs("p", "r", "j", self._events(5))
+        first = storage.poll_logs("p", "r", "j", start_line=0, limit=1000)
+        assert [e.message for e in first] == [f"line-{i}\n" for i in range(5)]
+        line_i, byte_off = storage._offsets[("p", "r", "j")]
+        assert line_i == 5 and byte_off > 0
+
+        storage.write_logs("p", "r", "j", self._events(3, start=5))
+        tail = storage.poll_logs("p", "r", "j", start_line=5, limit=1000)
+        assert [e.message for e in tail] == [f"line-{i}\n" for i in range(5, 8)]
+        assert storage._offsets[("p", "r", "j")][0] == 8
+
+    def test_memo_validated_against_truncation(self, tmp_path):
+        storage = logs_service.FileLogStorage(str(tmp_path))
+        storage.write_logs("p", "r", "j", self._events(10))
+        assert len(storage.poll_logs("p", "r", "j")) == 10
+        # Truncate behind the memo's back (rotation): the next poll must fall
+        # back to a full scan, not seek past EOF.
+        path = tmp_path / "p" / "r" / "j.jsonl"
+        path.write_text("")
+        storage.write_logs("p", "r", "j", self._events(2))
+        assert len(storage.poll_logs("p", "r", "j", start_line=0)) == 2
+
+    def test_rewind_behind_memo_rescans(self, tmp_path):
+        storage = logs_service.FileLogStorage(str(tmp_path))
+        storage.write_logs("p", "r", "j", self._events(6))
+        assert len(storage.poll_logs("p", "r", "j", start_line=4)) == 2
+        # A caller starting over still gets everything.
+        assert len(storage.poll_logs("p", "r", "j", start_line=0)) == 6
+
+    def test_missing_file_clears_memo(self, tmp_path):
+        storage = logs_service.FileLogStorage(str(tmp_path))
+        assert storage.poll_logs("p", "r", "j") == []
+        assert ("p", "r", "j") not in storage._offsets
+
+    def test_mid_line_memo_recovers_via_rescan(self, tmp_path):
+        """An equal-or-larger file replacement defeats the shrink check and
+        leaves the memo pointing mid-line; the poll must rescan from the top
+        instead of raising (and must keep doing so correctly afterwards)."""
+        storage = logs_service.FileLogStorage(str(tmp_path))
+        storage.write_logs("p", "r", "j", self._events(6))
+        # Plant what a same-size rotation produces: a memo whose byte offset
+        # lands inside a JSON line (byte 10 is always mid-first-line).
+        storage._offsets[("p", "r", "j")] = (2, 10)
+        events = storage.poll_logs("p", "r", "j", start_line=2)
+        assert [e.message for e in events] == [f"line-{i}\n" for i in range(2, 6)]
+        # The memo was rebuilt sane: a tail poll works without rescanning.
+        storage.write_logs("p", "r", "j", self._events(1, start=6))
+        tail = storage.poll_logs("p", "r", "j", start_line=6)
+        assert [e.message for e in tail] == ["line-6\n"]
+
+
+class TestRouteTableFences:
+    def test_build_fence_is_per_run(self):
+        """The endpoint-resolve fence trips only on THIS run's invalidation;
+        unrelated runs' scheduler churn must not evict fresh entries (a global
+        fence would collapse the hit rate on a busy control plane)."""
+        with _Fixture():
+            table = proxy_service.RouteTable()
+            seq = table.mark_build("rid")
+            table.invalidate_run("some-other-run")
+            assert table.run_seq("rid") == seq  # unrelated churn: no trip
+            table.invalidate_run("rid")
+            assert table.run_seq("rid") != seq  # own transition: fence trips
+            table.forget_seq("rid")
+            assert "rid" not in table._run_seq  # swept with the run
+
+    async def test_own_invalidation_during_endpoint_resolve_discards_entry(self):
+        """If the run transitions while its endpoints are being resolved, the
+        built route serves that request only — it is not cached."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    run_id, _ = await seed_service(api.db, "fenced", stub.port)
+                    entry = await proxy_service.resolve_route(api.db, "main", "fenced")
+                    # Simulate a transition landing mid-resolve.
+                    proxy_service.route_table.mark_build(run_id)
+                    proxy_service.route_table.invalidate_run(run_id)
+                    entry2 = await proxy_service.resolve_route(api.db, "main", "fenced")
+                    orig = proxy_service.list_service_replicas
+
+                    async def racing_list(*a, **k):
+                        proxy_service.route_table.invalidate_run(run_id)
+                        return await orig(*a, **k)
+
+                    proxy_service.list_service_replicas = racing_list
+                    try:
+                        await proxy_service._populate_endpoints(api.db, entry2)
+                    finally:
+                        proxy_service.list_service_replicas = orig
+                    assert entry2.endpoints  # this request is still served
+                    assert proxy_service.route_table.get("main", "fenced") is None
+            finally:
+                await stub.stop()
+
+    async def test_unauthenticated_requests_resolve_no_endpoints(self):
+        """auth-protected services: a 401'd request must not trigger replica
+        listing or tunnel establishment (endpoints stay unpopulated)."""
+        with _Fixture():
+            stub = await _StubReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "locked", stub.port, auth=True)
+                    resp = await api.client.get("/proxy/services/main/locked/ping")
+                    assert resp.status == 401
+                    entry = proxy_service.route_table.get("main", "locked")
+                    assert entry is not None and entry.endpoints is None
+                    assert stub.connections == 0
+                    # An authorized request populates and forwards.
+                    resp = await api.client.get(
+                        "/proxy/services/main/locked/ping",
+                        headers={"Authorization": f"Bearer {api.token}"},
+                    )
+                    assert resp.status == 200
+                    assert entry.endpoints
+            finally:
+                await stub.stop()
+
+
+class TestLatencyWindow:
+    def test_avg_latency_over_window(self):
+        stats = proxy_service.ServiceStats()
+        stats.record_latency("r1", 0.10)
+        stats.record_latency("r1", 0.30)
+        assert stats.avg_latency("r1") == pytest.approx(0.20)
+        assert stats.avg_latency("r2") is None
+        stats.drop_run("r1")
+        assert stats.avg_latency("r1") is None
