@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy smoke-observability release publish clean
 
 all: runner wheel
 
@@ -47,6 +47,12 @@ bench-scheduler:
 # the legacy per-request-session/per-request-DB path.
 bench-proxy:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_proxy()))"
+
+# Observability smoke: boots the server in-process, drives one run through the
+# full FSM, and asserts the events timeline + /metrics histograms are live.
+# Prints one JSON line; a missing surface is a non-zero exit.
+smoke-observability:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_observability()"
 
 release: runner wheel
 	@mkdir -p $(DIST)

@@ -85,15 +85,48 @@ def bench_tpu_train() -> dict:
     }
 
 
+def _histogram_summaries(family: str, label_key: str = None) -> dict:
+    """p50/p90/mean/count per label value (or one merged entry) from a tracer
+    histogram — recorded into bench extras so BENCH_* files capture latency
+    DISTRIBUTIONS, not just throughput."""
+    from dstack_tpu.core import tracing
+
+    snap = tracing.histogram_snapshot(family)
+    if snap is None:
+        return {}
+    _, series = snap
+    out = {}
+    if label_key is None:
+        s = tracing.summary(family)
+        return {"all": _round_summary(s)} if s else {}
+    for labels, _, _, _ in series:
+        key = labels.get(label_key, "?")
+        s = tracing.summary(family, labels)
+        if s:
+            out[key] = _round_summary(s)
+    return out
+
+
+def _round_summary(s: dict) -> dict:
+    return {
+        "count": s["count"],
+        "mean_ms": round(s["mean"] * 1000, 3),
+        "p50_ms": round(s["p50"] * 1000, 3),
+        "p90_ms": round(s["p90"] * 1000, 3),
+    }
+
+
 def bench_scheduler() -> dict:
     """150 single-job runs through the real scheduler loops against the mock TPU
     backend + scripted runner (no cloud, no network)."""
     import asyncio
 
+    from dstack_tpu.core import tracing
     from dstack_tpu.server.background import tasks
     from tests.common import FakeRunnerClient, api_server, setup_mock_backend, tpu_task_spec
 
     N = 150  # the reference's per-replica active-run capacity (BASELINE.md)
+    tracing.reset()
 
     async def run() -> float:
         FakeRunnerClient.reset()
@@ -124,7 +157,22 @@ def bench_scheduler() -> dict:
         "value": round(rate, 1),
         "unit": "runs/min",
         "vs_baseline": round(rate / 75.0, 4),
-        "extra": {"runs": N, "seconds": round(dt, 2)},
+        "extra": {
+            "runs": N,
+            "seconds": round(dt, 2),
+            # Per-pass and per-phase latency distributions from the tracer.
+            "pass_durations": _histogram_summaries(
+                "dstack_tpu_scheduler_pass_duration_seconds", "pass"
+            ),
+            "phase_durations": {
+                phase: (_histogram_summaries(family) or {}).get("all")
+                for phase, family in (
+                    ("queue", "dstack_tpu_run_queue_wait_seconds"),
+                    ("provision", "dstack_tpu_run_provision_duration_seconds"),
+                    ("pull", "dstack_tpu_run_pull_duration_seconds"),
+                )
+            },
+        },
     }
 
 
@@ -279,6 +327,9 @@ def bench_proxy() -> dict:
             await http_forward.close_session()
             await stub_runner.cleanup()
 
+    from dstack_tpu.core import tracing
+
+    tracing.reset()
     r = asyncio.run(run())
     return {
         "metric": "proxy_requests_per_sec",
@@ -291,8 +342,72 @@ def bench_proxy() -> dict:
             "legacy_req_per_sec": round(r["before"], 1),
             "requests": N,
             "concurrency": CONCURRENCY,
+            # End-to-end proxied latency distribution across both modes,
+            # from the tracer's service-latency histogram.
+            "latency": _histogram_summaries(
+                "dstack_tpu_service_request_latency_seconds"
+            ).get("all"),
         },
     }
+
+
+def smoke_observability() -> dict:
+    """`make smoke-observability`: boot the server in-process, drive one run
+    through the full FSM, and assert the events timeline + /metrics histogram
+    families are live. Raises (non-zero exit) on any missing piece."""
+    import asyncio
+
+    from dstack_tpu.core import tracing
+    from dstack_tpu.server.background import tasks
+    from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend, tpu_task_spec
+
+    tracing.reset()
+
+    async def run() -> dict:
+        FakeRunnerClient.reset()
+        tasks.get_runner_client = FakeRunnerClient.for_jpd
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("smoke-obs", "v5e-8")
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "smoke-obs"})
+            assert run["status"] == "done", f"run ended {run['status']}"
+
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "smoke-obs"}
+            )
+            statuses = [e["new_status"] for e in data["events"] if e["job_id"]]
+            assert statuses == [
+                "submitted", "provisioning", "pulling", "running", "terminating", "done",
+            ], statuses
+            phases = data["phases"]
+            assert all(
+                phases[p] is not None for p in ("queue", "provision", "pull", "total")
+            ), phases
+
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            for family in (
+                "dstack_tpu_run_queue_wait_seconds",
+                "dstack_tpu_run_provision_duration_seconds",
+                "dstack_tpu_scheduler_pass_duration_seconds",
+            ):
+                assert f"{family}_bucket{{" in text, f"{family} has no samples"
+                assert f"{family}_count" in text, family
+            return {
+                "metric": "smoke_observability",
+                "value": len(data["events"]),
+                "unit": "events",
+                "phases_ms": {
+                    k: round(v * 1000, 1) for k, v in phases.items() if v is not None
+                },
+            }
+
+    result = asyncio.run(run())
+    print(json.dumps(result))
+    return result
 
 
 def main() -> None:

@@ -132,6 +132,11 @@ class RunsApi:
         data = self._c.post(self._c._p("/runs/get"), {"run_name": run_name})
         return Run.model_validate(data)
 
+    def get_events(self, run_name: str) -> dict:
+        """Lifecycle timeline + derived phase durations:
+        {"run_name", "status", "events": [...], "phases": {...}}."""
+        return self._c.post(self._c._p("/runs/get_events"), {"run_name": run_name})
+
     def stop(self, run_names: List[str], abort: bool = False) -> None:
         self._c.post(self._c._p("/runs/stop"), {"runs_names": run_names, "abort": abort})
 

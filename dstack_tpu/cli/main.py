@@ -326,16 +326,28 @@ def cmd_ps(args) -> None:
         runs = client.runs.list()
         if not args.all:
             runs = [r for r in runs if not r.status.is_finished()] or runs[:5]
+        headers = ["NAME", "TYPE", "RESOURCES", "STATUS", "COST", "AGE"]
+        if args.verbose:
+            headers.append("PHASES")
         rows = []
         for r in runs:
             conf = r.run_spec.configuration
             resources = conf.resources.pretty() if conf.resources else ""
-            rows.append(
-                [r.run_name, conf.type, resources, r.status.value, f"${r.cost:.2f}", _age(r.submitted_at)]
-            )
+            row = [
+                r.run_name, conf.type, resources, r.status.value,
+                f"${r.cost:.2f}", _age(r.submitted_at),
+            ]
+            if args.verbose:
+                # One events call per listed run: -v is an operator surface,
+                # and ps caps the listing anyway.
+                try:
+                    row.append(_phase_summary(client.runs.get_events(r.run_name)["phases"]))
+                except DstackTpuError:
+                    row.append("-")
+            rows.append(row)
         if args.watch:
             _clear_screen()
-        print(_table(["NAME", "TYPE", "RESOURCES", "STATUS", "COST", "AGE"], rows), flush=True)
+        print(_table(headers, rows), flush=True)
 
     _watch_loop(render, args.watch, 2.0)
 
@@ -404,6 +416,61 @@ def cmd_metrics(args) -> None:
     _watch_loop(render, args.watch, args.interval)
 
 
+def _fmt_secs(seconds) -> str:
+    if seconds is None:
+        return "-"
+    if seconds < 1:
+        return f"{seconds * 1000:.0f}ms"
+    if seconds < 60:
+        return f"{seconds:.1f}s"
+    from dstack_tpu.utils.common import pretty_resources_duration
+
+    return pretty_resources_duration(seconds)
+
+
+def _phase_summary(phases: dict) -> str:
+    parts = []
+    for name in ("queue", "provision", "pull", "run"):
+        if phases.get(name) is not None:
+            parts.append(f"{name}={_fmt_secs(phases[name])}")
+    return " ".join(parts) or "-"
+
+
+def cmd_events(args) -> None:
+    """Print a run's lifecycle timeline with per-phase durations."""
+    client = _client()
+    data = client.runs.get_events(args.run_name)
+    events = data["events"]
+    if not events:
+        print(f"no events recorded for {args.run_name}")
+        return
+    from dstack_tpu.utils.common import from_iso
+
+    t0 = from_iso(events[0]["timestamp"])
+    rows = []
+    for ev in events:
+        offset = (from_iso(ev["timestamp"]) - t0).total_seconds()
+        transition = (
+            f"{ev['old_status']} -> {ev['new_status']}"
+            if ev["old_status"]
+            else ev["new_status"]
+        )
+        scope = "run" if ev["job_id"] is None else f"job {ev['job_id'][:8]}"
+        detail = ev["reason"] or ""
+        if ev["message"]:
+            detail = f"{detail}: {ev['message']}" if detail else ev["message"]
+        rows.append(
+            [f"+{_fmt_secs(offset)}", scope, transition, ev["actor"], detail or "-"]
+        )
+    print(f"run {data['run_name']} ({data['status']})")
+    print(_table(["TIME", "SCOPE", "TRANSITION", "ACTOR", "REASON"], rows))
+    phases = data["phases"]
+    print()
+    print("phases:")
+    for name in ("queue", "provision", "pull", "run", "total"):
+        print(f"  {name:<10} {_fmt_secs(phases.get(name))}")
+
+
 def cmd_offer(args) -> None:
     client = _client()
     resources = {}
@@ -428,7 +495,7 @@ def cmd_offer(args) -> None:
 
 
 _SUBCOMMANDS = (
-    "server config init apply attach metrics ps stop delete logs offer fleet"
+    "server config init apply attach metrics events ps stop delete logs offer fleet"
     " gateway volume secret backend instance project stats completion"
 )
 
@@ -606,7 +673,15 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("ps", help="list runs")
     s.add_argument("-a", "--all", action="store_true")
     s.add_argument("-w", "--watch", action="store_true", help="refresh continuously")
+    s.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="include per-run phase durations (queue/provision/pull/run)",
+    )
     s.set_defaults(func=cmd_ps)
+
+    s = sub.add_parser("events", help="print a run's lifecycle timeline")
+    s.add_argument("run_name")
+    s.set_defaults(func=cmd_events)
 
     s = sub.add_parser("stop", help="stop runs")
     s.add_argument("runs", nargs="+")

@@ -1,0 +1,234 @@
+"""Zero-dependency run lifecycle tracer: contextvar trace ids, timed spans,
+in-process fixed-bucket duration histograms, and named gauges.
+
+The control plane's question is "where did my run spend its time?". This module
+answers the in-process half: every scheduler pass, offer query, backend
+provisioning call, runner round trip, and proxied request runs under a
+``span(...)`` whose duration lands in a histogram that
+``server/services/prometheus.py`` renders as ``_bucket``/``_sum``/``_count``
+series. The persistent half (the ``run_events`` table) lives in
+``server/services/events.py``; it stamps each row with the current trace id so
+a slow span in the logs is joinable to the run timeline.
+
+Design constraints:
+- core must not import server code (the gateway appliance uses core too), so
+  the slow-span threshold is read straight from the environment
+  (``DSTACK_TPU_TRACE_SLOW_SECONDS``, default 5.0; 0 disables the warning).
+- observations may come from the DB worker thread (event writes happen inside
+  transactions), so the registries are guarded by a lock. The hot proxy path
+  only appends to an in-memory list under that lock — no DB, no syscalls.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Default buckets span the control plane's dynamic range: single-digit-ms proxy
+# forwards up to multi-minute cloud provisioning. Fixed (not per-family) so the
+# exposition stays stable and dashboards can be written once.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+_trace_id: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "dstack_tpu_trace_id", default=None
+)
+_span_id: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "dstack_tpu_span_id", default=None
+)
+
+
+def slow_span_threshold() -> float:
+    try:
+        return float(os.getenv("DSTACK_TPU_TRACE_SLOW_SECONDS", "5.0"))
+    except ValueError:
+        return 5.0
+
+
+def new_trace() -> str:
+    """Start a fresh trace (one scheduler work item, one API request); returns
+    the new trace id and binds it to the current context."""
+    tid = uuid.uuid4().hex[:16]
+    _trace_id.set(tid)
+    _span_id.set(None)
+    return tid
+
+
+def current_trace_id() -> Optional[str]:
+    return _trace_id.get()
+
+
+def current_span_id() -> Optional[str]:
+    return _span_id.get()
+
+
+class Histogram:
+    """Cumulative fixed-bucket histogram (Prometheus semantics), one counter
+    vector per label set."""
+
+    __slots__ = ("name", "buckets", "_series")
+
+    def __init__(self, name: str, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        # label-items tuple -> [bucket_counts..., +Inf count, sum]
+        self._series: Dict[Tuple[Tuple[str, str], ...], List[float]] = {}
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        row = self._series.get(key)
+        if row is None:
+            row = self._series[key] = [0.0] * (len(self.buckets) + 2)
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                row[i] += 1
+        row[-2] += 1  # +Inf / total count
+        row[-1] += value  # sum
+
+    def snapshot(self) -> List[Tuple[Dict[str, str], List[float], float, float]]:
+        """[(labels, cumulative_bucket_counts incl +Inf, sum, count)]."""
+        out = []
+        for key, row in sorted(self._series.items()):
+            out.append((dict(key), list(row[:-1]), row[-1], row[-2]))
+        return out
+
+
+_lock = threading.Lock()
+_histograms: Dict[str, Histogram] = {}
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+
+
+def observe(name: str, seconds: float, labels: Optional[Dict[str, str]] = None) -> None:
+    """Record one duration into the named histogram (thread-safe)."""
+    with _lock:
+        hist = _histograms.get(name)
+        if hist is None:
+            hist = _histograms[name] = Histogram(name)
+        hist.observe(seconds, labels)
+
+
+def histogram_snapshot(name: str):
+    """Snapshot of one histogram family, or None if never observed."""
+    with _lock:
+        hist = _histograms.get(name)
+        return None if hist is None else (hist.buckets, hist.snapshot())
+
+
+def histogram_names() -> List[str]:
+    with _lock:
+        return sorted(_histograms)
+
+
+def drop_series(name: str, labels: Dict[str, str]) -> None:
+    """Remove one histogram series (exact label match). Per-run series (e.g.
+    proxied latency labeled by run name) must go when the run goes, or
+    /metrics grows one dead series per run ever observed."""
+    with _lock:
+        hist = _histograms.get(name)
+        if hist is not None:
+            hist._series.pop(tuple(sorted(labels.items())), None)
+
+
+def set_gauge(name: str, labels: Optional[Dict[str, str]], value: float) -> None:
+    with _lock:
+        _gauges[(name, tuple(sorted((labels or {}).items())))] = value
+
+
+def gauge_snapshot(name: str) -> List[Tuple[Dict[str, str], float]]:
+    with _lock:
+        return [
+            (dict(key[1]), v) for key, v in sorted(_gauges.items()) if key[0] == name
+        ]
+
+
+def summary(name: str, labels: Optional[Dict[str, str]] = None) -> Optional[dict]:
+    """{count, mean, p50, p90, max_bucket} estimated from the histogram —
+    bench.py records these so BENCH_* files carry distributions, not means."""
+    snap = histogram_snapshot(name)
+    if snap is None:
+        return None
+    buckets, series = snap
+    want = tuple(sorted((labels or {}).items()))
+    rows = [r for r in series if tuple(sorted(r[0].items())) == want or labels is None]
+    if not rows:
+        return None
+    # Merge matching series (labels=None merges all of them).
+    counts = [0.0] * (len(buckets) + 1)
+    total_sum = 0.0
+    total_count = 0.0
+    for _, cum, s, c in rows:
+        for i, v in enumerate(cum):
+            counts[i] += v
+        total_sum += s
+        total_count += c
+    if total_count == 0:
+        return None
+
+    def quantile(q: float) -> float:
+        target = q * total_count
+        for i, le in enumerate(buckets):
+            if counts[i] >= target:
+                return le
+        return float("inf")
+
+    return {
+        "count": int(total_count),
+        "mean": total_sum / total_count,
+        "p50": quantile(0.5),
+        "p90": quantile(0.9),
+    }
+
+
+def reset() -> None:
+    """Drop all registered histograms and gauges (tests/bench isolation)."""
+    with _lock:
+        _histograms.clear()
+        _gauges.clear()
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    histogram: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    **attrs,
+) -> Iterator[None]:
+    """Timed span: propagates trace/span ids through the context, feeds the
+    duration into ``histogram`` (when given), and WARNs when the span exceeds
+    DSTACK_TPU_TRACE_SLOW_SECONDS. ``attrs`` (e.g. ``run="name"``) only appear
+    in the slow-span log line — they never become metric labels, so arbitrary
+    run names can't explode exposition cardinality.
+
+    Works around both sync and async code: the context manager holds no lock
+    across the body, and the ids restore on exit even when the body raises."""
+    if _trace_id.get() is None:
+        new_trace()
+    parent = _span_id.get()
+    sid = uuid.uuid4().hex[:8]
+    token = _span_id.set(sid)
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        elapsed = time.monotonic() - t0
+        _span_id.reset(token)
+        if histogram is not None:
+            observe(histogram, elapsed, labels)
+        threshold = slow_span_threshold()
+        if threshold > 0 and elapsed >= threshold:
+            extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+            logger.warning(
+                "slow span %s: %.2fs (trace=%s span=%s parent=%s)%s",
+                name, elapsed, _trace_id.get(), sid, parent or "-",
+                f" {extra}" if extra else "",
+            )

@@ -21,8 +21,24 @@ class BackgroundScheduler:
     def add_periodic(
         self, fn: Callable[[], Awaitable[None]], interval: float, name: str
     ) -> None:
+        from dstack_tpu.core import tracing
+
         async def loop() -> None:
+            import time
+
+            expected = None  # when the NEXT pass should start (fixed-rate anchor)
             while True:
+                now = time.monotonic()
+                # Loop lag: how far behind schedule this pass starts. The
+                # anchor is set BEFORE the pass runs, so a pass that overruns
+                # its interval shows up as lag on the next pass (an anchor
+                # taken after fn() would hide exactly the overload this gauge
+                # exists to catch).
+                lag = max(0.0, now - expected) if expected is not None else 0.0
+                tracing.set_gauge(
+                    "dstack_tpu_background_loop_lag_seconds", {"task": name}, lag
+                )
+                expected = now + interval
                 try:
                     await fn()
                 except asyncio.CancelledError:

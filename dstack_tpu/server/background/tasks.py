@@ -25,6 +25,7 @@ queries are served from a TTL cache (services/offers).
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
 from typing import Awaitable, Dict, Iterable, List, Optional, Tuple
@@ -48,9 +49,11 @@ from dstack_tpu.core.models.runs import (
     RunStatus,
     RunTerminationReason,
 )
+from dstack_tpu.core import tracing
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, in_clause, loads, new_id
 from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import events as events_service
 from dstack_tpu.server.services import fleets as fleets_service
 from dstack_tpu.server.services import instances as instances_service
 from dstack_tpu.server.services import logs as logs_service
@@ -81,6 +84,25 @@ _REASON_TO_RETRY_EVENT = {
     JobTerminationReason.CREATING_CONTAINER_ERROR: RetryEvent.ERROR,
     JobTerminationReason.PORTS_BINDING_FAILED: RetryEvent.ERROR,
 }
+
+
+def _traced_pass(fn):
+    """Run a scheduler pass under a timed span: the pass duration lands in the
+    ``dstack_tpu_scheduler_pass_duration_seconds{pass=...}`` histogram and a
+    pass that overruns DSTACK_TPU_TRACE_SLOW_SECONDS WARNs with its trace id."""
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    async def wrapper(*args, **kwargs):
+        tracing.new_trace()
+        with tracing.span(
+            f"scheduler.{name}",
+            histogram="dstack_tpu_scheduler_pass_duration_seconds",
+            labels={"pass": name},
+        ):
+            return await fn(*args, **kwargs)
+
+    return wrapper
 
 
 async def _fan_out(coros: Iterable[Awaitable]) -> None:
@@ -118,6 +140,7 @@ async def _fan_out(coros: Iterable[Awaitable]) -> None:
 # process_submitted_jobs
 
 
+@_traced_pass
 async def process_submitted_jobs(db: Database, batch: Optional[int] = None) -> None:
     batch = batch or settings.PROCESS_BATCH_SIZE
     # Order by last processing attempt, not submission time: jobs parked in `submitted`
@@ -138,9 +161,12 @@ async def process_submitted_jobs(db: Database, batch: Optional[int] = None) -> N
     async def _one(run_id: str, replica_num: int, submission_num: int) -> None:
         # Keyed lock + fresh gang re-fetch inside _place_replica: an overlapping
         # pass (or a sibling replica task of the same run) placing the same gang
-        # first turns this item into a no-op.
+        # first turns this item into a no-op. Each work item gets its own trace
+        # so the run_events it writes are joinable to its log lines.
+        tracing.new_trace()
         async with get_locker().lock(f"run:{run_id}"):
-            await _place_replica(db, run_id, replica_num, submission_num)
+            with tracing.span("scheduler.place_replica", run=run_id, replica=replica_num):
+                await _place_replica(db, run_id, replica_num, submission_num)
 
     await _fan_out(_one(*key) for key in list(groups)[:batch])
 
@@ -271,6 +297,14 @@ def _assign_job_tx(conn, job_row, instance_id: str, jpd_dict: dict) -> None:
         " job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
         (instance_id, json.dumps(jpd_dict), to_iso(now_utc()), job_row["id"]),
     )
+    events_service.record_event_tx(
+        conn,
+        job_row["run_id"],
+        "provisioning",
+        old_status=job_row["status"],
+        job_id=job_row["id"],
+        actor="scheduler",
+    )
 
 
 async def _slices_with_volumes(db: Database, slices: List[List], volumes: List) -> List[List]:
@@ -331,9 +365,15 @@ async def _provision_slice(
         # Authorized keys: the user's run key plus the server's tunnel identity.
         keys = [k for k in (run_spec.ssh_key_pub, _server_public_key()) if k]
         try:
-            jpds = await compute.create_slice(
-                offer, name, ssh_public_key="\n".join(keys), volumes=volumes or None
-            )
+            with tracing.span(
+                "backend.create_slice",
+                histogram="dstack_tpu_backend_create_slice_seconds",
+                labels={"backend": offer.backend},
+                run=run_row["run_name"],
+            ):
+                jpds = await compute.create_slice(
+                    offer, name, ssh_public_key="\n".join(keys), volumes=volumes or None
+                )
         except NoCapacityError as e:
             logger.debug("offer %s/%s no capacity: %s", offer.backend, offer.instance.name, e)
             continue
@@ -432,6 +472,7 @@ async def _handle_no_capacity(db: Database, run_row, job_rows: List, profile: Pr
 # process_running_jobs
 
 
+@_traced_pass
 async def process_running_jobs(db: Database, batch: Optional[int] = None) -> None:
     batch = batch or settings.PROCESS_BATCH_SIZE
     rows = await db.fetchall(
@@ -444,6 +485,7 @@ async def process_running_jobs(db: Database, batch: Optional[int] = None) -> Non
         by_run.setdefault(row["run_id"], []).append(row)
 
     async def _one_run(run_id: str, run_rows: List) -> None:
+        tracing.new_trace()
         async with get_locker().lock(f"run:{run_id}"):
             # One grouped re-fetch under the lock replaces the per-job SELECT;
             # the run row (immutable run_spec) is shared by the whole gang.
@@ -469,7 +511,10 @@ async def process_running_jobs(db: Database, batch: Optional[int] = None) -> Non
                 try:
                     await _process_active_job(db, fresh, run_row)
                 except Exception:
-                    logger.exception("job %s processing failed", row["id"])
+                    logger.exception(
+                        "run %s: job %s processing failed (trace=%s)",
+                        row["run_name"], row["id"], tracing.current_trace_id(),
+                    )
                     await touch_jobs(db, [row])
                 processed = True
 
@@ -542,7 +587,13 @@ async def _process_provisioning(db: Database, job_row, run_row=None) -> None:
         return
 
     client = get_runner_client(jpd, jrd)
-    health = await client.healthcheck()
+    with tracing.span(
+        "runner.healthcheck",
+        histogram="dstack_tpu_runner_call_seconds",
+        labels={"op": "healthcheck"},
+        run=job_row["run_name"],
+    ):
+        health = await client.healthcheck()
     if health is None:
         await _check_provisioning_deadline(db, job_row)
         return
@@ -584,22 +635,41 @@ async def _process_provisioning(db: Database, job_row, run_row=None) -> None:
             m.device = data.get("device_name")
             m.host_dir = data.get("host_dir")
         jrd.volume_names = [m.name for m in spec.volumes]
-    await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
-    code = await _get_code(db, job_row["project_id"], run_spec)
-    if code:
-        await client.upload_code(code)
-    await client.run_job()
+    with tracing.span(
+        "runner.submit",
+        histogram="dstack_tpu_runner_call_seconds",
+        labels={"op": "submit"},
+        run=job_row["run_name"],
+    ):
+        await client.submit(spec, info, run_spec=loads(run_row["run_spec"]), secrets=secrets)
+        code = await _get_code(db, job_row["project_id"], run_spec)
+        if code:
+            await client.upload_code(code)
+        await client.run_job()
 
     if job_row["instance_id"]:
         await db.execute(
             "UPDATE instances SET status = 'busy' WHERE id = ? AND status = 'provisioning'",
             (job_row["instance_id"],),
         )
-    await db.execute(
-        "UPDATE jobs SET status = 'pulling', job_runtime_data = ?, last_processed_at = ?"
-        " WHERE id = ?",
-        (jrd.model_dump_json(), to_iso(now_utc()), job_row["id"]),
-    )
+    jrd_json = jrd.model_dump_json()
+
+    def _to_pulling(conn) -> None:
+        conn.execute(
+            "UPDATE jobs SET status = 'pulling', job_runtime_data = ?, last_processed_at = ?"
+            " WHERE id = ?",
+            (jrd_json, to_iso(now_utc()), job_row["id"]),
+        )
+        events_service.record_event_tx(
+            conn,
+            job_row["run_id"],
+            "pulling",
+            old_status=job_row["status"],
+            job_id=job_row["id"],
+            actor="scheduler",
+        )
+
+    await db.run(_to_pulling)
 
 
 async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> None:
@@ -608,7 +678,13 @@ async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> No
     spec = load_job_spec(job_row)
     client = get_runner_client(jpd, jrd)
     try:
-        result = await client.pull(offset=jrd.pull_offset)
+        with tracing.span(
+            "runner.pull",
+            histogram="dstack_tpu_runner_call_seconds",
+            labels={"op": "pull"},
+            run=job_row["run_name"],
+        ):
+            result = await client.pull(offset=jrd.pull_offset)
     except Exception:
         await _handle_runner_disconnect(db, job_row)
         return
@@ -680,13 +756,23 @@ async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> No
 
     now = to_iso(now_utc())
     if new_status == JobStatus.TERMINATING:
-        await db.execute(
-            "UPDATE jobs SET status = 'terminating', termination_reason = ?,"
-            " termination_reason_message = ?, exit_status = ?, job_runtime_data = ?,"
-            " last_processed_at = ? WHERE id = ?",
-            (reason.value if reason else None, reason_msg, exit_status,
-             jrd.model_dump_json(), now, job_row["id"]),
-        )
+
+        def _to_terminating(conn) -> None:
+            conn.execute(
+                "UPDATE jobs SET status = 'terminating', termination_reason = ?,"
+                " termination_reason_message = ?, exit_status = ?, job_runtime_data = ?,"
+                " last_processed_at = ? WHERE id = ?",
+                (reason.value if reason else None, reason_msg, exit_status,
+                 jrd.model_dump_json(), now, job_row["id"]),
+            )
+            events_service.record_event_tx(
+                conn, job_row["run_id"], "terminating",
+                old_status=job_row["status"], job_id=job_row["id"],
+                actor="runner", reason=reason.value if reason else None,
+                message=reason_msg,
+            )
+
+        await db.run(_to_terminating)
         proxy_service.route_table.invalidate_run(job_row["run_id"])
         return
     status_val = (
@@ -694,10 +780,19 @@ async def _process_pulling_or_running(db: Database, job_row, run_row=None) -> No
         if new_status is not None
         else ("running" if job_row["status"] == "running" else job_row["status"])
     )
-    await db.execute(
-        "UPDATE jobs SET status = ?, job_runtime_data = ?, last_processed_at = ? WHERE id = ?",
-        (status_val, jrd.model_dump_json(), now, job_row["id"]),
-    )
+
+    def _update_status(conn) -> None:
+        conn.execute(
+            "UPDATE jobs SET status = ?, job_runtime_data = ?, last_processed_at = ? WHERE id = ?",
+            (status_val, jrd.model_dump_json(), now, job_row["id"]),
+        )
+        if status_val != job_row["status"]:
+            events_service.record_event_tx(
+                conn, job_row["run_id"], status_val,
+                old_status=job_row["status"], job_id=job_row["id"], actor="runner",
+            )
+
+    await db.run(_update_status)
     if status_val != job_row["status"]:
         # The run's replica set changed (e.g. a replica just turned RUNNING
         # with its ports_mapping): refresh the proxy's cached route.
@@ -851,6 +946,7 @@ async def _get_code(db: Database, project_id: str, run_spec: RunSpec) -> Optiona
 # process_terminating_jobs
 
 
+@_traced_pass
 async def process_terminating_jobs(db: Database, batch: Optional[int] = None) -> None:
     batch = batch or settings.PROCESS_BATCH_SIZE
     rows = await db.fetchall(
@@ -862,6 +958,7 @@ async def process_terminating_jobs(db: Database, batch: Optional[int] = None) ->
         by_run.setdefault(row["run_id"], []).append(row)
 
     async def _one_run(run_id: str, run_rows: List) -> None:
+        tracing.new_trace()
         async with get_locker().lock(f"run:{run_id}"):
             # Grouped re-fetch is safe for the whole gang here: terminating one
             # job never rewrites its siblings' rows.
@@ -874,7 +971,10 @@ async def process_terminating_jobs(db: Database, batch: Optional[int] = None) ->
                 try:
                     await _process_terminating_job(db, fresh)
                 except Exception:
-                    logger.exception("terminating job %s failed", fresh["id"])
+                    logger.exception(
+                        "run %s: terminating job %s failed (trace=%s)",
+                        fresh["run_name"], fresh["id"], tracing.current_trace_id(),
+                    )
 
     await _fan_out(_one_run(rid, rr) for rid, rr in by_run.items())
 
@@ -908,6 +1008,7 @@ async def _process_terminating_job(db: Database, job_row) -> None:
 # process_runs
 
 
+@_traced_pass
 async def process_runs(db: Database, batch: Optional[int] = None) -> None:
     batch = batch or settings.PROCESS_BATCH_SIZE * 2
     rows = await db.fetchall(
@@ -917,6 +1018,7 @@ async def process_runs(db: Database, batch: Optional[int] = None) -> None:
     )
 
     async def _one(row) -> None:
+        tracing.new_trace()
         async with get_locker().lock(f"run:{row['id']}"):
             fresh = await db.fetchone("SELECT * FROM runs WHERE id = ?", (row["id"],))
             if fresh is None or RunStatus(fresh["status"]).is_finished():
@@ -927,7 +1029,10 @@ async def process_runs(db: Database, batch: Optional[int] = None) -> None:
                 else:
                     await _process_active_run(db, fresh)
             except Exception:
-                logger.exception("run %s processing failed", row["id"])
+                logger.exception(
+                    "run %s (%s) processing failed (trace=%s)",
+                    row["run_name"], row["id"], tracing.current_trace_id(),
+                )
             await db.execute(
                 "UPDATE runs SET last_processed_at = ? WHERE id = ?",
                 (to_iso(now_utc()), row["id"]),
@@ -959,10 +1064,18 @@ async def _process_terminating_run(db: Database, run_row) -> None:
         if r["status"] != "terminating":
             await terminate_job(db, r, reason.to_job_termination_reason())
     if not active:
-        await db.execute(
-            "UPDATE runs SET status = ? WHERE id = ?",
-            (reason.to_status().value, run_row["id"]),
-        )
+        final = reason.to_status().value
+
+        def _finalize(conn) -> None:
+            conn.execute(
+                "UPDATE runs SET status = ? WHERE id = ?", (final, run_row["id"])
+            )
+            events_service.record_event_tx(
+                conn, run_row["id"], final,
+                old_status=run_row["status"], actor="scheduler", reason=reason.value,
+            )
+
+        await db.run(_finalize)
 
 
 async def _process_active_run(db: Database, run_row) -> None:
@@ -1055,9 +1168,18 @@ async def _process_active_run(db: Database, run_row) -> None:
     elif any(s in (JobStatus.PROVISIONING, JobStatus.PULLING) for s in statuses):
         new_status = RunStatus.PROVISIONING
     if new_status != RunStatus(run_row["status"]):
-        await db.execute(
-            "UPDATE runs SET status = ? WHERE id = ?", (new_status.value, run_row["id"])
-        )
+
+        def _run_status(conn) -> None:
+            conn.execute(
+                "UPDATE runs SET status = ? WHERE id = ?",
+                (new_status.value, run_row["id"]),
+            )
+            events_service.record_event_tx(
+                conn, run_row["id"], new_status.value,
+                old_status=run_row["status"], actor="scheduler",
+            )
+
+        await db.run(_run_status)
 
 
 def _retry_delay(submission_num: int) -> float:
@@ -1107,27 +1229,38 @@ async def _maybe_retry_replica(
         return True  # backoff window
 
     now = to_iso(now_utc())
-    # One executemany = one transaction: the resubmitted gang appears whole or not at
-    # all (a partial gang would deadlock the slice-atomic placement forever).
-    await db.executemany(
-        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
-        " submission_num, job_spec, status, submitted_at)"
-        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
-        [
-            (
-                new_id(),
-                r["project_id"],
-                r["run_id"],
-                r["run_name"],
-                r["job_num"],
-                r["replica_num"],
-                submission_num + 1,
-                r["job_spec"],
-                now,
+    # One transaction: the resubmitted gang (and its lifecycle events) appears
+    # whole or not at all (a partial gang would deadlock the slice-atomic
+    # placement forever).
+    gang = [
+        (
+            new_id(),
+            r["project_id"],
+            r["run_id"],
+            r["run_name"],
+            r["job_num"],
+            r["replica_num"],
+            submission_num + 1,
+            r["job_spec"],
+            now,
+        )
+        for r in replica_rows
+    ]
+
+    def _resubmit(conn) -> None:
+        conn.executemany(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+            " submission_num, job_spec, status, submitted_at)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
+            gang,
+        )
+        for g in gang:
+            events_service.record_event_tx(
+                conn, g[2], "submitted", job_id=g[0],
+                actor="scheduler", reason="gang_retry",
             )
-            for r in replica_rows
-        ],
-    )
+
+    await db.run(_resubmit)
     logger.info(
         "run %s: retrying replica %s (submission %s)",
         run_row["run_name"], replica_rows[0]["replica_num"], submission_num + 1,
@@ -1136,10 +1269,17 @@ async def _maybe_retry_replica(
 
 
 async def _terminate_run(db: Database, run_row, reason: RunTerminationReason) -> None:
-    await db.execute(
-        "UPDATE runs SET status = 'terminating', termination_reason = ? WHERE id = ?",
-        (reason.value, run_row["id"]),
-    )
+    def _tx(conn) -> None:
+        conn.execute(
+            "UPDATE runs SET status = 'terminating', termination_reason = ? WHERE id = ?",
+            (reason.value, run_row["id"]),
+        )
+        events_service.record_event_tx(
+            conn, run_row["id"], "terminating",
+            old_status=run_row["status"], actor="scheduler", reason=reason.value,
+        )
+
+    await db.run(_tx)
     run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_row["id"],))
     await _process_terminating_run(db, run_row)
 
@@ -1159,7 +1299,10 @@ async def process_instances(db: Database, batch: Optional[int] = None) -> None:
         try:
             await _process_instance(db, row)
         except Exception:
-            logger.exception("instance %s processing failed", row["id"])
+            logger.exception(
+                "instance %s (%s) processing failed (trace=%s)",
+                row["name"], row["id"], tracing.current_trace_id(),
+            )
         await db.execute(
             "UPDATE instances SET last_processed_at = ? WHERE id = ?",
             (to_iso(now_utc()), row["id"]),

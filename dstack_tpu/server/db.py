@@ -31,6 +31,7 @@ through transactions + advisory locks).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import functools
 import json
 import re
@@ -323,9 +324,11 @@ class Database:
             item = self._queue.get()
             if item is None:
                 break
-            fn, fut, fut_loop = item
+            fn, fut, fut_loop, ctx = item
             try:
-                result = fn(conn)
+                # Run under the submitter's contextvars so closures see the
+                # caller's tracing context (trace ids in run_events rows).
+                result = ctx.run(fn, conn)
                 conn.commit()
             except Exception as e:
                 conn.rollback()
@@ -338,7 +341,7 @@ class Database:
         """Run `fn(conn)` on the DB thread inside a transaction; return its result."""
         loop = asyncio.get_running_loop()
         fut: "asyncio.Future" = loop.create_future()
-        self._queue.put((fn, fut, loop))
+        self._queue.put((fn, fut, loop, contextvars.copy_context()))
         return await fut
 
     async def execute(self, sql: str, params: Iterable = ()) -> int:

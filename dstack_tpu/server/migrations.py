@@ -223,6 +223,26 @@ MIGRATIONS: List[Tuple[int, str]] = [
         );
         """,
     ),
+    (
+        3,
+        """
+        CREATE TABLE run_events (
+            id TEXT PRIMARY KEY,
+            run_id TEXT NOT NULL,
+            job_id TEXT,
+            timestamp TEXT NOT NULL,
+            actor TEXT NOT NULL,
+            old_status TEXT,
+            new_status TEXT NOT NULL,
+            reason TEXT,
+            message TEXT,
+            trace_id TEXT,
+            seq INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE INDEX ix_run_events_run ON run_events(run_id, seq);
+        CREATE INDEX ix_run_events_job ON run_events(job_id);
+        """,
+    ),
 ]
 
 

@@ -131,6 +131,36 @@ async def get_run(request: web.Request) -> web.Response:
     return model_response(run)
 
 
+@routes.post("/api/project/{project_name}/runs/get_events")
+async def get_run_events(request: web.Request) -> web.Response:
+    """The run's lifecycle timeline (every status transition with timestamp,
+    actor, reason, trace id) plus derived phase durations — the API behind
+    `dstack-tpu events <run>`."""
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    db = request.app["db"]
+    from dstack_tpu.core.errors import ResourceNotExistsError
+    from dstack_tpu.server.services import events as events_service
+
+    run_name = body.get("run_name")
+    row = await db.fetchone(
+        "SELECT id, run_name, status FROM runs WHERE project_id = ? AND run_name = ?"
+        " AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"run {run_name} not found")
+    events = await events_service.list_run_events(db, row["id"])
+    return web.json_response(
+        {
+            "run_name": row["run_name"],
+            "status": row["status"],
+            "events": events,
+            "phases": events_service.compute_phases(events),
+        }
+    )
+
+
 @routes.post("/api/project/{project_name}/runs/stop")
 async def stop_runs(request: web.Request) -> web.Response:
     _, project_row = await auth_project(request)

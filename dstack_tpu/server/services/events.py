@@ -1,0 +1,164 @@
+"""Run lifecycle events: the persistent half of the tracing layer.
+
+Every run/job status transition (``services/runs.py``, ``services/jobs``,
+``background/tasks.py``) appends one ``run_events`` row — timestamp, actor,
+old→new status, reason, and the scheduler's current trace id — so "where did
+my run spend its time?" is answerable after the fact, not just while a
+debugger is attached. Derived phase durations (queue wait, provision, pull,
+time-to-running) are computed from the timeline here, and the job-level phase
+transitions feed the in-process Prometheus histograms
+(``dstack_tpu_run_queue_wait_seconds`` / ``..._provision_duration_seconds``)
+at write time, so ``/metrics`` carries distributions without re-reading the
+table on scrape.
+
+The single writer is ``record_event_tx``, called inside a ``db.run(...)``
+transaction closure so the event commits atomically with the transition it
+describes — a crash can't record a move that didn't land, or vice versa.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dstack_tpu.core import tracing
+from dstack_tpu.server.db import Database, new_id
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+# Histogram family fed when a job LEAVES the keyed status; the observed value
+# is the time spent in that status (from the previous event for the same job,
+# falling back to the job's submitted_at for the first transition).
+_PHASE_HISTOGRAMS = {
+    "submitted": "dstack_tpu_run_queue_wait_seconds",
+    "provisioning": "dstack_tpu_run_provision_duration_seconds",
+    "pulling": "dstack_tpu_run_pull_duration_seconds",
+}
+
+# Human-facing phase names derived from a job timeline (CLI + get_events API).
+PHASES = ("queue", "provision", "pull", "run")
+
+
+def record_event_tx(
+    conn,
+    run_id: str,
+    new_status: str,
+    old_status: Optional[str] = None,
+    job_id: Optional[str] = None,
+    actor: str = "server",
+    reason: Optional[str] = None,
+    message: Optional[str] = None,
+) -> None:
+    """Append one event inside an open transaction (sqlite3 connection or the
+    postgres adapter — both expose .execute with qmark SQL)."""
+    now = now_utc()
+    if job_id is not None and old_status in _PHASE_HISTOGRAMS:
+        prev = conn.execute(
+            "SELECT timestamp FROM run_events WHERE job_id = ?"
+            " ORDER BY seq DESC LIMIT 1",
+            (job_id,),
+        ).fetchone()
+        anchor = prev["timestamp"] if prev is not None else None
+        if anchor is None:
+            row = conn.execute(
+                "SELECT submitted_at FROM jobs WHERE id = ?", (job_id,)
+            ).fetchone()
+            anchor = row["submitted_at"] if row is not None else None
+        if anchor:
+            elapsed = (now - from_iso(anchor)).total_seconds()
+            if elapsed >= 0:
+                tracing.observe(_PHASE_HISTOGRAMS[old_status], elapsed)
+    # seq orders the timeline deterministically when ISO timestamps collide
+    # (several events in one transaction). Per-run MAX+1 inside the same
+    # transaction — unlike an in-process counter it survives server restarts,
+    # so a run spanning a restart still reads back in order.
+    seq_row = conn.execute(
+        "SELECT COALESCE(MAX(seq), 0) + 1 AS s FROM run_events WHERE run_id = ?",
+        (run_id,),
+    ).fetchone()
+    conn.execute(
+        "INSERT INTO run_events (id, run_id, job_id, timestamp, actor, old_status,"
+        " new_status, reason, message, trace_id, seq)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            new_id(),
+            run_id,
+            job_id,
+            to_iso(now),
+            actor,
+            old_status,
+            new_status,
+            reason,
+            message,
+            tracing.current_trace_id(),
+            seq_row["s"],
+        ),
+    )
+
+
+async def list_run_events(db: Database, run_id: str) -> List[dict]:
+    """The run's full timeline, oldest first."""
+    rows = await db.fetchall(
+        "SELECT * FROM run_events WHERE run_id = ? ORDER BY seq", (run_id,)
+    )
+    return [
+        {
+            "timestamp": r["timestamp"],
+            "actor": r["actor"],
+            "job_id": r["job_id"],
+            "old_status": r["old_status"],
+            "new_status": r["new_status"],
+            "reason": r["reason"],
+            "message": r["message"],
+            "trace_id": r["trace_id"],
+        }
+        for r in rows
+    ]
+
+
+def compute_phases(events: List[dict]) -> Dict[str, Optional[float]]:
+    """Derived per-phase durations (seconds) from a run's timeline.
+
+    queue      = first submitted -> first job leaving 'submitted'
+    provision  = first provisioning -> first job leaving 'provisioning'
+    pull       = first pulling -> first job reaching 'running'
+    run        = first 'running' -> the run's terminal event
+    total      = first event -> last event (None while the run is live)
+
+    Phases a run never entered (e.g. pull for a failed placement) are None.
+    Derivations use the FIRST job to cross each edge: a gang's phases are the
+    critical path of its slowest predecessor edge, and the first crossing is
+    when the run as a whole left the phase."""
+
+    def ts(ev) -> float:
+        return from_iso(ev["timestamp"]).timestamp()
+
+    def first(pred) -> Optional[dict]:
+        for ev in events:
+            if pred(ev):
+                return ev
+        return None
+
+    out: Dict[str, Optional[float]] = {p: None for p in PHASES}
+    out["total"] = None
+    if not events:
+        return out
+    start = first(lambda e: e["new_status"] == "submitted") or events[0]
+    left_queue = first(lambda e: e["job_id"] and e["old_status"] == "submitted")
+    if left_queue is not None:
+        out["queue"] = max(0.0, ts(left_queue) - ts(start))
+    entered_prov = first(lambda e: e["job_id"] and e["new_status"] == "provisioning")
+    left_prov = first(lambda e: e["job_id"] and e["old_status"] == "provisioning")
+    if entered_prov is not None and left_prov is not None:
+        out["provision"] = max(0.0, ts(left_prov) - ts(entered_prov))
+    entered_pull = first(lambda e: e["job_id"] and e["new_status"] == "pulling")
+    running = first(lambda e: e["new_status"] == "running")
+    if entered_pull is not None and running is not None:
+        out["pull"] = max(0.0, ts(running) - ts(entered_pull))
+    terminal = first(
+        lambda e: not e["job_id"]
+        and e["new_status"] in ("terminated", "failed", "done")
+    )
+    if running is not None and terminal is not None:
+        out["run"] = max(0.0, ts(terminal) - ts(running))
+    if terminal is not None:
+        out["total"] = max(0.0, ts(terminal) - ts(start))
+    return out

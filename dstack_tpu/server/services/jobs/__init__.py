@@ -46,34 +46,56 @@ async def set_job_status(
     reason: Optional[JobTerminationReason] = None,
     reason_message: Optional[str] = None,
     exit_status: Optional[int] = None,
+    actor: str = "server",
 ) -> None:
+    from dstack_tpu.server.services import events as events_service
+
     now = to_iso(now_utc())
     finished = now if status.is_finished() else None
-    await db.execute(
-        "UPDATE jobs SET status = ?,"
-        " termination_reason = COALESCE(?, termination_reason),"
-        " termination_reason_message = COALESCE(?, termination_reason_message),"
-        " exit_status = COALESCE(?, exit_status),"
-        " last_processed_at = ?, finished_at = COALESCE(finished_at, ?)"
-        " WHERE id = ?",
-        (
-            status.value,
-            reason.value if reason else None,
-            reason_message,
-            exit_status,
-            now,
-            finished,
-            job_row["id"],
-        ),
-    )
-    # Every job transition drops the run's cached proxy route (no-op for runs
-    # never proxied). Import is deferred: proxy imports this module.
-    from dstack_tpu.server.services import proxy as proxy_service
-
     try:
         run_id = job_row["run_id"]
     except (KeyError, IndexError):
         run_id = None
+    old_status = job_row["status"]
+
+    def _tx(conn) -> None:
+        conn.execute(
+            "UPDATE jobs SET status = ?,"
+            " termination_reason = COALESCE(?, termination_reason),"
+            " termination_reason_message = COALESCE(?, termination_reason_message),"
+            " exit_status = COALESCE(?, exit_status),"
+            " last_processed_at = ?, finished_at = COALESCE(finished_at, ?)"
+            " WHERE id = ?",
+            (
+                status.value,
+                reason.value if reason else None,
+                reason_message,
+                exit_status,
+                now,
+                finished,
+                job_row["id"],
+            ),
+        )
+        # The lifecycle event commits atomically with the transition it
+        # describes: a crash can't record a move that didn't land (or vice
+        # versa). Same-status touches are not transitions and stay silent.
+        if run_id and old_status != status.value:
+            events_service.record_event_tx(
+                conn,
+                run_id,
+                status.value,
+                old_status=old_status,
+                job_id=job_row["id"],
+                actor=actor,
+                reason=reason.value if reason else None,
+                message=reason_message,
+            )
+
+    await db.run(_tx)
+    # Every job transition drops the run's cached proxy route (no-op for runs
+    # never proxied). Import is deferred: proxy imports this module.
+    from dstack_tpu.server.services import proxy as proxy_service
+
     if run_id:
         proxy_service.route_table.invalidate_run(run_id)
 
@@ -95,11 +117,14 @@ async def terminate_job(
     job_row,
     reason: JobTerminationReason,
     reason_message: Optional[str] = None,
+    actor: str = "server",
 ) -> None:
     """Move an active job into TERMINATING; process_terminating_jobs finishes it."""
     if JobStatus(job_row["status"]).is_finished():
         return
-    await set_job_status(db, job_row, JobStatus.TERMINATING, reason, reason_message)
+    await set_job_status(
+        db, job_row, JobStatus.TERMINATING, reason, reason_message, actor=actor
+    )
 
 
 def build_cluster_info(

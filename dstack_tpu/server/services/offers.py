@@ -111,6 +111,22 @@ async def _query_offers(
     requirements: Requirements,
     profile: Profile,
 ) -> List[InstanceOffer]:
+    from dstack_tpu.core import tracing
+
+    with tracing.span(
+        "offers.query",
+        histogram="dstack_tpu_offer_query_seconds",
+        project=project_row["name"],
+    ):
+        return await _query_offers_inner(db, project_row, requirements, profile)
+
+
+async def _query_offers_inner(
+    db: Database,
+    project_row,
+    requirements: Requirements,
+    profile: Profile,
+) -> List[InstanceOffer]:
     computes = await backends_service.get_project_computes(db, project_row)
     if profile.backends:
         computes = [(t, c) for t, c in computes if t in profile.backends]

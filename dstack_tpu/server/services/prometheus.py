@@ -5,6 +5,14 @@ and per-job gauges rendered for scraping). Rendered by hand — the exposition
 format is a dozen lines of text; no client library needed. TPU re-design: the
 per-job hardware gauges are TPU duty-cycle / HBM (from the agents' runtime
 scrape) instead of per-GPU DCGM series.
+
+Beyond the gauges, the tracing layer's fixed-bucket histograms
+(core/tracing.py — run phase durations, scheduler pass durations, runner/SSH
+round trips, proxied request latency) render as real ``_bucket``/``_sum``/
+``_count`` families, and each background loop exports its scheduling lag.
+A strict exposition-parser test (tests/test_run_events.py) validates every
+family emitted here, since hand-rendering is exactly where format drift creeps
+in.
 """
 
 from __future__ import annotations
@@ -12,6 +20,7 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Tuple
 
+from dstack_tpu.core import tracing
 from dstack_tpu.server.db import Database
 
 
@@ -19,14 +28,50 @@ def _esc(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _esc_help(v: str) -> str:
+    # HELP text escapes only backslash and newline (labels also escape quotes).
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _sample(name: str, labels: Dict[str, str], value: float) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}} {value:g}"
+    return f"{name} {value:g}"
+
+
 def _fmt(name: str, help_: str, type_: str, samples: List[Tuple[Dict[str, str], float]]) -> str:
-    lines = [f"# HELP {name} {help_}", f"# TYPE {name} {type_}"]
+    lines = [f"# HELP {name} {_esc_help(help_)}", f"# TYPE {name} {type_}"]
     for labels, value in samples:
-        if labels:
-            inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
-            lines.append(f"{name}{{{inner}}} {value:g}")
-        else:
-            lines.append(f"{name} {value:g}")
+        lines.append(_sample(name, labels, value))
+    return "\n".join(lines)
+
+
+# Histogram families always advertised (HELP/TYPE) even before the first
+# observation, so scrapers and dashboards can discover them from a cold server.
+_HISTOGRAM_HELP = {
+    "dstack_tpu_run_queue_wait_seconds": "Time jobs spent queued (submitted -> placement)",
+    "dstack_tpu_run_provision_duration_seconds": "Time jobs spent provisioning (placement -> runner submit)",
+    "dstack_tpu_run_pull_duration_seconds": "Time jobs spent pulling (runner submit -> running)",
+    "dstack_tpu_scheduler_pass_duration_seconds": "Scheduler background pass wall time",
+    "dstack_tpu_service_request_latency_seconds": "Proxied service request latency",
+    "dstack_tpu_runner_call_seconds": "Runner agent round-trip time by op",
+    "dstack_tpu_offer_query_seconds": "Offer fan-in query time across project backends",
+    "dstack_tpu_backend_create_slice_seconds": "Cloud slice provisioning call time",
+    "dstack_tpu_ssh_tunnel_open_seconds": "SSH tunnel establishment time",
+}
+
+
+def _fmt_histogram(name: str, help_: str) -> str:
+    lines = [f"# HELP {name} {_esc_help(help_)}", f"# TYPE {name} histogram"]
+    snap = tracing.histogram_snapshot(name)
+    if snap is not None:
+        buckets, series = snap
+        for labels, cumulative, total, count in series:
+            for le, c in zip([f"{b:g}" for b in buckets] + ["+Inf"], cumulative):
+                lines.append(_sample(f"{name}_bucket", {**labels, "le": le}, c))
+            lines.append(_sample(f"{name}_sum", labels, total))
+            lines.append(_sample(f"{name}_count", labels, count))
     return "\n".join(lines)
 
 
@@ -79,12 +124,17 @@ async def render_metrics(db: Database) -> str:
     )
 
     # Per-running-job latest sample (cpu micro is a counter; TPU gauges as-is).
+    # One grouped join resolves every job's newest point: the correlated
+    # MAX(timestamp) subquery this replaces re-scanned job_metrics_points once
+    # per running job, so /metrics degraded linearly with fleet size.
     rows = await db.fetchall(
         "SELECT j.run_name, j.job_num, j.replica_num, m.cpu_usage_micro,"
         "       m.memory_usage_bytes, m.tpu"
-        " FROM jobs j JOIN job_metrics_points m ON m.job_id = j.id"
+        " FROM jobs j"
+        " JOIN (SELECT job_id, MAX(timestamp) AS ts FROM job_metrics_points"
+        "       GROUP BY job_id) latest ON latest.job_id = j.id"
+        " JOIN job_metrics_points m ON m.job_id = j.id AND m.timestamp = latest.ts"
         " WHERE j.status = 'running'"
-        "   AND m.timestamp = (SELECT MAX(timestamp) FROM job_metrics_points WHERE job_id = j.id)"
     )
     cpu, mem, duty, hbm = [], [], [], []
     for r in rows:
@@ -134,21 +184,20 @@ async def render_metrics(db: Database) -> str:
     )
 
     # Service data-plane window (services/proxy.py ServiceStats): the same RPS
-    # the autoscaler scales on, plus mean proxied latency over the last minute.
+    # the autoscaler scales on. Latency is no longer a mean-only gauge — the
+    # dstack_tpu_service_request_latency_seconds HISTOGRAM below carries the
+    # full distribution (the in-memory avg_latency window remains the
+    # autoscaler's signal; only the exposition changed).
     from dstack_tpu.server.services import proxy as proxy_service
 
     run_ids = proxy_service.stats.run_ids()
-    svc_rps, svc_latency = [], []
+    svc_rps = []
     if run_ids:
         rows = await db.fetch_in(
             "SELECT id, run_name FROM runs WHERE deleted = 0 AND id IN ({in})", run_ids
         )
         for r in rows:
-            labels = {"run": r["run_name"]}
-            svc_rps.append((labels, proxy_service.stats.rps(r["id"])))
-            latency = proxy_service.stats.avg_latency(r["id"])
-            if latency is not None:
-                svc_latency.append((labels, latency))
+            svc_rps.append(({"run": r["run_name"]}, proxy_service.stats.rps(r["id"])))
     sections.append(
         _fmt(
             "dstack_tpu_service_requests_per_second",
@@ -157,13 +206,26 @@ async def render_metrics(db: Database) -> str:
             svc_rps,
         )
     )
+
+    # Background loop lag: how far behind schedule each processing loop started
+    # its latest pass (0 = on time; sustained growth = an overloaded loop).
     sections.append(
         _fmt(
-            "dstack_tpu_service_request_latency_seconds",
-            "Mean proxied request latency over the trailing minute",
+            "dstack_tpu_background_loop_lag_seconds",
+            "Delay of the latest background pass behind its schedule",
             "gauge",
-            svc_latency,
+            tracing.gauge_snapshot("dstack_tpu_background_loop_lag_seconds"),
         )
     )
+
+    # Tracing histograms: the advertised families first (stable discovery),
+    # then any additional span histograms instrumentation has registered.
+    rendered = set()
+    for name, help_ in _HISTOGRAM_HELP.items():
+        sections.append(_fmt_histogram(name, help_))
+        rendered.add(name)
+    for name in tracing.histogram_names():
+        if name not in rendered:
+            sections.append(_fmt_histogram(name, f"Span duration for {name}"))
 
     return "\n".join(sections) + "\n"

@@ -20,6 +20,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from aiohttp import web
 
+from dstack_tpu.core import tracing
 from dstack_tpu.core.models.runs import JobProvisioningData, JobRuntimeData
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, loads
@@ -332,15 +333,19 @@ class RouteTable:
 route_table = RouteTable()
 
 
-def forget_run(run_id: str) -> None:
+def forget_run(run_id: str, run_name: Optional[str] = None) -> None:
     """Run deleted: drop ALL its per-run proxy state (route entry, build fence,
-    round-robin cursor, stats window, rate-limit buckets) so none of it grows
-    unbounded."""
+    round-robin cursor, stats window, rate-limit buckets, latency histogram
+    series) so none of it grows unbounded."""
     route_table.invalidate_run(run_id)
     route_table.forget_seq(run_id)
     _rr.pop(run_id, None)
     stats.drop_run(run_id)
     rate_limiter.drop_scope(run_id)
+    if run_name:
+        tracing.drop_series(
+            "dstack_tpu_service_request_latency_seconds", {"run": run_name}
+        )
 
 
 async def resolve_route(db: Database, project_name: str, run_name: str) -> RouteEntry:
@@ -566,5 +571,12 @@ async def proxy_request(
         # Buffered (known-length) responses only: for streamed/SSE output
         # forward() returns after the WHOLE stream, and a 120s held-open
         # completion would poison the mean-latency autoscaler signal.
-        stats.record_latency(entry.run_id, time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        stats.record_latency(entry.run_id, elapsed)
+        # Latency distribution for /metrics (fixed-bucket histogram, rendered
+        # by services/prometheus). Purely in-memory: the steady-state hot path
+        # stays at zero DB queries per request.
+        tracing.observe(
+            "dstack_tpu_service_request_latency_seconds", elapsed, {"run": run_name}
+        )
     return resp

@@ -42,6 +42,9 @@ async def request_metrics_middleware(request: web.Request, handler):
         status = e.status
         raise
     finally:
+        # Unmatched requests (404 spam, scanners) share ONE label value: using
+        # the raw path would mint a new (method, route, status) series per
+        # probe and let anyone blow up the /metrics exposition.
         resource = request.match_info.route.resource
-        route = resource.canonical if resource is not None else request.path
+        route = resource.canonical if resource is not None else "unmatched"
         record(request.method, route, status, time.monotonic() - start)

@@ -17,6 +17,7 @@ import logging
 from typing import Dict, Optional, Tuple
 
 from dstack_tpu.backends.gcp.startup import RUNNER_PORT
+from dstack_tpu.core import tracing
 from dstack_tpu.core.errors import SSHError
 from dstack_tpu.core.models.runs import JobProvisioningData, JobRuntimeData
 from dstack_tpu.core.services.ssh.tunnel import (
@@ -105,7 +106,12 @@ async def tunneled_endpoint(
             proxy=jpd.ssh_proxy,
             forwards=[Forward(local_port, "127.0.0.1", remote_port)],
         )
-        await tunnel.open()  # slow path: only this key's callers wait
+        with tracing.span(
+            "ssh.tunnel_open",
+            histogram="dstack_tpu_ssh_tunnel_open_seconds",
+            host=jpd.hostname,
+        ):
+            await tunnel.open()  # slow path: only this key's callers wait
         async with _lock():
             _pool[key] = tunnel
         logger.debug("tunnel up: %s -> %s:%s (local %s)", key, jpd.hostname, remote_port, local_port)
@@ -134,7 +140,12 @@ async def tunneled_app_endpoint(jpd: JobProvisioningData, remote_port: int) -> T
             proxy=jpd.ssh_proxy,
             forwards=[Forward(local_port, "127.0.0.1", remote_port)],
         )
-        await tunnel.open()
+        with tracing.span(
+            "ssh.app_tunnel_open",
+            histogram="dstack_tpu_ssh_tunnel_open_seconds",
+            host=jpd.hostname,
+        ):
+            await tunnel.open()
         async with _lock():
             _pool[key] = tunnel
         logger.debug("app tunnel up: %s (local %s)", key, local_port)

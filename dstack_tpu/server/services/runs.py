@@ -288,6 +288,8 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
     run_spec_json = run_spec.model_dump_json()
     run_name = run_spec.run_name
 
+    from dstack_tpu.server.services import events as events_service
+
     def _tx(conn) -> None:
         if existing is not None:
             # Finished runs with the same name are soft-deleted on resubmit.
@@ -300,13 +302,17 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
                 run_spec_json, service_spec_json, replicas,
             ),
         )
+        events_service.record_event_tx(
+            conn, run_id, RunStatus.SUBMITTED.value, actor="user"
+        )
         for _, job_spec in all_specs:
+            job_id = new_id()
             conn.execute(
                 "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
                 " submission_num, job_spec, status, submitted_at)"
                 " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                 (
-                    new_id(),
+                    job_id,
                     project_id,
                     run_id,
                     run_name,
@@ -318,6 +324,9 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
                     now,
                 ),
             )
+            events_service.record_event_tx(
+                conn, run_id, JobStatus.SUBMITTED.value, job_id=job_id, actor="user"
+            )
 
     await db.run(_tx)
     from dstack_tpu.server.services import proxy as proxy_service
@@ -325,7 +334,7 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
     if existing is not None:
         # The old (soft-deleted) run's proxy state goes with it; the route for
         # this run name must rebuild against the fresh run id.
-        proxy_service.forget_run(existing["id"])
+        proxy_service.forget_run(existing["id"], run_name)
     proxy_service.route_table.invalidate(project_row["name"], run_name)
     run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
     return await run_model_to_run(db, run_row)
@@ -426,10 +435,25 @@ async def stop_runs(db: Database, project_row, run_names: List[str], abort: bool
             raise ResourceNotExistsError(f"run {name} not found")
         if RunStatus(row["status"]).is_finished():
             continue
-        await db.execute(
-            "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
-            (RunStatus.TERMINATING.value, reason.value, row["id"]),
-        )
+        from dstack_tpu.server.services import events as events_service
+
+        old_status = row["status"]
+
+        def _tx(conn, row=row, old_status=old_status) -> None:
+            conn.execute(
+                "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
+                (RunStatus.TERMINATING.value, reason.value, row["id"]),
+            )
+            events_service.record_event_tx(
+                conn,
+                row["id"],
+                RunStatus.TERMINATING.value,
+                old_status=old_status,
+                actor="user",
+                reason=reason.value,
+            )
+
+        await db.run(_tx)
 
 
 async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
@@ -443,11 +467,14 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
         if not RunStatus(row["status"]).is_finished():
             raise ServerClientError(f"run {name} is {row['status']}; stop it first")
         await db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))
+        # The timeline goes with the run: events for deleted runs are
+        # unreachable (get_events 404s) and would otherwise accumulate forever.
+        await db.execute("DELETE FROM run_events WHERE run_id = ?", (row["id"],))
         # Sweep ALL the proxy's per-run state (route entry, rr cursor, stats
         # window, rate-limit buckets): deleted runs must not leak memory.
         from dstack_tpu.server.services import proxy as proxy_service
 
-        proxy_service.forget_run(row["id"])
+        proxy_service.forget_run(row["id"], row["run_name"])
 
 
 def _validate_run_name(name: str) -> None:
@@ -523,25 +550,37 @@ async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
         used_nums = set(_latest_by_replica(job_rows))
 
         async def _insert_replica(replica_num: int, specs, submission_num: int) -> None:
-            await db.executemany(
-                "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
-                " submission_num, job_spec, status, submitted_at)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
-                [
-                    (
-                        new_id(),
-                        run_row["project_id"],
-                        run_row["id"],
-                        run_row["run_name"],
-                        s.job_num,
-                        replica_num,
-                        submission_num,
-                        s.model_dump_json(),
-                        now,
+            from dstack_tpu.server.services import events as events_service
+
+            rows = [
+                (
+                    new_id(),
+                    run_row["project_id"],
+                    run_row["id"],
+                    run_row["run_name"],
+                    s.job_num,
+                    replica_num,
+                    submission_num,
+                    s.model_dump_json(),
+                    now,
+                )
+                for s in specs
+            ]
+
+            def _tx(conn) -> None:
+                conn.executemany(
+                    "INSERT INTO jobs (id, project_id, run_id, run_name, job_num,"
+                    " replica_num, submission_num, job_spec, status, submitted_at)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 'submitted', ?)",
+                    rows,
+                )
+                for r in rows:
+                    events_service.record_event_tx(
+                        conn, run_row["id"], "submitted", job_id=r[0],
+                        actor="autoscaler", reason="scaled_up",
                     )
-                    for s in specs
-                ],
-            )
+
+            await db.run(_tx)
 
         # Revive previously scaled-down/finished replicas first (fresh submission).
         for replica_num, rows in inactive:

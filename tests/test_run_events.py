@@ -1,0 +1,444 @@
+"""Run lifecycle tracing: event timeline ordering, derived phase durations,
+histogram exposition, and a strict Prometheus text-format parser.
+
+The parser test is the regression net for the hand-rendered exposition
+(services/prometheus.py): every family must carry HELP+TYPE, histogram series
+must be cumulative and consistent (_bucket/+Inf == _count), and label values
+must be escaped — exactly the properties a real Prometheus scraper enforces."""
+
+import re
+
+import pytest
+
+from dstack_tpu.core import tracing
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import events as events_service
+from dstack_tpu.server.services import request_metrics
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+    tpu_task_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fake_runner(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+    tracing.reset()
+    request_metrics.reset()
+    yield
+    FakeRunnerClient.reset()
+    tracing.reset()
+
+
+class TestEventTimeline:
+    async def test_full_lifecycle_ordering_and_phases(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("ev-run", "v5e-8"))
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "ev-run"})
+            assert run["status"] == "done"
+
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "ev-run"}
+            )
+            events = data["events"]
+            # First event is the user's submission of the run itself.
+            assert events[0]["new_status"] == "submitted"
+            assert events[0]["actor"] == "user"
+            assert events[0]["job_id"] is None
+
+            # The job walks the whole FSM, in order, with no repeats.
+            job_events = [e for e in events if e["job_id"]]
+            assert [e["new_status"] for e in job_events] == [
+                "submitted", "provisioning", "pulling", "running", "terminating", "done",
+            ]
+            # Every transition's old_status chains to the previous new_status.
+            for prev, cur in zip(job_events, job_events[1:]):
+                assert cur["old_status"] == prev["new_status"]
+
+            # Run-level aggregation follows and the run reaches a terminal event.
+            run_events = [e for e in events if e["job_id"] is None]
+            assert run_events[-1]["new_status"] == "done"
+            assert run_events[-1]["reason"] == "all_jobs_done"
+
+            # Scheduler-written events carry a trace id for log correlation.
+            assert all(
+                e["trace_id"] for e in events if e["actor"] in ("scheduler", "runner")
+            )
+
+            # Derived phases: the run visited every phase, so none is None and
+            # total covers the sum of the parts.
+            phases = data["phases"]
+            for name in ("queue", "provision", "pull", "run", "total"):
+                assert phases[name] is not None and phases[name] >= 0
+            assert phases["total"] >= max(
+                phases["queue"], phases["provision"], phases["pull"], phases["run"]
+            )
+
+    async def test_stop_records_user_event(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            spec = tpu_task_spec("ev-stop", "v5e-8")
+            await api.post("/api/project/main/runs/submit", spec)
+            await api.post(
+                "/api/project/main/runs/stop",
+                {"runs_names": ["ev-stop"], "abort_requested": False},
+            )
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "ev-stop"}
+            )
+            stop = [e for e in data["events"] if e["new_status"] == "terminating"]
+            assert stop and stop[0]["actor"] == "user"
+            assert stop[0]["reason"] == "stopped_by_user"
+
+    async def test_unknown_run_is_404(self):
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "ghost"}, expect=404
+            )
+
+
+class TestPhaseDerivation:
+    def test_compute_phases_from_synthetic_timeline(self):
+        def ev(t, new, old=None, job="j1"):
+            return {
+                "timestamp": f"2026-01-01T00:00:{t:06.3f}+00:00",
+                "new_status": new,
+                "old_status": old,
+                "job_id": job,
+                "actor": "scheduler",
+                "reason": None,
+                "message": None,
+                "trace_id": None,
+            }
+
+        events = [
+            ev(0.0, "submitted", job=None),
+            ev(0.0, "submitted"),
+            ev(2.0, "provisioning", "submitted"),
+            ev(5.0, "pulling", "provisioning"),
+            ev(6.0, "running", "pulling"),
+            ev(6.5, "running", "provisioning", job=None),
+            ev(9.0, "terminating", "running"),
+            ev(9.5, "done", "terminating"),
+            ev(10.0, "terminating", "running", job=None),
+            ev(10.0, "done", "terminating", job=None),
+        ]
+        phases = events_service.compute_phases(events)
+        assert phases["queue"] == pytest.approx(2.0)
+        assert phases["provision"] == pytest.approx(3.0)
+        assert phases["pull"] == pytest.approx(1.0)
+        assert phases["run"] == pytest.approx(4.0)
+        assert phases["total"] == pytest.approx(10.0)
+
+    def test_unvisited_phases_are_none(self):
+        events = [
+            {
+                "timestamp": "2026-01-01T00:00:00+00:00",
+                "new_status": "submitted",
+                "old_status": None,
+                "job_id": None,
+                "actor": "user",
+                "reason": None,
+                "message": None,
+                "trace_id": None,
+            }
+        ]
+        phases = events_service.compute_phases(events)
+        assert phases["queue"] is None
+        assert phases["provision"] is None
+        assert phases["total"] is None
+        assert events_service.compute_phases([])["total"] is None
+
+
+# ---------------------------------------------------------------------------
+# Strict Prometheus text exposition parser
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _parse_labels(s: str) -> dict:
+    """Parse `k="v",k2="v2"` enforcing quoting and escape rules."""
+    labels = {}
+    i = 0
+    while i < len(s):
+        m = re.match(r"([a-zA-Z_][a-zA-Z0-9_]*)=\"", s[i:])
+        assert m, f"bad label start at {s[i:]!r}"
+        name = m.group(1)
+        i += m.end()
+        val = []
+        while True:
+            assert i < len(s), f"unterminated label value in {s!r}"
+            ch = s[i]
+            if ch == "\\":
+                assert i + 1 < len(s) and s[i + 1] in '\\"n', f"bad escape in {s!r}"
+                val.append({"n": "\n"}.get(s[i + 1], s[i + 1]))
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                assert ch != "\n", "raw newline in label value"
+                val.append(ch)
+                i += 1
+        labels[name] = "".join(val)
+        if i < len(s):
+            assert s[i] == ",", f"expected ',' at {s[i:]!r}"
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str) -> dict:
+    """Validate the whole exposition; returns {family: {"type", "samples"}}
+    where samples is [(name, labels, value)]. Raises AssertionError on any
+    format violation."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families: dict = {}
+    current = None  # (family, type)
+    pending_help = None
+    for line in text.splitlines():
+        assert line.strip() == line, f"stray whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam, _, help_text = rest.partition(" ")
+            assert _NAME_RE.match(fam), f"bad family name {fam!r}"
+            assert fam not in families, f"duplicate HELP for {fam}"
+            assert help_text, f"empty HELP for {fam}"
+            pending_help = fam
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, type_ = rest.partition(" ")
+            assert fam == pending_help, f"TYPE {fam} not preceded by its HELP"
+            assert type_ in ("counter", "gauge", "histogram"), type_
+            families[fam] = {"type": type_, "samples": []}
+            current = (fam, type_)
+            pending_help = None
+            continue
+        assert not line.startswith("#"), f"unknown comment line {line!r}"
+        assert current is not None, f"sample before any TYPE: {line!r}"
+        fam, type_ = current
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$", line)
+        assert m, f"unparsable sample line {line!r}"
+        name, label_str, value_str = m.groups()
+        if type_ == "histogram":
+            assert name in (f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"), (
+                f"sample {name} does not belong to histogram {fam}"
+            )
+        else:
+            assert name == fam, f"sample {name} does not belong to {fam}"
+        labels = _parse_labels(label_str) if label_str else {}
+        for k in labels:
+            assert _LABEL_NAME_RE.match(k), f"bad label name {k!r}"
+        value = float(value_str)  # raises on malformed numbers
+        families[fam]["samples"].append((name, labels, value))
+    # Histogram consistency: per label set, buckets are cumulative and
+    # +Inf == _count; _sum/_count present exactly once.
+    for fam, data in families.items():
+        if data["type"] != "histogram":
+            continue
+        series: dict = {}
+        for name, labels, value in data["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            entry = series.setdefault(key, {"buckets": [], "sum": None, "count": None})
+            if name.endswith("_bucket"):
+                assert "le" in labels, f"{fam} bucket without le"
+                le = float("inf") if labels["le"] == "+Inf" else float(labels["le"])
+                entry["buckets"].append((le, value))
+            elif name.endswith("_sum"):
+                assert entry["sum"] is None, f"duplicate {fam}_sum"
+                entry["sum"] = value
+            else:
+                assert entry["count"] is None, f"duplicate {fam}_count"
+                entry["count"] = value
+        for key, entry in series.items():
+            assert entry["buckets"], f"{fam}{dict(key)} has no buckets"
+            les = [le for le, _ in entry["buckets"]]
+            assert les == sorted(les), f"{fam} buckets out of order"
+            assert les[-1] == float("inf"), f"{fam} missing +Inf bucket"
+            counts = [c for _, c in entry["buckets"]]
+            assert counts == sorted(counts), f"{fam} buckets not cumulative"
+            assert entry["count"] is not None and entry["sum"] is not None
+            assert counts[-1] == entry["count"], f"{fam} +Inf != count"
+    return families
+
+
+class TestPrometheusExposition:
+    async def test_every_family_parses_strictly(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("px", "v5e-8"))
+            await drive(api.db)
+            # A proxied-latency observation and a loop-lag gauge, so those
+            # families render with samples too.
+            tracing.observe(
+                "dstack_tpu_service_request_latency_seconds", 0.034, {"run": "px"}
+            )
+            tracing.set_gauge(
+                "dstack_tpu_background_loop_lag_seconds", {"task": "process_runs"}, 0.0
+            )
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+
+            # The advertised histogram families are all present and typed.
+            for fam in (
+                "dstack_tpu_run_queue_wait_seconds",
+                "dstack_tpu_run_provision_duration_seconds",
+                "dstack_tpu_scheduler_pass_duration_seconds",
+                "dstack_tpu_service_request_latency_seconds",
+            ):
+                assert families[fam]["type"] == "histogram", fam
+            assert families["dstack_tpu_runs_total"]["type"] == "gauge"
+            assert families["dstack_tpu_background_loop_lag_seconds"]["type"] == "gauge"
+
+    async def test_histogram_bucket_counts(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("hx", "v5e-8"))
+            await drive(api.db)
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+
+            # One single-job run = one job left 'submitted' and one left
+            # 'provisioning': each phase histogram observed exactly once.
+            for fam in (
+                "dstack_tpu_run_queue_wait_seconds",
+                "dstack_tpu_run_provision_duration_seconds",
+                "dstack_tpu_run_pull_duration_seconds",
+            ):
+                counts = [
+                    v for name, labels, v in families[fam]["samples"]
+                    if name.endswith("_count")
+                ]
+                assert counts == [1.0], (fam, families[fam]["samples"])
+            # Scheduler pass histograms: one series per instrumented pass,
+            # counts match the number of drive() iterations (10 each).
+            passes = {
+                labels["pass"]
+                for name, labels, _ in
+                families["dstack_tpu_scheduler_pass_duration_seconds"]["samples"]
+                if name.endswith("_count")
+            }
+            assert passes == {
+                "process_submitted_jobs", "process_running_jobs",
+                "process_terminating_jobs", "process_runs",
+            }
+
+    def test_parser_rejects_malformed_expositions(self):
+        good = (
+            "# HELP m_total things\n# TYPE m_total counter\n"
+            'm_total{a="b"} 1\n'
+        )
+        parse_exposition(good)
+        with pytest.raises(AssertionError):
+            parse_exposition("m_total 1\n")  # sample with no HELP/TYPE
+        with pytest.raises(AssertionError):  # TYPE without preceding HELP
+            parse_exposition("# TYPE m_total counter\nm_total 1\n")
+        with pytest.raises(AssertionError):  # unescaped quote in label value
+            parse_exposition(
+                "# HELP m things\n# TYPE m gauge\n" 'm{a="b"c"} 1\n'
+            )
+        with pytest.raises(AssertionError):  # histogram without +Inf
+            parse_exposition(
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n'
+            )
+        with pytest.raises(AssertionError):  # non-cumulative buckets
+            parse_exposition(
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\n'
+            )
+
+    def test_label_escaping_round_trips(self):
+        from dstack_tpu.server.services.prometheus import _fmt
+
+        text = _fmt(
+            "m_esc", "weird labels", "gauge",
+            [({"a": 'quote" back\\slash \n newline'}, 1.0)],
+        ) + "\n"
+        fams = parse_exposition(text)
+        ((_, labels, _),) = fams["m_esc"]["samples"]
+        assert labels["a"] == 'quote" back\\slash \n newline'
+
+
+class TestUnmatchedRouteBucketing:
+    async def test_unmatched_paths_share_one_label(self):
+        async with api_server() as api:
+            for i in range(5):
+                resp = await api.client.get(f"/no/such/path-{i}")
+                assert resp.status == 404
+            routes = {route for (_, route, _), _, _ in request_metrics.snapshot()}
+            for i in range(5):
+                assert f"/no/such/path-{i}" not in routes
+            assert "unmatched" in routes
+            # Matched routes still use their canonical template.
+            await api.post("/api/project/main/runs/list")
+            routes = {route for (_, route, _), _, _ in request_metrics.snapshot()}
+            assert "/api/project/{project_name}/runs/list" in routes
+
+
+class TestTracer:
+    def test_span_nesting_and_trace_propagation(self):
+        tracing.new_trace()
+        tid = tracing.current_trace_id()
+        assert tid
+        with tracing.span("outer"):
+            outer_sid = tracing.current_span_id()
+            assert tracing.current_trace_id() == tid
+            with tracing.span("inner"):
+                assert tracing.current_span_id() != outer_sid
+            assert tracing.current_span_id() == outer_sid
+        assert tracing.current_span_id() is None
+
+    def test_span_feeds_histogram(self):
+        with tracing.span("x", histogram="test_hist", labels={"k": "v"}):
+            pass
+        buckets, series = tracing.histogram_snapshot("test_hist")
+        ((labels, cumulative, total, count),) = series
+        assert labels == {"k": "v"}
+        assert count == 1 and cumulative[-1] == 1
+        assert total >= 0
+
+    def test_slow_span_warns(self, monkeypatch, caplog):
+        import logging
+
+        monkeypatch.setenv("DSTACK_TPU_TRACE_SLOW_SECONDS", "0.0001")
+        with caplog.at_level(logging.WARNING, logger="dstack_tpu.core.tracing"):
+            with tracing.span("slow.op", run="r1"):
+                import time
+
+                time.sleep(0.002)
+        assert any("slow span slow.op" in r.message for r in caplog.records)
+        assert any("run=r1" in r.message for r in caplog.records)
+
+    def test_deleted_run_latency_series_swept(self):
+        from dstack_tpu.server.services import proxy as proxy_service
+
+        tracing.observe(
+            "dstack_tpu_service_request_latency_seconds", 0.05, {"run": "dead-svc"}
+        )
+        tracing.observe(
+            "dstack_tpu_service_request_latency_seconds", 0.05, {"run": "live-svc"}
+        )
+        proxy_service.forget_run("run-dead", "dead-svc")
+        _, series = tracing.histogram_snapshot(
+            "dstack_tpu_service_request_latency_seconds"
+        )
+        assert [labels for labels, _, _, _ in series] == [{"run": "live-svc"}]
+
+    def test_summary_quantiles(self):
+        for v in (0.004, 0.02, 0.02, 0.2):
+            tracing.observe("q_hist", v)
+        s = tracing.summary("q_hist")
+        assert s["count"] == 4
+        assert s["p50"] == 0.025  # bucket upper bound containing the median
+        assert s["mean"] == pytest.approx(0.061)
