@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy smoke-observability release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train smoke-observability release publish clean
 
 all: runner wheel
 
@@ -47,6 +47,13 @@ bench-scheduler:
 # the legacy per-request-session/per-request-DB path.
 bench-proxy:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_proxy()))"
+
+# Training-pipeline smoke: the grad-accumulation/prefetch sweep on 8 fake CPU
+# devices with bounded steps (DSTACK_TPU_BENCH_TRAIN_STEPS, default 6) — one
+# JSON line per run; proves every overlapped-pipeline variant end to end.
+bench-train:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -c "import json, bench; print(json.dumps(bench.bench_train_pipeline()))"
 
 # Observability smoke: boots the server in-process, drives one run through the
 # full FSM, and asserts the events timeline + /metrics histograms are live.

@@ -22,6 +22,10 @@ from dstack_tpu.core.models.configurations import (
 from dstack_tpu.core.models.profiles import Profile
 from dstack_tpu.core.models.runs import JobSpec, Requirements, RunSpec
 
+# jax-free string composition (workloads/xla_flags.py): the comm/compute-overlap
+# XLA defaults every orchestrated TPU job receives unless it opts out.
+from dstack_tpu.workloads.xla_flags import overlap_env
+
 # Pinned openvscode-server release installed at dev-env start when the image
 # ships no IDE and the host has egress (reference configurators/dev.py:35).
 OPENVSCODE_VERSION = "1.97.2"
@@ -73,6 +77,29 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
 
     from dstack_tpu.core.models.common import parse_duration
 
+    env = _env(run_spec)
+    if conf.resources.tpu is not None:
+        # TPU jobs get the comm/compute-overlap compiler defaults (latency-
+        # hiding scheduler + async collectives). overlap_env merges flag-by-
+        # flag UNDER the user's own XLA_FLAGS/LIBTPU_INIT_ARGS (their flags
+        # win by name) and returns {} when DSTACK_TPU_OVERLAP_FLAGS=0.
+        additions = overlap_env(env)
+        if additions:
+            env = {**env, **additions}
+        elif not conf.image:
+            # Opted out on the DEFAULT image: pin the vars (user values or
+            # empty) so its baked ENV can't re-apply the flags the user just
+            # disabled — container env overrides image env. Custom images are
+            # left alone: their baked ENV is the user's own choice.
+            env.setdefault("XLA_FLAGS", "")
+            env.setdefault("LIBTPU_INIT_ARGS", "")
+    elif not conf.image:
+        # NON-TPU job on the default TPU image: the baked flags are libtpu-
+        # registered and would abort any CPU-backed XLA at init, so neutralize
+        # them at the container level (user env still wins via setdefault).
+        env.setdefault("XLA_FLAGS", "")
+        env.setdefault("LIBTPU_INIT_ARGS", "")
+
     commands = _build_commands(conf)
     stop_duration = (
         parse_duration(profile.stop_duration)
@@ -94,7 +121,7 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
                 job_name=f"{run_name}-{job_num}-{replica_num}",
                 jobs_per_replica=jobs_per_replica,
                 commands=commands,
-                env=_env(run_spec),
+                env=env,
                 image_name=conf.image or DEFAULT_TPU_IMAGE,
                 registry_auth=conf.registry_auth,
                 privileged=conf.privileged,

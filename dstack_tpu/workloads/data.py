@@ -1,0 +1,237 @@
+"""Input pipeline: batch sources, per-host sharding, and async prefetch.
+
+The host side of the overlapped training loop. Three pieces:
+
+- **Batch sources** yield host-local numpy (tokens, targets) pairs — synthetic
+  (seeded, cheap) or token-file-backed (a flat binary of token ids, the
+  standard packed-corpus format).
+- **Per-host sharded batch construction**: on a multihost mesh each process
+  materializes only its `global_batch / process_count` rows and the global
+  jax.Array is assembled from the local shards — no host ever touches the
+  full batch (Podracer-style host->device feeding).
+- **Prefetcher**: a configurable-depth double buffer that issues
+  `jax.device_put` for batch N+1 (and beyond, up to `depth`) on a background
+  thread while step N runs on the device, so host->HBM transfer disappears
+  from the step's critical path. `device_put` is async on TPU — the thread
+  only *enqueues* transfers; depth bounds how much HBM staged batches pin.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+Batch = Tuple[np.ndarray, np.ndarray]  # (tokens, targets), each [local_B, T]
+
+
+def host_shard(global_batch: int, process_index: int, process_count: int) -> Tuple[int, int]:
+    """(row_offset, rows) of this host's contiguous slice of the global batch."""
+    if global_batch % process_count != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {process_count} hosts"
+        )
+    rows = global_batch // process_count
+    return process_index * rows, rows
+
+
+def synthetic_batches(
+    vocab_size: int,
+    global_batch: int,
+    seq: int,
+    seed: int = 0,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Iterator[Batch]:
+    """Endless stream of random-token batches; each host draws only its own
+    rows (the per-host generator is seeded by (seed, process_index) so shards
+    are distinct but every host's stream is reproducible)."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    _, rows = host_shard(global_batch, pi, pc)
+    rng = np.random.default_rng((seed, pi))
+    while True:
+        tokens = rng.integers(0, vocab_size, (rows, seq), dtype=np.int32)
+        yield tokens, tokens
+
+
+def token_file_batches(
+    path: str,
+    global_batch: int,
+    seq: int,
+    dtype: str = "uint16",
+    loop: bool = True,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Iterator[Batch]:
+    """Batches from a flat binary file of token ids (np.memmap — the file is
+    never loaded whole). Windows of seq+1 tokens give (tokens, next-token
+    targets). Hosts stride the corpus disjointly: window w belongs to the host
+    where (w // rows_per_host) % process_count lands, so a pass covers the file
+    once across the fleet."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    _, rows = host_shard(global_batch, pi, pc)
+    data = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+    window = seq + 1
+    n_windows = len(data) // window
+    if n_windows < global_batch:
+        raise ValueError(
+            f"{path}: {len(data)} tokens = {n_windows} windows of {window}; "
+            f"need at least {global_batch} for one global batch"
+        )
+    w = 0
+    while True:
+        # Each global batch consumes `global_batch` consecutive windows; this
+        # host takes the `rows` of them at offset process_index * rows.
+        if w + global_batch > n_windows:
+            if not loop:
+                return
+            w = 0
+        start = w + pi * rows
+        idx = np.arange(start, start + rows) * window
+        chunk = np.stack([data[i : i + window] for i in idx]).astype(np.int32)
+        yield chunk[:, :-1], chunk[:, 1:]
+        w += global_batch
+
+
+def make_global_array(
+    local: np.ndarray, sharding: NamedSharding, global_batch: int
+) -> jax.Array:
+    """One global [global_batch, ...] jax.Array from this host's local rows.
+
+    Multihost: `jax.make_array_from_process_local_data` places each host's
+    rows onto its own devices — no cross-host gather. Single process: a plain
+    sharded device_put (local IS global)."""
+    if jax.process_count() > 1:
+        global_shape = (global_batch,) + local.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, local, global_shape)
+    return jax.device_put(local, sharding)
+
+
+def sharded_batches(
+    source: Iterator[Batch],
+    mesh: Mesh,
+    spec,
+    global_batch: int,
+) -> Iterator[Tuple[jax.Array, jax.Array]]:
+    """Map a host-local numpy batch stream to globally-sharded device arrays."""
+    sharding = NamedSharding(mesh, spec)
+    for tokens, targets in source:
+        yield (
+            make_global_array(tokens, sharding, global_batch),
+            make_global_array(targets, sharding, global_batch),
+        )
+
+
+class Prefetcher:
+    """Depth-bounded async prefetch over any iterator.
+
+    A daemon thread pulls items from `it` (each pull typically enqueues a
+    host->device transfer via `sharded_batches`) and parks them in a queue of
+    size `depth`; `__next__` pops the oldest. While the consumer runs step N
+    on-device, the thread is already staging batches N+1..N+depth, so the
+    transfer for the next step overlaps the current step's compute.
+
+    depth=0 is a synchronous passthrough (no thread — the legacy feed).
+    Exceptions in the source re-raise in the consumer; `close()` (or source
+    exhaustion) shuts the thread down. Iteration order is always preserved.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        self.depth = depth
+        self._it = it
+        self._closed = False
+        if depth == 0:
+            self._q = None
+            self._thread = None
+            return
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _fill(self) -> None:
+        try:
+            for item in self._it:
+                if self._closed:
+                    return
+                while not self._closed:
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._closed:
+                    return
+            self._push(self._DONE)
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            self._push(e)
+
+    def _push(self, item) -> None:
+        while not self._closed:
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.depth == 0:
+            return next(self._it)
+        if self._closed:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._closed = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._closed = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._closed = True
+        if self._thread is not None:
+            # Drain so a blocked put() observes _closed and exits.
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def input_pipeline(
+    mesh: Mesh,
+    spec,
+    global_batch: int,
+    seq: int,
+    vocab_size: int,
+    data_path: Optional[str] = None,
+    prefetch: int = 2,
+    seed: int = 0,
+) -> Prefetcher:
+    """The train entrypoint's one-call feed: pick the source (token file or
+    synthetic), shard per host, wrap in the prefetcher."""
+    if data_path:
+        source: Iterator[Batch] = token_file_batches(data_path, global_batch, seq)
+    else:
+        source = synthetic_batches(vocab_size, global_batch, seq, seed=seed)
+    return Prefetcher(sharded_batches(source, mesh, spec, global_batch), depth=prefetch)

@@ -347,12 +347,45 @@ def shard_moe_params(params: Params, mesh: Mesh) -> Params:
     return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
 
 
-def make_moe_train_step(cfg: MoeConfig, optimizer, mesh: Optional[Mesh] = None):
-    """jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss)."""
+def make_moe_train_step(
+    cfg: MoeConfig,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    grad_accum: int = 1,
+):
+    """jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
+
+    `grad_accum=N` scans N microbatches with fp32 grad accumulators (same
+    recipe as the dense step — train.accumulate_grads); donation and the
+    explicit batch shardings are unchanged."""
     import optax
 
+    from dstack_tpu.workloads import train
+
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    data_shards = (
+        mesh.shape["dp"] * mesh.shape["fsdp"] * mesh.shape["ep"]
+        if mesh is not None else 1
+    )
+
+    def micro_constraint(x):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, ("dp", "fsdp", "ep"), "sp"))
+        )
+
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg, mesh)
+        train.check_microbatch(tokens.shape[0], grad_accum, data_shards,
+                               axes_label="dp*fsdp*ep")
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg, mesh)
+        else:
+            loss, grads = train.accumulate_grads(
+                loss_fn, params, tokens, targets, grad_accum,
+                micro_constraint=micro_constraint, cfg=cfg, mesh=mesh,
+            )
         updates, new_opt = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt, loss
 

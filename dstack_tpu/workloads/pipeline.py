@@ -122,10 +122,12 @@ def pipelined_forward(
         out, _ = jax.lax.scan(body_fn, xs, stage_layers)
         return out
 
+    # Static stage count (jax.lax has no axis_size; the mesh is in scope).
+    pp = mesh.shape["pp"]
+
     def pipeline_body(stage_layers, micro_local):
         # Inside shard_map: stage_layers has the LOCAL [L/S, ...] slice;
         # micro_local is the dp-local microbatch stream, replicated over pp.
-        pp = jax.lax.axis_size("pp")
         sid = jax.lax.axis_index("pp")
         n_mb = micro_local.shape[0]
         ticks = n_mb + pp - 1

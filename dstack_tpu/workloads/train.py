@@ -58,17 +58,91 @@ def init_train_state(
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
 
+def check_microbatch(batch: int, grad_accum: int, data_shards: int,
+                     axes_label: str = "dp*fsdp") -> None:
+    """Trace-time guard shared by the dense and MoE steps: a microbatch
+    smaller than (or ragged over) the data-shard count silently reshards —
+    some devices idle — which is a config error in a perf-tuned step, so fail
+    loudly instead."""
+    if grad_accum > 1 and (batch // grad_accum) % data_shards != 0:
+        raise ValueError(
+            f"microbatch {batch}//{grad_accum} must be a multiple of the "
+            f"{data_shards} data shards ({axes_label}); grow --batch or "
+            f"shrink --grad-accum"
+        )
+
+
+def accumulate_grads(loss_fn, params, tokens, targets, grad_accum: int,
+                     micro_constraint=None, **loss_kwargs):
+    """(mean_loss, mean_grads) over `grad_accum` microbatches via lax.scan.
+
+    The batch's leading dim splits row-major into [A, B/A, T]; each scan step
+    runs one microbatch's forward+backward and adds its grads into fp32
+    accumulators (master-precision sums — bf16 accumulation drifts over long
+    accumulation windows). Peak activation memory is ONE microbatch's, which
+    is what lets a global batch grow ~A x without HBM blowup. Returned grads
+    are cast back to each param's dtype for the optimizer."""
+    b = tokens.shape[0]
+    if b % grad_accum != 0:
+        raise ValueError(f"batch {b} not divisible by grad_accum={grad_accum}")
+    mb = b // grad_accum
+    tok = tokens.reshape(grad_accum, mb, *tokens.shape[1:])
+    tgt = targets.reshape(grad_accum, mb, *targets.shape[1:])
+    if micro_constraint is not None:
+        tok = micro_constraint(tok)
+        tgt = micro_constraint(tgt)
+
+    def micro(carry, xs):
+        acc, loss_sum = carry
+        t, g = xs
+        loss, grads = jax.value_and_grad(loss_fn)(params, t, g, **loss_kwargs)
+        acc = jax.tree.map(lambda a, gr: a + gr.astype(jnp.float32), acc, grads)
+        return (acc, loss_sum + loss), None
+
+    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (acc, loss_sum), _ = jax.lax.scan(micro, (zeros, jnp.zeros(())), (tok, tgt))
+    grads = jax.tree.map(
+        lambda a, p: (a / grad_accum).astype(p.dtype), acc, params
+    )
+    return loss_sum / grad_accum, grads
+
+
 def make_train_step(
     cfg: LlamaConfig,
     optimizer: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
+    grad_accum: int = 1,
 ):
-    """Returns jitted (state, tokens, targets) -> (state, metrics)."""
+    """Returns jitted (state, tokens, targets) -> (state, metrics).
+
+    `grad_accum=N` microbatches the global batch N ways (fp32 accumulators,
+    one optimizer update per call); N=1 is the single-shot step. Donation and
+    the explicit in/out shardings are identical either way."""
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    data_shards = mesh.shape["dp"] * mesh.shape["fsdp"] if mesh is not None else 1
+
+    def micro_constraint(x):
+        # Microbatches keep the batch sharding on their row dim: [A, B/A, T]
+        # shards dim 1 over (dp, fsdp) and the sequence over sp, so each scan
+        # step is exactly a smaller copy of the unaccumulated step.
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, ("dp", "fsdp"), "sp"))
+        )
 
     def step(state: TrainState, tokens: jax.Array, targets: jax.Array):
-        loss, grads = jax.value_and_grad(model_lib.loss_fn)(
-            state.params, tokens, targets, cfg, mesh
-        )
+        check_microbatch(tokens.shape[0], grad_accum, data_shards)
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(model_lib.loss_fn)(
+                state.params, tokens, targets, cfg, mesh
+            )
+        else:
+            loss, grads = accumulate_grads(
+                model_lib.loss_fn, state.params, tokens, targets, grad_accum,
+                micro_constraint=micro_constraint, cfg=cfg, mesh=mesh,
+            )
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -96,28 +170,75 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _step_time_stats(times) -> Dict[str, float]:
+    """p50/p90/mean seconds from a list of per-step wall times."""
+    if not times:
+        return {}
+    s = sorted(times)
+    pick = lambda q: s[min(len(s) - 1, int(q * len(s)))]  # noqa: E731
+    return {
+        "p50_s": pick(0.50),
+        "p90_s": pick(0.90),
+        "mean_s": sum(s) / len(s),
+    }
+
+
 def _timed_loop(steps: int, batch: int, seq: int, do_step,
-                flops_per_step: float = 0.0) -> None:
-    """Shared throughput loop: `do_step()` advances state and returns loss."""
+                flops_per_step: float = 0.0) -> Dict[str, float]:
+    """Shared throughput loop: `do_step()` advances state and returns loss.
+
+    The first call is compile + first step and is reported (and returned) as
+    `compile_s`, SEPARATE from the steady-state numbers — folding a 30 s
+    compile into tok/s made short runs look slow and hid step-time jitter.
+    Steady state reports the p50/p90 step-time distribution; throughput/MFU
+    derive from p50 (the honest steady-state rate). The per-step sync this
+    takes costs one host round trip (~10 ms) against multi-second training
+    steps — <1%, and the prefetcher keeps transfers staged regardless."""
     import time
 
-    t0 = time.time()
-    for i in range(steps):
+    if steps <= 0:
+        print("training done (0 steps)", flush=True)
+        return {}
+
+    t0 = time.perf_counter()
+    loss = do_step()
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t0
+    print(f"step 1/{steps} loss={float(loss):.4f} "
+          f"compile+first-step {compile_s:.2f}s", flush=True)
+
+    times = []
+    for i in range(1, steps):
+        t0 = time.perf_counter()
         loss = do_step()
-        if i == 0 or (i + 1) % 10 == 0:
-            jax.block_until_ready(loss)
-            dt = time.time() - t0
-            steps_done = 1 if i == 0 else 10
-            tok_s = steps_done * batch * seq / max(dt, 1e-9)
-            tf = (f" {steps_done * flops_per_step / max(dt, 1e-9) / 1e12:.1f} TF/s"
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+        if (i + 1) % 10 == 0 or i == steps - 1:
+            window = times[-10:]
+            dt = sum(window) / len(window)
+            tok_s = batch * seq / max(dt, 1e-9)
+            tf = (f" {flops_per_step / max(dt, 1e-9) / 1e12:.1f} TF/s"
                   if flops_per_step else "")
             print(f"step {i + 1}/{steps} loss={float(loss):.4f} "
                   f"{tok_s:,.0f} tok/s{tf}", flush=True)
-            t0 = time.time()
-    print("training done", flush=True)
+
+    stats = _step_time_stats(times)
+    stats["compile_s"] = compile_s
+    if times:
+        p50 = stats["p50_s"]
+        stats["tokens_per_sec"] = batch * seq / max(p50, 1e-9)
+        summary = (f"done: {steps} steps, compile {compile_s:.2f}s, "
+                   f"step p50 {p50 * 1000:.1f}ms p90 {stats['p90_s'] * 1000:.1f}ms, "
+                   f"{stats['tokens_per_sec']:,.0f} tok/s")
+        if flops_per_step:
+            summary += f" {flops_per_step / max(p50, 1e-9) / 1e12:.1f} TF/s"
+        print(summary, flush=True)
+    else:
+        print("training done", flush=True)
+    return stats
 
 
-def _moe_main(args, moe_lib) -> None:
+def _moe_main(args, moe_lib, data_lib) -> None:
     """MoE training entrypoint branch: experts over ep, the rest on dp."""
     import math
 
@@ -126,6 +247,10 @@ def _moe_main(args, moe_lib) -> None:
     devices = jax.devices()
     n = len(devices)
     cfg = moe_lib.MOE_PRESETS[args.config]
+    if args.remat_policy:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, remat=True, remat_policy=args.remat_policy)
     # ep must divide both the device count and the expert count; the default
     # is the largest such axis (gcd), degrading to pure dp on odd fits.
     ep = args.ep or math.gcd(n, cfg.n_experts)
@@ -139,45 +264,63 @@ def _moe_main(args, moe_lib) -> None:
     mesh = moe_lib.make_moe_mesh(dp=n // ep, fsdp=1, ep=ep, tp=1, sp=1,
                                  devices=devices)
     data_shards = mesh.shape["dp"] * mesh.shape["fsdp"] * mesh.shape["ep"]
-    batch = args.batch or 2 * data_shards
+    # Scale the default with accumulation: 2 rows per data shard per microbatch.
+    batch = args.batch or 2 * data_shards * args.grad_accum
     seq = args.seq or cfg.max_seq_len
     print(f"config={args.config} devices={n} mesh={dict(mesh.shape)} "
-          f"experts={cfg.n_experts} top_k={cfg.top_k} batch={batch} seq={seq}",
+          f"experts={cfg.n_experts} top_k={cfg.top_k} batch={batch} seq={seq} "
+          f"grad_accum={args.grad_accum} prefetch={args.prefetch}",
           flush=True)
-    optimizer = make_optimizer()
+    optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
     with mesh:
         params = moe_lib.shard_moe_params(
             moe_lib.init_moe_params(cfg, jax.random.PRNGKey(0)), mesh
         )
         opt_state = optimizer.init(params)
-        step_fn = moe_lib.make_moe_train_step(cfg, optimizer, mesh)
-        bspec = jax.sharding.NamedSharding(mesh, moe_lib.MOE_BATCH)
-        tokens = jax.device_put(
-            jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
-                               cfg.vocab_size),
-            bspec,
+        step_fn = moe_lib.make_moe_train_step(
+            cfg, optimizer, mesh, grad_accum=args.grad_accum
+        )
+        feed = data_lib.input_pipeline(
+            mesh, moe_lib.MOE_BATCH, batch, seq, cfg.vocab_size,
+            data_path=args.data or None, prefetch=args.prefetch,
         )
         state = {"params": params, "opt": opt_state}
 
         def do_step():
+            tokens, targets = next(feed)
             state["params"], state["opt"], loss = step_fn(
-                state["params"], state["opt"], tokens, tokens
+                state["params"], state["opt"], tokens, targets
             )
             return loss
 
-        _timed_loop(args.steps, batch, seq, do_step)
+        try:
+            _timed_loop(args.steps, batch, seq, do_step)
+        finally:
+            feed.close()
 
 
 def main() -> None:
     """`python -m dstack_tpu.workloads.train` — the runnable training entrypoint
-    the example configurations submit (examples/*.dstack.yml). Synthetic data;
-    prints per-step throughput and MFU so `dstack-tpu logs` shows live numbers."""
+    the example configurations submit (examples/*.dstack.yml). Synthetic data by
+    default (`--data tokens.bin` feeds a packed corpus); prints per-step
+    throughput and MFU so `dstack-tpu logs` shows live numbers."""
     import argparse
+    import dataclasses
+    import os
 
-    from dstack_tpu.workloads.config import PRESETS, get_config
-    from dstack_tpu.workloads.sharding import make_mesh, make_multislice_mesh
+    # Comm/compute-overlap XLA defaults BEFORE the first backend touch (XLA
+    # parses XLA_FLAGS at client init). No-op unless PJRT_DEVICE=TPU — the
+    # runner/docker contract — so CPU tests and dev chips are untouched.
+    from dstack_tpu.workloads import xla_flags
 
+    applied = xla_flags.apply()
+    if applied:
+        print(f"overlap XLA defaults applied: {applied['XLA_FLAGS']}", flush=True)
+
+    from dstack_tpu.workloads import data as data_lib
     from dstack_tpu.workloads import moe as moe_lib
+    from dstack_tpu.workloads.config import PRESETS, get_config
+    from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh, make_multislice_mesh
 
     parser = argparse.ArgumentParser(prog="dstack_tpu.workloads.train")
     parser.add_argument("--config", default="test",
@@ -191,15 +334,32 @@ def main() -> None:
                         help="expert-parallel axis size for MoE configs"
                              " (0 = largest ep dividing both the device count"
                              " and n_experts, i.e. their gcd)")
+    parser.add_argument("--grad-accum", type=int, default=1, dest="grad_accum",
+                        help="microbatches per optimizer update (fp32 grad"
+                             " accumulators; batch must divide evenly)")
+    parser.add_argument("--mu-dtype", default="", dest="mu_dtype",
+                        choices=["", "float32", "bfloat16"],
+                        help="Adam first-moment dtype (bfloat16 halves its HBM)")
+    parser.add_argument("--remat-policy", default="", dest="remat_policy",
+                        choices=["", "full", "dots", "save_proj"],
+                        help="rematerialization policy override (config default"
+                             " if empty)")
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="input prefetch depth: batches staged to HBM ahead"
+                             " of the step (0 = synchronous feed)")
+    parser.add_argument("--data", default="",
+                        help="flat binary token-id file (np.uint16) to train"
+                             " on; empty = synthetic tokens")
     args = parser.parse_args()
 
     if args.config in moe_lib.MOE_PRESETS:
-        _moe_main(args, moe_lib)
+        _moe_main(args, moe_lib, data_lib)
         return
 
     cfg = get_config(args.config)
+    if args.remat_policy:
+        cfg = dataclasses.replace(cfg, remat=True, remat_policy=args.remat_policy)
     devices = jax.devices()
-    import os
 
     num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
     if args.multislice and num_slices > 1:
@@ -207,28 +367,34 @@ def main() -> None:
     else:
         mesh = make_mesh(devices=devices)  # all devices on fsdp
     data_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
-    batch = args.batch or 2 * data_shards
+    # The default batch scales with accumulation so each MICROBATCH keeps 2
+    # rows per data shard (an explicit --batch must divide accordingly).
+    batch = args.batch or 2 * data_shards * args.grad_accum
     seq = args.seq or cfg.max_seq_len
 
     print(f"config={args.config} devices={len(devices)} mesh={dict(mesh.shape)} "
-          f"batch={batch} seq={seq}", flush=True)
-    optimizer = make_optimizer()
+          f"batch={batch} seq={seq} grad_accum={args.grad_accum} "
+          f"prefetch={args.prefetch}", flush=True)
+    optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
     with mesh:
         state = init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
-        step_fn = make_train_step(cfg, optimizer, mesh)
-        bspec = batch_sharding(mesh)
-        key = jax.random.PRNGKey(1)
-        tokens = jax.device_put(
-            jax.random.randint(key, (batch, seq), 0, cfg.vocab_size), bspec
+        step_fn = make_train_step(cfg, optimizer, mesh, grad_accum=args.grad_accum)
+        feed = data_lib.input_pipeline(
+            mesh, BATCH_SPEC, batch, seq, cfg.vocab_size,
+            data_path=args.data or None, prefetch=args.prefetch,
         )
         flops_per_step = cfg.flops_per_token(seq) * batch * seq
         box = {"state": state}
 
         def do_step():
-            box["state"], metrics = step_fn(box["state"], tokens, tokens)
+            tokens, targets = next(feed)
+            box["state"], metrics = step_fn(box["state"], tokens, targets)
             return metrics["loss"]
 
-        _timed_loop(args.steps, batch, seq, do_step, flops_per_step)
+        try:
+            _timed_loop(args.steps, batch, seq, do_step, flops_per_step)
+        finally:
+            feed.close()
 
 
 if __name__ == "__main__":

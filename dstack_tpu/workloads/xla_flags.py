@@ -1,0 +1,121 @@
+"""Comm/compute-overlap compiler defaults for TPU training jobs.
+
+The XLA flags every serious TPU training setup turns on (MaxText's proven
+set, "Exploring the limits of Concurrency in ML Training on Google TPUs"):
+the latency-hiding scheduler plus async collectives, so the all-gathers /
+reduce-scatters / all-reduces that SPMD inserts for the (dp, fsdp, tp, sp)
+shardings run concurrently with MXU compute instead of serializing the step.
+
+This module is deliberately jax-free string composition so the SERVER can
+import it: the TPU job configurator (server/services/jobs/configurators.py)
+injects these defaults into every orchestrated TPU job's env, docker/tpu
+bakes them into the default image, and the train entrypoint applies them
+before JAX initializes its backend. User-provided values always win — the
+merge is by flag name, never a blind overwrite.
+
+Safety gate: the flags are libtpu-registered, and XLA dies on unknown
+XLA_FLAGS entries on backends that don't register them (CPU jaxlib, the
+axon dev plugin). `apply()` therefore only touches the environment when the
+process is actually bound to a real TPU (PJRT_DEVICE=TPU — the contract the
+runner/docker image sets) and DSTACK_TPU_OVERLAP_FLAGS is not "0".
+
+Known tradeoff: the configurator/image inject the flags into the JOB env, so
+a CPU-forced jax subprocess inside a TPU job (``JAX_PLATFORMS=cpu python``
+without libtpu loaded) inherits flags its backend doesn't register and
+aborts at init. Such a subprocess must clear them (``env -u XLA_FLAGS``) or
+the job must opt out with DSTACK_TPU_OVERLAP_FLAGS=0 — the same contract
+every flag-baked TPU training image (MaxText et al.) ships with; see
+docs/guides/training-performance.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Mapping, Optional
+
+# Flag -> value. Rationale per flag lives in docs/guides/training-performance.md
+# (the user-facing table is generated from this dict — keep them in sync via
+# tests/test_train_pipeline.py::TestXlaFlags).
+OVERLAP_XLA_FLAGS: Dict[str, str] = {
+    # The big one: schedule independent collectives/compute to overlap instead
+    # of running the HLO sequence in order.
+    "--xla_tpu_enable_latency_hiding_scheduler": "true",
+    # Make the FSDP gather-on-use / reduce-scatter-on-grads asynchronous so
+    # they hide under the matmuls that don't depend on them.
+    "--xla_enable_async_all_gather": "true",
+    "--xla_enable_async_collective_permute": "true",
+    # Fuse adjacent async collectives and let a fused group span several
+    # compute steps of the schedule.
+    "--xla_tpu_enable_async_collective_fusion": "true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather": "true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps": "true",
+    # Let the scheduler trade scoped HBM for overlap headroom.
+    "--xla_tpu_overlap_compute_collective_tc": "true",
+    "--xla_tpu_enable_all_experimental_scheduler_features": "true",
+    # Split dp-sized ops so unequal-sized collectives still pipeline.
+    "--xla_tpu_data_parallel_opt_different_sized_ops": "true",
+    # Decompose einsum+collective patterns so each half can overlap.
+    "--xla_tpu_decompose_all_gather_einsum": "true",
+    "--xla_tpu_decompose_einsum_reduce_scatter": "true",
+}
+
+# libtpu init args (parsed by libtpu itself, not XLA): host-offloaded DMA
+# descriptors sized for multislice DCN transfers. Harmless on single slice.
+OVERLAP_LIBTPU_ARGS: Dict[str, str] = {
+    "--xla_tpu_enable_megascale_barrier": "true",
+}
+
+ENV_DISABLE = "DSTACK_TPU_OVERLAP_FLAGS"  # "0" opts a job out entirely
+
+
+def _parse(flags: str) -> Dict[str, Optional[str]]:
+    """'--a=1 --b' -> {'--a': '1', '--b': None}, order preserved (dict)."""
+    out: Dict[str, Optional[str]] = {}
+    for tok in flags.split():
+        name, sep, val = tok.partition("=")
+        out[name] = val if sep else None
+    return out
+
+
+def _render(flags: Mapping[str, Optional[str]]) -> str:
+    return " ".join(k if v is None else f"{k}={v}" for k, v in flags.items())
+
+
+def compose(existing: str = "", defaults: Optional[Mapping[str, str]] = None) -> str:
+    """Merge the overlap defaults UNDER an existing flag string: any flag the
+    user already set (by name, whatever the value) is left untouched."""
+    merged = dict(_parse(existing))
+    for name, val in (defaults if defaults is not None else OVERLAP_XLA_FLAGS).items():
+        merged.setdefault(name, val)
+    return _render(merged)
+
+
+def overlap_env(existing: Optional[Mapping[str, str]] = None) -> Dict[str, str]:
+    """The env additions for one TPU job, composed against the job's own env
+    (user flags win flag-by-flag). Returns {} when the job opted out."""
+    existing = existing or {}
+    if str(existing.get(ENV_DISABLE, "")) == "0":
+        return {}
+    return {
+        "XLA_FLAGS": compose(existing.get("XLA_FLAGS", "")),
+        "LIBTPU_INIT_ARGS": compose(
+            existing.get("LIBTPU_INIT_ARGS", ""), OVERLAP_LIBTPU_ARGS
+        ),
+    }
+
+
+def apply(env: Optional[Mapping[str, str]] = None) -> Dict[str, str]:
+    """Install the overlap defaults into os.environ — call BEFORE the first
+    jax device/backend touch (XLA parses XLA_FLAGS at backend init).
+
+    No-ops (returns {}) unless the process is bound to a real TPU
+    (PJRT_DEVICE=TPU, the runner/docker contract): the flags are registered
+    by libtpu and XLA hard-fails on unknown flags on other backends.
+    """
+    src = dict(env) if env is not None else dict(os.environ)
+    if src.get("PJRT_DEVICE") != "TPU":
+        return {}
+    additions = overlap_env(src)
+    for k, v in additions.items():
+        os.environ[k] = v
+    return additions

@@ -1,0 +1,528 @@
+"""Overlapped training pipeline: gradient accumulation, input prefetch,
+per-host sharded batches, and the comm/compute-overlap env defaults.
+
+Numerics run on the virtual 8-device CPU mesh (conftest); the orchestrator
+side (env injection) runs through the real server + scripted runner."""
+
+import dataclasses
+import itertools
+import os
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# Pin eager computation to CPU (same pattern as tests/test_workloads.py).
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+import optax
+
+from dstack_tpu.workloads import data as data_lib
+from dstack_tpu.workloads import moe as moe_lib
+from dstack_tpu.workloads import train as train_lib
+from dstack_tpu.workloads import xla_flags
+from dstack_tpu.workloads.config import get_config
+from dstack_tpu.workloads.sharding import BATCH_SPEC, batch_sharding, make_mesh
+
+REPO = Path(__file__).parent.parent
+
+
+def fp32_cfg(**over):
+    over.setdefault("dtype", "float32")
+    over.setdefault("param_dtype", "float32")
+    over.setdefault("remat", False)
+    over.setdefault("max_seq_len", 64)
+    return get_config("test", **over)
+
+
+def cpu_devices(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices, have {len(devs)}")
+    return devs[:n]
+
+
+class TestGradAccum:
+    def test_accum4_matches_full_batch_step(self):
+        """One accum=4 update over 4 microbatches == one full-batch update,
+        within fp32 tolerance (the acceptance-bar equivalence)."""
+        cfg = fp32_cfg()
+        opt = optax.sgd(0.1)  # linear in grads: equivalence is exact up to fp
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size)
+
+        results = {}
+        for accum in (1, 4):
+            state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt)
+            step = train_lib.make_train_step(cfg, opt, grad_accum=accum)
+            state, metrics = step(state, tokens, targets)
+            results[accum] = (state, metrics)
+
+        full, acc = results[1], results[4]
+        np.testing.assert_allclose(
+            float(acc[1]["loss"]), float(full[1]["loss"]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(acc[1]["grad_norm"]), float(full[1]["grad_norm"]), rtol=1e-4
+        )
+        for key in full[0].params:
+            np.testing.assert_allclose(
+                np.asarray(acc[0].params[key]), np.asarray(full[0].params[key]),
+                rtol=1e-4, atol=1e-5, err_msg=key,
+            )
+
+    def test_accum_on_mesh_matches_unaccumulated(self):
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=2, fsdp=4, tp=1, sp=1, devices=devs)
+        cfg = fp32_cfg()
+        opt = optax.sgd(0.1)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, cfg.vocab_size)
+        results = {}
+        with mesh:
+            bspec = batch_sharding(mesh)
+            tok = jax.device_put(tokens, bspec)
+            for accum in (1, 2):
+                state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt, mesh)
+                step = train_lib.make_train_step(cfg, opt, mesh, grad_accum=accum)
+                state, metrics = step(state, tok, tok)
+                results[accum] = (
+                    {k: np.asarray(v) for k, v in state.params.items()},
+                    float(metrics["loss"]),
+                )
+        np.testing.assert_allclose(results[2][1], results[1][1], rtol=1e-5)
+        for key in results[1][0]:
+            np.testing.assert_allclose(
+                results[2][0][key], results[1][0][key], rtol=1e-4, atol=1e-5,
+                err_msg=key,
+            )
+
+    def test_moe_accum_trains(self):
+        cfg = dataclasses.replace(moe_lib.MOE_PRESETS["moe_test"], max_seq_len=64)
+        opt = optax.adamw(1e-3)
+        params = moe_lib.init_moe_params(cfg, jax.random.PRNGKey(0))
+        opt_state = opt.init(params)
+        step = moe_lib.make_moe_train_step(cfg, opt, grad_accum=2)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens, tokens)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_indivisible_batch_rejected(self):
+        cfg = fp32_cfg()
+        opt = optax.sgd(0.1)
+        state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt)
+        step = train_lib.make_train_step(cfg, opt, grad_accum=3)
+        tokens = jnp.zeros((8, 32), jnp.int32)
+        with pytest.raises(ValueError, match="not divisible"):
+            step(state, tokens, tokens)
+
+    def test_microbatch_smaller_than_data_shards_rejected(self):
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=2, fsdp=4, tp=1, sp=1, devices=devs)
+        cfg = fp32_cfg()
+        opt = optax.sgd(0.1)
+        with mesh:
+            state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt, mesh)
+            step = train_lib.make_train_step(cfg, opt, mesh, grad_accum=2)
+            tokens = jax.device_put(
+                jnp.zeros((8, 32), jnp.int32), batch_sharding(mesh)
+            )  # microbatch 4 < 8 data shards
+            with pytest.raises(ValueError, match="data shards"):
+                step(state, tokens, tokens)
+
+    def test_bad_grad_accum_rejected(self):
+        cfg = fp32_cfg()
+        with pytest.raises(ValueError, match="grad_accum"):
+            train_lib.make_train_step(cfg, optax.sgd(0.1), grad_accum=0)
+
+
+class TestPrefetcher:
+    def test_order_preserved(self):
+        with data_lib.Prefetcher(iter(range(20)), depth=3) as p:
+            assert list(p) == list(range(20))
+
+    def test_depth_bounds_readahead(self):
+        produced = []
+
+        def source():
+            for i in itertools.count():
+                produced.append(i)
+                yield i
+
+        p = data_lib.Prefetcher(source(), depth=3)
+        try:
+            assert next(p) == 0
+            deadline = time.time() + 2.0
+            # It prefetches AHEAD of demand (that's the point)...
+            while len(produced) < 3 and time.time() < deadline:
+                time.sleep(0.01)
+            assert len(produced) >= 3
+            time.sleep(0.2)
+            # ...but never more than consumed + depth + 1 in-hand item.
+            assert len(produced) <= 1 + 3 + 1, produced
+        finally:
+            p.close()
+
+    def test_depth_zero_is_synchronous_passthrough(self):
+        pulled = []
+
+        def source():
+            for i in range(5):
+                pulled.append(i)
+                yield i
+
+        p = data_lib.Prefetcher(source(), depth=0)
+        assert p._thread is None
+        assert next(p) == 0
+        assert pulled == [0]  # nothing pulled ahead
+        assert list(p) == [1, 2, 3, 4]
+
+    def test_source_exception_propagates(self):
+        def source():
+            yield 1
+            yield 2
+            raise RuntimeError("corrupt shard")
+
+        p = data_lib.Prefetcher(source(), depth=2)
+        try:
+            assert next(p) == 1
+            assert next(p) == 2
+            with pytest.raises(RuntimeError, match="corrupt shard"):
+                next(p)
+        finally:
+            p.close()
+
+    def test_exhaustion_stops_iteration(self):
+        p = data_lib.Prefetcher(iter([1]), depth=2)
+        assert next(p) == 1
+        with pytest.raises(StopIteration):
+            next(p)
+        with pytest.raises(StopIteration):
+            next(p)  # stays closed
+
+    def test_close_stops_fill_thread(self):
+        p = data_lib.Prefetcher(itertools.count(), depth=2)
+        assert next(p) == 0
+        p.close()
+        assert not p._thread.is_alive()
+
+    def test_negative_depth_rejected(self):
+        with pytest.raises(ValueError, match="depth"):
+            data_lib.Prefetcher(iter([]), depth=-1)
+
+
+class TestHostShardedBatches:
+    def test_host_shard_partition(self):
+        seen = []
+        for pi in range(4):
+            off, rows = data_lib.host_shard(16, pi, 4)
+            assert rows == 4
+            seen.extend(range(off, off + rows))
+        assert sorted(seen) == list(range(16))  # disjoint cover
+
+    def test_host_shard_indivisible_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            data_lib.host_shard(10, 0, 3)
+
+    def test_synthetic_per_host_distinct_and_reproducible(self):
+        a0 = next(data_lib.synthetic_batches(100, 8, 16, process_index=0, process_count=2))
+        a0_again = next(
+            data_lib.synthetic_batches(100, 8, 16, process_index=0, process_count=2)
+        )
+        a1 = next(data_lib.synthetic_batches(100, 8, 16, process_index=1, process_count=2))
+        assert a0[0].shape == (4, 16)  # local rows = global / hosts
+        np.testing.assert_array_equal(a0[0], a0_again[0])
+        assert not np.array_equal(a0[0], a1[0])
+
+    def test_token_file_windows_and_targets(self, tmp_path):
+        path = tmp_path / "tokens.bin"
+        np.arange(4 * 9, dtype=np.uint16).tofile(path)  # 4 windows of seq+1=9
+        it = data_lib.token_file_batches(
+            str(path), global_batch=2, seq=8, loop=False,
+            process_index=0, process_count=1,
+        )
+        tokens, targets = next(it)
+        assert tokens.shape == (2, 8)
+        np.testing.assert_array_equal(tokens[0], np.arange(8))
+        np.testing.assert_array_equal(targets[0], np.arange(1, 9))  # next-token
+        np.testing.assert_array_equal(tokens[1], np.arange(9, 17))
+        next(it)  # windows 2..3
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_token_file_hosts_are_disjoint(self, tmp_path):
+        path = tmp_path / "tokens.bin"
+        np.arange(4 * 9, dtype=np.uint16).tofile(path)
+        host_rows = [
+            next(data_lib.token_file_batches(
+                str(path), global_batch=4, seq=8,
+                process_index=pi, process_count=2,
+            ))[0]
+            for pi in range(2)
+        ]
+        combined = np.concatenate(host_rows)  # hosts cover the global batch
+        full = next(data_lib.token_file_batches(
+            str(path), global_batch=4, seq=8, process_index=0, process_count=1
+        ))[0]
+        np.testing.assert_array_equal(combined, full)
+
+    def test_token_file_too_small_rejected(self, tmp_path):
+        path = tmp_path / "tokens.bin"
+        np.arange(10, dtype=np.uint16).tofile(path)
+        with pytest.raises(ValueError, match="need at least"):
+            next(data_lib.token_file_batches(str(path), global_batch=4, seq=8))
+
+    def test_sharded_batches_on_8_device_mesh(self):
+        """The multihost batch-construction path on the fake-device harness:
+        the assembled global array carries the batch sharding and exactly the
+        source's content."""
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=2, fsdp=2, tp=1, sp=2, devices=devs)
+        src_np = next(data_lib.synthetic_batches(100, 16, 32, process_index=0,
+                                                 process_count=1))
+        with mesh:
+            tokens, targets = next(data_lib.sharded_batches(
+                iter([src_np]), mesh, BATCH_SPEC, global_batch=16
+            ))
+        assert tokens.shape == (16, 32)
+        assert tokens.sharding == jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("dp", "fsdp"), "sp")
+        )
+        np.testing.assert_array_equal(np.asarray(tokens), src_np[0])
+        # Each device holds exactly its [4, 16] tile.
+        shard_shape = tokens.sharding.shard_shape(tokens.shape)
+        assert shard_shape == (4, 16)
+
+    def test_input_pipeline_feeds_train_step(self):
+        devs = cpu_devices(8)
+        mesh = make_mesh(dp=2, fsdp=4, tp=1, sp=1, devices=devs)
+        cfg = fp32_cfg()
+        opt = optax.sgd(0.1)
+        with mesh:
+            state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt, mesh)
+            step = train_lib.make_train_step(cfg, opt, mesh, grad_accum=2)
+            with data_lib.input_pipeline(
+                mesh, BATCH_SPEC, global_batch=16, seq=32,
+                vocab_size=cfg.vocab_size, prefetch=2,
+            ) as feed:
+                for _ in range(2):
+                    tokens, targets = next(feed)
+                    state, metrics = step(state, tokens, targets)
+        assert np.isfinite(float(metrics["loss"]))
+
+
+class TestXlaFlags:
+    def test_defaults_compose(self):
+        flags = xla_flags.compose("")
+        for name in xla_flags.OVERLAP_XLA_FLAGS:
+            assert f"{name}=" in flags
+        assert "--xla_tpu_enable_latency_hiding_scheduler=true" in flags
+
+    def test_user_flag_wins_by_name(self):
+        flags = xla_flags.compose("--xla_tpu_enable_latency_hiding_scheduler=false")
+        assert flags.count("--xla_tpu_enable_latency_hiding_scheduler") == 1
+        assert "--xla_tpu_enable_latency_hiding_scheduler=false" in flags
+        assert "--xla_enable_async_all_gather=true" in flags  # rest still added
+
+    def test_unrelated_user_flags_preserved(self):
+        env = xla_flags.overlap_env({"XLA_FLAGS": "--xla_dump_to=/tmp/hlo"})
+        assert env["XLA_FLAGS"].startswith("--xla_dump_to=/tmp/hlo")
+        assert "--xla_tpu_enable_async_collective_fusion=true" in env["XLA_FLAGS"]
+        assert "--xla_tpu_enable_megascale_barrier=true" in env["LIBTPU_INIT_ARGS"]
+
+    def test_opt_out(self):
+        assert xla_flags.overlap_env({xla_flags.ENV_DISABLE: "0"}) == {}
+
+    def test_apply_noops_off_tpu(self, monkeypatch):
+        monkeypatch.delenv("PJRT_DEVICE", raising=False)
+        sentinel = os.environ.get("XLA_FLAGS")
+        assert xla_flags.apply() == {}
+        assert os.environ.get("XLA_FLAGS") == sentinel  # untouched
+
+    def test_apply_sets_env_on_tpu(self, monkeypatch):
+        monkeypatch.setenv("PJRT_DEVICE", "TPU")
+        monkeypatch.setenv("XLA_FLAGS", "--xla_dump_to=/tmp/hlo")
+        monkeypatch.setenv("LIBTPU_INIT_ARGS", "")
+        applied = xla_flags.apply()
+        assert os.environ["XLA_FLAGS"] == applied["XLA_FLAGS"]
+        assert applied["XLA_FLAGS"].startswith("--xla_dump_to=/tmp/hlo")
+        assert "--xla_tpu_enable_latency_hiding_scheduler=true" in applied["XLA_FLAGS"]
+
+    def test_docker_image_env_matches_module(self):
+        """docker/tpu bakes the same defaults the module composes — the image
+        and the configurator must never drift apart."""
+        text = (REPO / "docker" / "tpu" / "Dockerfile").read_text()
+        baked = {}
+        for var in ("XLA_FLAGS", "LIBTPU_INIT_ARGS"):
+            m = [ln for ln in text.splitlines() if f'{var}="' in ln]
+            assert m, f"docker/tpu/Dockerfile does not bake {var}"
+            baked[var] = m[0].split('"')[1]
+        assert xla_flags._parse(baked["XLA_FLAGS"]) == dict(xla_flags.OVERLAP_XLA_FLAGS)
+        assert xla_flags._parse(baked["LIBTPU_INIT_ARGS"]) == dict(
+            xla_flags.OVERLAP_LIBTPU_ARGS
+        )
+
+
+class TestTimedLoop:
+    def test_reports_compile_separately_and_percentiles(self, capsys):
+        calls = []
+
+        def do_step():
+            calls.append(1)
+            time.sleep(0.05 if len(calls) == 1 else 0.01)
+            return jnp.float32(1.0)
+
+        stats = train_lib._timed_loop(12, batch=4, seq=8, do_step=do_step)
+        assert stats["compile_s"] >= 0.05
+        assert 0 < stats["p50_s"] <= stats["p90_s"]
+        # Steady-state throughput excludes the slow first step entirely.
+        assert stats["tokens_per_sec"] > 4 * 8 / 0.05
+        out = capsys.readouterr().out
+        assert "compile+first-step" in out
+        assert "p50" in out and "p90" in out
+
+
+class TestOverlapEnvInjection:
+    """Orchestrated runs receive the overlap env defaults (acceptance bar:
+    server-side coverage of the job-configurator path)."""
+
+    @pytest.fixture(autouse=True)
+    def _fake_runner(self, monkeypatch):
+        from dstack_tpu.server.background import tasks
+        from dstack_tpu.server.services import backends as backends_service
+        from tests.common import FakeRunnerClient
+
+        FakeRunnerClient.reset()
+        backends_service.reset_compute_cache()
+        monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+        yield
+
+    async def test_tpu_job_env_gets_overlap_defaults(self):
+        from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend, tpu_task_spec
+
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("overlap", "v5e-8")
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "overlap"})
+            assert run["status"] == "done"
+            fakes = list(FakeRunnerClient.registry.values())
+            assert fakes
+            for fake in fakes:
+                env = fake.submitted.env
+                assert "--xla_tpu_enable_latency_hiding_scheduler=true" in env["XLA_FLAGS"]
+                assert "--xla_enable_async_all_gather=true" in env["XLA_FLAGS"]
+                assert "--xla_tpu_enable_megascale_barrier=true" in env["LIBTPU_INIT_ARGS"]
+
+    async def test_user_env_wins_flag_by_flag(self):
+        from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend, tpu_task_spec
+
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "overlap-ov", "v5e-8",
+                    env={"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=false"},
+                ),
+            )
+            await drive(api.db)
+            env = list(FakeRunnerClient.registry.values())[0].submitted.env
+            assert "--xla_tpu_enable_latency_hiding_scheduler=false" in env["XLA_FLAGS"]
+            assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in env["XLA_FLAGS"]
+            assert "--xla_enable_async_all_gather=true" in env["XLA_FLAGS"]
+
+    async def test_opt_out_env(self):
+        from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend, tpu_task_spec
+
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("overlap-off", "v5e-8",
+                              env={"DSTACK_TPU_OVERLAP_FLAGS": "0"}),
+            )
+            await drive(api.db)
+            env = list(FakeRunnerClient.registry.values())[0].submitted.env
+            # Pinned EMPTY (not merely absent): the container-level value must
+            # override the default image's baked ENV so the opt-out is real.
+            assert env["XLA_FLAGS"] == ""
+            assert env["LIBTPU_INIT_ARGS"] == ""
+
+    def test_non_tpu_job_on_default_image_neutralizes_baked_flags(self):
+        """The default image bakes TPU-only XLA_FLAGS; a non-TPU job on it
+        must have them pinned empty or CPU-backed XLA aborts at init."""
+        from dstack_tpu.core.models.runs import RunSpec
+        from dstack_tpu.server.services.jobs.configurators import get_job_specs
+
+        spec = RunSpec.model_validate({
+            "run_name": "cpu-task",
+            "configuration": {"type": "task", "commands": ["echo hi"]},
+        })
+        (job,) = get_job_specs(spec)
+        assert job.env["XLA_FLAGS"] == ""
+        assert job.env["LIBTPU_INIT_ARGS"] == ""
+
+    def test_non_tpu_job_on_custom_image_untouched(self):
+        from dstack_tpu.core.models.runs import RunSpec
+        from dstack_tpu.server.services.jobs.configurators import get_job_specs
+
+        spec = RunSpec.model_validate({
+            "run_name": "cpu-task-img",
+            "configuration": {
+                "type": "task",
+                "commands": ["echo hi"],
+                "image": "python:3.11",
+            },
+        })
+        (job,) = get_job_specs(spec)
+        assert "XLA_FLAGS" not in job.env
+        assert "LIBTPU_INIT_ARGS" not in job.env
+
+    def test_opt_out_on_custom_image_leaves_image_env_alone(self):
+        """Opting out on a CUSTOM image must not pin XLA_FLAGS="" — that
+        would wipe flags the user baked into their own image's ENV."""
+        from dstack_tpu.core.models.runs import RunSpec
+        from dstack_tpu.server.services.jobs.configurators import get_job_specs
+
+        spec = RunSpec.model_validate({
+            "run_name": "custom-img",
+            "configuration": {
+                "type": "task",
+                "commands": ["python train.py"],
+                "image": "ghcr.io/me/my-tpu-image:1",
+                "resources": {"tpu": "v5e-8"},
+                "env": {"DSTACK_TPU_OVERLAP_FLAGS": "0"},
+            },
+        })
+        jobs = get_job_specs(spec)
+        for job in jobs:
+            assert "XLA_FLAGS" not in job.env
+            assert "LIBTPU_INIT_ARGS" not in job.env
+
+
+class TestEntrypointDefaults:
+    def test_default_batch_scales_with_grad_accum(self, monkeypatch, capsys):
+        """The shipped examples pass --grad-accum with no --batch: the default
+        batch must keep each MICROBATCH at 2 rows per data shard, or main()
+        dies in check_microbatch at the first step (regression)."""
+        import sys
+
+        monkeypatch.setattr(sys, "argv", [
+            "train", "--config", "test", "--steps", "1", "--seq", "32",
+            "--grad-accum", "4", "--prefetch", "1",
+        ])
+        train_lib.main()
+        out = capsys.readouterr().out
+        n = len(jax.devices())
+        assert f"batch={2 * n * 4} " in out  # scaled by accum
+        assert "compile+first-step" in out
