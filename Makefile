@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train smoke-observability release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve smoke-observability smoke-serve release publish clean
 
 all: runner wheel
 
@@ -55,11 +55,24 @@ bench-train:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  python -c "import json, bench; print(json.dumps(bench.bench_train_pipeline()))"
 
+# Serving-engine bench: open-loop synthetic load against the continuous-
+# batching engine on CPU — one JSON line with tokens/s/chip, p50/p99 TTFT and
+# inter-token latency; vs_baseline is continuous over static batching.
+bench-serve:
+	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_serve()))"
+
 # Observability smoke: boots the server in-process, drives one run through the
 # full FSM, and asserts the events timeline + /metrics histograms are live.
 # Prints one JSON line; a missing surface is a non-zero exit.
 smoke-observability:
 	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_observability()"
+
+# Serving smoke: boots the server + a real engine replica, streams SSE tokens
+# through the proxy, and asserts the latency autoscaler scales a service from
+# zero (run_events carries the autoscaler actor + cold-start histogram) and
+# back. Prints one JSON line; any missing piece is a non-zero exit.
+smoke-serve:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_serve()"
 
 release: runner wheel
 	@mkdir -p $(DIST)

@@ -562,6 +562,350 @@ def smoke_observability() -> dict:
     return result
 
 
+def _serve_bench_config():
+    """Tiny fp32 model for the CPU serving bench/smoke: big enough that a
+    decode step does real matmul work, small enough that a full open-loop run
+    finishes in seconds."""
+    from dstack_tpu.workloads.config import get_config
+
+    return get_config(
+        "test", n_layers=2, d_model=128, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=1024, max_seq_len=256, dtype="float32",
+        param_dtype="float32", remat=False,
+    )
+
+
+def _serve_schedule(n_requests: int, seed: int = 7) -> list:
+    """Open-loop arrival plan: (arrival_s, prompt_tokens, max_new). MIXED
+    generation lengths on purpose (2..96): uniform-length batches hide exactly
+    the slot waste static batching suffers — a finished short request idles
+    its slot until the longest one in the batch drains. Arrivals saturate the
+    engine (~200 req/s offered), so throughput measures drain capacity and
+    queueing shows up in the TTFT tail."""
+    import random
+
+    rng = random.Random(seed)
+    schedule, t = [], 0.0
+    for _ in range(n_requests):
+        t += rng.expovariate(1 / 0.005)
+        prompt = [rng.randrange(1, 1024) for _ in range(rng.randint(4, 32))]
+        schedule.append((t, prompt, rng.randint(2, 96)))
+    return schedule
+
+
+def _run_serve_variant(cfg, params, schedule, **engine_kwargs) -> dict:
+    """Drive one engine variant through the open-loop schedule; report
+    tokens/s/chip, p50/p99 TTFT, and inter-token latency. Open loop: arrivals
+    follow the schedule's clock whether or not the engine keeps up, so queue
+    growth shows up as TTFT tail, exactly like production overload."""
+    from dstack_tpu.workloads import serve as serve_lib
+
+    engine = serve_lib.ServeEngine(
+        cfg, serve_lib.EngineConfig(**engine_kwargs), params=params
+    )
+    # Warm the jit caches (decode + this schedule's prefill buckets) so the
+    # measured run times scheduling, not compilation.
+    warm = engine.submit([1, 2, 3], max_new_tokens=2)
+    while not warm.done:
+        engine.step()
+
+    arrivals = {}      # req_id -> arrival time
+    token_times = {}   # req_id -> [emission times]
+    reqs = {}
+    idx = 0
+    t0 = time.perf_counter()
+    first_arrival = schedule[0][0]
+    while idx < len(schedule) or engine.has_work():
+        now = time.perf_counter() - t0
+        while idx < len(schedule) and schedule[idx][0] <= now:
+            arrival, prompt, max_new = schedule[idx]
+            req = engine.submit(prompt, max_new_tokens=max_new)
+            arrivals[req.req_id] = arrival
+            token_times[req.req_id] = []
+            reqs[req.req_id] = req
+            idx += 1
+        if engine.has_work():
+            events = engine.step()
+            t_emit = time.perf_counter() - t0
+            for ev in events:
+                token_times[ev.req_id].append(t_emit)
+        elif idx < len(schedule):
+            time.sleep(max(0.0, schedule[idx][0] - (time.perf_counter() - t0)))
+    t_end = time.perf_counter() - t0
+
+    from dstack_tpu.utils.common import nearest_rank
+
+    ttfts = sorted(
+        times[0] - arrivals[rid] for rid, times in token_times.items() if times
+    )
+    itls = sorted(
+        b - a for times in token_times.values() for a, b in zip(times, times[1:])
+    )
+    total_tokens = sum(len(t) for t in token_times.values())
+    assert all(r.done for r in reqs.values()), "engine left requests unfinished"
+    return {
+        "tokens_per_sec": round(total_tokens / max(t_end - first_arrival, 1e-9), 1),
+        "ttft_p50_ms": round(nearest_rank(ttfts, 0.50) * 1000, 1),
+        "ttft_p99_ms": round(nearest_rank(ttfts, 0.99) * 1000, 1),
+        "itl_p50_ms": round(nearest_rank(itls, 0.50) * 1000, 2),
+        "itl_p99_ms": round(nearest_rank(itls, 0.99) * 1000, 2),
+        "steps": engine.total_steps,
+        "preemptions": engine.total_preemptions,
+        "requests": len(schedule),
+        "policy": engine.ecfg.policy,
+        "page_size": engine.ecfg.page_size,
+    }
+
+
+def bench_serve() -> dict:
+    """`make bench-serve`: the continuous-batching engine under an open-loop
+    synthetic load — continuous vs static batching plus a page-size sweep, PR 4
+    style (headline = continuous; per-variant numbers in extras). On one CPU
+    device this is a scheduling bench, not a model-speed bench; on a TPU host
+    the same code measures the chip."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from dstack_tpu.workloads import model as model_lib
+
+    import statistics
+
+    cfg = _serve_bench_config()
+    params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+    n = int(os.environ.get("DSTACK_TPU_BENCH_SERVE_REQUESTS", "24"))
+    rounds = int(os.environ.get("DSTACK_TPU_BENCH_SERVE_ROUNDS", "3"))
+    schedule = _serve_schedule(n)
+    pool = dict(page_size=16, num_pages=96, max_batch=4, max_seq=160)
+
+    # Rehearsal runs compile every prefill bucket the schedule touches (the
+    # jitted fns are memoized per config, so warmth carries across engines);
+    # page-size variants have their own cache shapes and rehearse separately.
+    _run_serve_variant(cfg, params, schedule, policy="continuous", **pool)
+    _run_serve_variant(cfg, params, schedule, policy="static", **pool)
+
+    # Paired rounds with the order flipped each time (the bench_proxy design):
+    # the headline ratio is the median of per-round ratios, so correlated
+    # host-load drift cancels inside each pair.
+    cont_rounds, static_rounds, ratios = [], [], []
+    for i in range(rounds):
+        pair = {}
+        order = ("continuous", "static") if i % 2 == 0 else ("static", "continuous")
+        for policy in order:
+            pair[policy] = _run_serve_variant(
+                cfg, params, schedule, policy=policy, **pool
+            )
+        cont_rounds.append(pair["continuous"])
+        static_rounds.append(pair["static"])
+        ratios.append(
+            pair["continuous"]["tokens_per_sec"] / pair["static"]["tokens_per_sec"]
+        )
+
+    def _median_round(rs: list) -> dict:
+        return sorted(rs, key=lambda r: r["tokens_per_sec"])[len(rs) // 2]
+
+    cont = _median_round(cont_rounds)
+    static = _median_round(static_rounds)
+    variants = {"continuous": cont, "static": static}
+    # Page-size sweep (informational extras): second run is the measured one.
+    for name, kw in (
+        ("continuous_page4", dict(page_size=4, policy="continuous",
+                                  num_pages=384, max_batch=4, max_seq=160)),
+        ("continuous_page64", dict(page_size=64, policy="continuous",
+                                   num_pages=24, max_batch=4, max_seq=160)),
+    ):
+        try:
+            _run_serve_variant(cfg, params, schedule, **kw)
+            variants[name] = _run_serve_variant(cfg, params, schedule, **kw)
+        except Exception as e:  # noqa: BLE001
+            variants[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    n_dev = max(jax.device_count(), 1)
+    return {
+        "metric": "serve_tokens_per_sec_per_chip",
+        "value": round(cont["tokens_per_sec"] / n_dev, 1),
+        "unit": "tok/s/chip",
+        # Baseline = static batching on the same mixed-length schedule: the
+        # continuous engine's whole reason to exist is beating this.
+        "vs_baseline": round(statistics.median(ratios), 2),
+        "extra": {
+            "requests": n,
+            "rounds": rounds,
+            "devices": n_dev,
+            "ttft_p50_ms": cont["ttft_p50_ms"],
+            "ttft_p99_ms": cont["ttft_p99_ms"],
+            "itl_p50_ms": cont["itl_p50_ms"],
+            "itl_p99_ms": cont["itl_p99_ms"],
+            "per_round_ratio": [round(r, 2) for r in ratios],
+            "variants": variants,
+        },
+    }
+
+
+def smoke_serve() -> dict:
+    """`make smoke-serve`: boot the server in-process, stand up a REAL serving
+    engine as a replica, stream tokens through the proxy's SSE pass-through,
+    then close the autoscaler loop — injected p90 latency scales a fake
+    service up (run_events shows the autoscaler actor + the cold-start
+    histogram fills), an idle window scales it back to zero. Raises on any
+    missing piece."""
+    import asyncio
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from dstack_tpu.core import tracing
+    from dstack_tpu.server.background import tasks
+    from dstack_tpu.server.services import proxy as proxy_service
+    from dstack_tpu.workloads import model as model_lib
+    from dstack_tpu.workloads import serve as serve_lib
+    from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend
+
+    tracing.reset()
+    proxy_service.stats.reset()
+
+    async def run() -> dict:
+        import jax
+
+        cfg = _serve_bench_config()
+        engine = serve_lib.ServeEngine(
+            cfg,
+            serve_lib.EngineConfig(page_size=8, num_pages=64, max_batch=4,
+                                   max_seq=128),
+            params=model_lib.init_params(cfg, jax.random.PRNGKey(0)),
+        )
+        runner = serve_lib.EngineRunner(engine, idle_wait=0.01)
+        runner.start()
+        app_runner = aioweb.AppRunner(serve_lib.create_serve_app(runner))
+        await app_runner.setup()
+        site = aioweb.TCPSite(app_runner, "127.0.0.1", 0)
+        await site.start()
+        engine_port = site._server.sockets[0].getsockname()[1]
+
+        FakeRunnerClient.reset()
+        tasks.get_runner_client = FakeRunnerClient.for_jpd
+        # Service replicas must STAY running (the stock script finishes jobs,
+        # which is right for tasks and wrong for services).
+        saved_script = FakeRunnerClient.default_script
+        FakeRunnerClient.default_script = lambda self: [
+            {"job_states": [{"state": "running"}], "logs": [], "offset": 1}
+        ]
+        try:
+            async with api_server() as api:
+                # --- tokens stream through the proxy, unbuffered ---------
+                await _seed_bench_service(api.db, "smoke-serve", engine_port)
+                url = (
+                    f"http://127.0.0.1:{api.client.server.port}"
+                    "/proxy/services/main/smoke-serve/generate"
+                )
+                events = []
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                        url,
+                        json={"prompt": "hello tpu", "max_tokens": 8,
+                              "stream": True},
+                    ) as resp:
+                        assert resp.status == 200, await resp.text()
+                        assert resp.headers["Content-Type"].startswith(
+                            "text/event-stream"
+                        )
+                        async for line in resp.content:
+                            if line.startswith(b"data: "):
+                                events.append(line[6:].strip())
+                assert events[-1] == b"[DONE]" and len(events) == 9, events
+                # The first-chunk hook recorded TTFT + engine queue depth.
+                q = proxy_service.stats.latency_quantiles("run-smoke-serve")
+                assert q and q["count"] >= 1, q
+                assert proxy_service.stats.queue_depth("run-smoke-serve") is not None
+
+                # --- the autoscaler control loop -------------------------
+                await setup_mock_backend(api)
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {"run_spec": {
+                        "run_name": "scaled-svc",
+                        "configuration": {
+                            "type": "service",
+                            "commands": ["python -m dstack_tpu.workloads.serve"],
+                            "port": 8000,
+                            "auth": False,
+                            "replicas": "0..2",
+                            "resources": {"tpu": "v5e-8"},
+                            "scaling": {
+                                "metric": "latency", "target": 0.2,
+                                "queue_depth_target": 2,
+                                "scale_up_delay": 0, "scale_down_delay": 0,
+                            },
+                        },
+                    }},
+                )
+                row = await api.db.fetchone(
+                    "SELECT * FROM runs WHERE run_name = 'scaled-svc'"
+                )
+                # Inject demand with a sick p90: the loop must scale 0 -> 1.
+                for _ in range(30):
+                    proxy_service.stats.record(row["id"])
+                    proxy_service.stats.record_latency(row["id"], 0.8)
+                proxy_service.stats.record_queue_depth(row["id"], 7)
+                await tasks.process_autoscaler(api.db)
+                await drive(api.db)
+                jobs = await api.db.fetchall(
+                    "SELECT * FROM jobs WHERE run_id = ? AND status = 'running'",
+                    (row["id"],),
+                )
+                assert jobs, "autoscaler never scaled the service from zero"
+
+                data = await api.post(
+                    "/api/project/main/runs/get_events",
+                    {"run_name": "scaled-svc"},
+                )
+                auto = [e for e in data["events"] if e["actor"] == "autoscaler"]
+                assert auto and auto[0]["reason"] == "scale_from_zero", auto
+                snap = tracing.histogram_snapshot(
+                    "dstack_tpu_service_cold_start_seconds"
+                )
+                assert snap is not None, "cold-start histogram never observed"
+                cold = _histogram_summaries(
+                    "dstack_tpu_service_cold_start_seconds", "from_zero"
+                )
+
+                # Demand evaporates: back to zero (min replicas = 0).
+                proxy_service.stats.reset()
+                await tasks.process_autoscaler(api.db)
+                await drive(api.db)
+                left = await api.db.fetchall(
+                    "SELECT * FROM jobs WHERE run_id = ? AND status = 'running'",
+                    (row["id"],),
+                )
+                assert not left, "autoscaler never scaled back to zero"
+                run = await api.post(
+                    "/api/project/main/runs/get", {"run_name": "scaled-svc"}
+                )
+                assert run["status"] == "running", run["status"]  # alive at 0
+
+                return {
+                    "metric": "smoke_serve",
+                    "value": len(events) - 1,
+                    "unit": "sse_tokens",
+                    "ttft_ms": round(q["p50"] * 1000, 1),
+                    "cold_start": cold,
+                }
+        finally:
+            FakeRunnerClient.default_script = saved_script
+            runner.shutdown()
+            await app_runner.cleanup()
+            proxy_service.stats.reset()
+            proxy_service.route_table.clear()
+
+    result = asyncio.run(run())
+    print(json.dumps(result))
+    return result
+
+
 def main() -> None:
     try:
         import jax

@@ -16,11 +16,21 @@ from dstack_tpu.core.models.common import ConfigModel, CoreModel, Duration
 
 class ScalingMetric(str, Enum):
     RPS = "rps"
+    # Windowed p90 end-to-end latency (seconds; TTFT for streamed responses)
+    # + engine queue depth — the serving-engine control loop
+    # (server/services/autoscaler.py).
+    LATENCY = "latency"
 
 
 class ScalingSpec(ConfigModel):
     metric: ScalingMetric = ScalingMetric.RPS
+    # rps: target requests/sec per replica. latency: target p90 seconds —
+    # p90 above it scales up, p90 under half of it scales down.
     target: float = Field(gt=0)
+    # latency metric only: queued requests per replica (reported by the
+    # engine via X-Dstack-Queue-Depth) above which a replica is added even
+    # while latency still looks healthy — backlog leads latency.
+    queue_depth_target: Optional[int] = Field(default=None, ge=1)
     scale_up_delay: Duration = 300
     scale_down_delay: Duration = 600
 

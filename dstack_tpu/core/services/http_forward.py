@@ -16,7 +16,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-from typing import Optional
+from typing import Callable, Optional
 
 import aiohttp
 from aiohttp import web
@@ -109,8 +109,15 @@ async def forward(
     tail: str,
     timeout_total: float = DEFAULT_TIMEOUT_TOTAL,
     body: bytes = None,
+    on_first_chunk: Optional[Callable[[aiohttp.ClientResponse], None]] = None,
 ) -> web.StreamResponse:
-    """Forward `request` to http://host:port/<tail> (+query), streaming back."""
+    """Forward `request` to http://host:port/<tail> (+query), streaming back.
+
+    ``on_first_chunk`` fires once, when the first STREAMED body chunk arrives
+    from upstream (buffered known-length responses never call it): for SSE
+    token streams that instant is time-to-first-token — the latency signal a
+    held-open stream's total duration would poison. The callback gets the
+    upstream response (headers readable) and must not raise or block."""
     url = f"http://{host}:{port}/{tail.lstrip('/')}"
     if request.query_string:
         url += f"?{request.query_string}"
@@ -141,7 +148,14 @@ async def forward(
             if k.lower() not in HOP_HEADERS:
                 resp.headers[k] = v
         await resp.prepare(request)
+        first = on_first_chunk
         async for chunk in upstream.content.iter_chunked(64 * 1024):
+            if first is not None:
+                try:
+                    first(upstream)
+                except Exception:
+                    logger.exception("on_first_chunk callback failed")
+                first = None
             await resp.write(chunk)
         await resp.write_eof()
         return resp

@@ -1,0 +1,236 @@
+"""Zero-python-dependency crypto for the gateway's TLS stack, backed by the
+``openssl`` CLI.
+
+The ACME client and SNI cert store need exactly five primitives: EC P-256
+keygen, ES256 (ECDSA/SHA-256) JWS signatures, CSR generation, self-signed
+certs, and certificate field parsing. The ``cryptography`` wheel ships all of
+them but is a heavyweight native dependency the serving images don't need for
+anything else — while every base image (and every CI host) already carries
+the openssl binary. So this module shells out: keys are PEM strings
+end-to-end, each call is one short-lived ``openssl`` process, and the only
+parsing done in Python is two tiny DER structures (an ECDSA signature's
+r/s SEQUENCE and the uncompressed point at the tail of a P-256 SPKI) whose
+layouts are fixed by the curve.
+
+Local CA helpers (``sign_csr``) are included for the test harness's fake ACME
+CA and for private-CA deployments.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import secrets
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+OPENSSL = os.environ.get("DSTACK_TPU_OPENSSL", "openssl")
+
+
+class CryptoError(RuntimeError):
+    pass
+
+
+def _run(args, input_bytes: Optional[bytes] = None) -> bytes:
+    proc = subprocess.run(
+        [OPENSSL, *args], input=input_bytes, capture_output=True
+    )
+    if proc.returncode != 0:
+        raise CryptoError(
+            f"openssl {' '.join(args[:3])}... failed: "
+            f"{proc.stderr.decode(errors='replace')[:300]}"
+        )
+    return proc.stdout
+
+
+class _TempFiles:
+    """Private scratch dir for key material passed to the CLI (0700 dir,
+    0600 files; gone when the operation ends)."""
+
+    def __enter__(self):
+        self._dir = tempfile.TemporaryDirectory(prefix="dstack-tpu-crypto-")
+        return self
+
+    def __exit__(self, *exc):
+        self._dir.cleanup()
+        return False
+
+    def write(self, name: str, content) -> str:
+        path = os.path.join(self._dir.name, name)
+        data = content.encode() if isinstance(content, str) else content
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        return path
+
+    def path(self, name: str) -> str:
+        return os.path.join(self._dir.name, name)
+
+
+# -- keys -------------------------------------------------------------------
+
+
+def generate_ec_key_pem() -> str:
+    """Fresh P-256 private key, PKCS#8 PEM."""
+    return _run(
+        ["genpkey", "-algorithm", "EC", "-pkeyopt", "ec_paramgen_curve:P-256"]
+    ).decode()
+
+
+def pubkey_xy(key_pem: str) -> Tuple[int, int]:
+    """(x, y) of the public point — what an ES256 JWK carries. The DER SPKI
+    for P-256 always ends with the 65-byte uncompressed point 04 || X || Y."""
+    with _TempFiles() as tf:
+        der = _run(["pkey", "-in", tf.write("k.pem", key_pem), "-pubout",
+                    "-outform", "DER"])
+    point = der[-65:]
+    if len(point) != 65 or point[0] != 0x04:
+        raise CryptoError("unexpected SPKI layout for P-256 public key")
+    return int.from_bytes(point[1:33], "big"), int.from_bytes(point[33:], "big")
+
+
+def ecdsa_sign_p256(key_pem: str, data: bytes) -> bytes:
+    """ES256 signature over `data`, raw 64-byte r||s (JWS format)."""
+    with _TempFiles() as tf:
+        der = _run(["dgst", "-sha256", "-sign", tf.write("k.pem", key_pem)],
+                   input_bytes=data)
+    r, s = _parse_ecdsa_der(der)
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def _parse_ecdsa_der(sig: bytes) -> Tuple[int, int]:
+    """DER ECDSA-Sig-Value: SEQUENCE { INTEGER r, INTEGER s }."""
+    if len(sig) < 8 or sig[0] != 0x30:
+        raise CryptoError("bad DER signature")
+    i = 2
+    if sig[1] & 0x80:  # long-form length never happens for P-256 but be safe
+        i = 2 + (sig[1] & 0x7F)
+
+    def read_int(i: int) -> Tuple[int, int]:
+        if sig[i] != 0x02:
+            raise CryptoError("bad DER signature integer")
+        n = sig[i + 1]
+        start = i + 2
+        return int.from_bytes(sig[start:start + n], "big"), start + n
+
+    r, i = read_int(i)
+    s, _ = read_int(i)
+    return r, s
+
+
+# -- certificates -----------------------------------------------------------
+
+
+def self_signed_cert(cn: str, days: int = 3650, is_ca: bool = False) -> Tuple[str, str]:
+    """(cert_pem, key_pem). Leaf certs carry a DNS SAN for `cn` (hostname
+    verification needs SANs, not CNs); `is_ca` relies on openssl's default
+    v3_ca section (basicConstraints CA:TRUE) — adding it again would mint a
+    duplicate extension that verifiers reject."""
+    key_pem = generate_ec_key_pem()
+    with _TempFiles() as tf:
+        args = [
+            "req", "-x509", "-new", "-key", tf.write("k.pem", key_pem),
+            "-subj", f"/CN={cn}", "-days", str(days), "-sha256",
+            "-out", tf.path("cert.pem"),
+        ]
+        if not is_ca:
+            args += ["-addext", f"subjectAltName=DNS:{cn}"]
+        _run(args)
+        with open(tf.path("cert.pem")) as f:
+            cert_pem = f.read()
+    return cert_pem, key_pem
+
+
+def make_csr_der(key_pem: str, domain: str) -> bytes:
+    """PKCS#10 CSR (DER) for `domain` with a DNS SAN — the ACME finalize body."""
+    with _TempFiles() as tf:
+        return _run([
+            "req", "-new", "-key", tf.write("k.pem", key_pem),
+            "-subj", f"/CN={domain}", "-addext", f"subjectAltName=DNS:{domain}",
+            "-outform", "DER",
+        ])
+
+
+def csr_cn(csr_der: bytes) -> str:
+    """The CSR subject's CN (RFC 2253 form strips to `CN=name`)."""
+    with _TempFiles() as tf:
+        out = _run([
+            "req", "-inform", "DER", "-in", tf.write("csr.der", csr_der),
+            "-noout", "-subject", "-nameopt", "RFC2253",
+        ]).decode().strip()
+    subject = out.split("=", 1)[1]
+    for part in subject.split(","):
+        if part.strip().startswith("CN="):
+            return part.strip()[3:]
+    raise CryptoError(f"CSR subject has no CN: {subject!r}")
+
+
+def sign_csr(
+    csr_der: bytes, ca_cert_pem: str, ca_key_pem: str, days: int = 30
+) -> str:
+    """CA-sign a CSR (test harness / private-CA issuance); returns the leaf
+    PEM. The SAN is re-derived from the CSR's CN (``openssl x509 -req`` drops
+    requested extensions unless an extfile restates them)."""
+    cn = csr_cn(csr_der)
+    with _TempFiles() as tf:
+        csr_pem = _run([  # x509 -req reads PEM CSRs only (openssl 1.1.1)
+            "req", "-inform", "DER", "-in", tf.write("csr.der", csr_der),
+            "-outform", "PEM",
+        ])
+        _run([
+            "x509", "-req", "-in", tf.write("csr.pem", csr_pem),
+            "-CA", tf.write("ca.pem", ca_cert_pem),
+            "-CAkey", tf.write("cakey.pem", ca_key_pem),
+            "-set_serial", str(secrets.randbits(63)),
+            "-days", str(days), "-sha256",
+            "-extfile", tf.write("ext.cnf", f"subjectAltName=DNS:{cn}\n"),
+            "-out", tf.path("leaf.pem"),
+        ])
+        with open(tf.path("leaf.pem")) as f:
+            return f.read()
+
+
+def _x509_field(cert, flag: str, inform: str) -> str:
+    with _TempFiles() as tf:
+        name = "cert.der" if inform == "DER" else "cert.pem"
+        out = _run([
+            "x509", "-inform", inform, "-in", tf.write(name, cert),
+            "-noout", flag, "-nameopt", "RFC2253",
+        ]).decode().strip()
+    return out.split("=", 1)[1]
+
+
+def cert_subject(cert, inform: str = "PEM") -> str:
+    """RFC 2253 subject, e.g. ``CN=svc.test`` (pass DER for a live peer cert)."""
+    return _x509_field(cert, "-subject", inform)
+
+
+def cert_issuer(cert, inform: str = "PEM") -> str:
+    return _x509_field(cert, "-issuer", inform)
+
+
+_MONTHS = {
+    "Jan": 1, "Feb": 2, "Mar": 3, "Apr": 4, "May": 5, "Jun": 6,
+    "Jul": 7, "Aug": 8, "Sep": 9, "Oct": 10, "Nov": 11, "Dec": 12,
+}
+
+
+def cert_not_after(cert_pem) -> datetime.datetime:
+    """The leaf's notAfter as an aware UTC datetime. openssl always prints
+    English month abbreviations ("notAfter=Sep  2 09:25:25 2026 GMT") but
+    strptime's %b follows LC_TIME — parse by hand so a non-English locale
+    can't silently disable renewal sweeps."""
+    with _TempFiles() as tf:
+        out = _run([
+            "x509", "-in", tf.write("cert.pem", cert_pem), "-noout", "-enddate",
+        ]).decode().strip()
+    stamp = out.split("=", 1)[1].split()
+    try:
+        mon, day, clock, year = stamp[0], int(stamp[1]), stamp[2], int(stamp[3])
+        hh, mm, ss = (int(p) for p in clock.split(":"))
+        return datetime.datetime(
+            year, _MONTHS[mon], day, hh, mm, ss, tzinfo=datetime.timezone.utc
+        )
+    except (KeyError, IndexError, ValueError) as e:
+        raise CryptoError(f"unparseable notAfter {out!r}: {e}")

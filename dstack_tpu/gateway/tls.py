@@ -5,8 +5,10 @@ certificate per service domain and nginx terminates TLS. TPU re-design: the
 aiohttp appliance terminates TLS itself via an SNI callback over a directory of
 per-domain certs, and issuance is a small ACME client speaking the REST flow
 directly (directory -> nonce -> account -> order -> http-01 -> finalize), the
-same SDK-free style as the repo's cloud clients. The `cryptography` primitives
-(EC keys, CSR, JWS signatures) are the only dependency — no certbot, no nginx.
+same SDK-free style as the repo's cloud clients. Crypto primitives (EC keys,
+CSR, JWS signatures) come from ``gateway.minicrypto`` — the openssl CLI every
+base image already ships — so there is no certbot, no nginx, and no native
+Python crypto wheel in the dependency set at all.
 """
 
 from __future__ import annotations
@@ -20,6 +22,8 @@ import ssl
 import threading
 import urllib.request
 from typing import Callable, Dict, Optional, Tuple
+
+from dstack_tpu.gateway import minicrypto
 
 logger = logging.getLogger(__name__)
 
@@ -99,8 +103,6 @@ class CertStore:
 
     def expiry(self, domain: str) -> Optional[datetime.datetime]:
         """not_valid_after of the stored leaf certificate (UTC), or None."""
-        from cryptography import x509
-
         path = os.path.join(self._domain_dir(domain), "fullchain.pem")
         try:
             with open(path, "rb") as f:
@@ -108,13 +110,9 @@ class CertStore:
         except OSError:
             return None
         try:
-            cert = x509.load_pem_x509_certificate(pem)
-        except ValueError:
+            return minicrypto.cert_not_after(pem)
+        except (minicrypto.CryptoError, ValueError):
             return None
-        exp = getattr(cert, "not_valid_after_utc", None)
-        if exp is None:  # older cryptography: naive UTC datetime
-            exp = cert.not_valid_after.replace(tzinfo=datetime.timezone.utc)
-        return exp
 
     def domains(self):
         return sorted(self._contexts)
@@ -149,33 +147,7 @@ class CertStore:
 
 def self_signed_cert(cn: str, days: int = 3650) -> Tuple[str, str]:
     """(cert_pem, key_pem) — placeholder/test certificates."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
-
-    key = ec.generate_private_key(ec.SECP256R1())
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
-    now = datetime.datetime.now(datetime.timezone.utc)
-    cert = (
-        x509.CertificateBuilder()
-        .subject_name(name)
-        .issuer_name(name)
-        .public_key(key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now - datetime.timedelta(minutes=5))
-        .not_valid_after(now + datetime.timedelta(days=days))
-        .add_extension(x509.SubjectAlternativeName([x509.DNSName(cn)]), critical=False)
-        .sign(key, hashes.SHA256())
-    )
-    return (
-        cert.public_bytes(serialization.Encoding.PEM).decode(),
-        key.private_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.PKCS8,
-            serialization.NoEncryption(),
-        ).decode(),
-    )
+    return minicrypto.self_signed_cert(cn, days=days)
 
 
 # ---------------------------------------------------------------------------
@@ -205,8 +177,6 @@ class AcmeClient:
         poll_interval: float = 0.5,
         poll_tries: int = 30,
     ) -> None:
-        from cryptography.hazmat.primitives.asymmetric import ec
-
         self.directory_url = directory_url
         self.publish = publish
         self.unpublish = unpublish
@@ -215,7 +185,7 @@ class AcmeClient:
         self.account_path = account_path
         self.poll_interval = poll_interval
         self.poll_tries = poll_tries
-        self.account_key = None
+        self.account_key: Optional[str] = None  # P-256 private key, PKCS#8 PEM
         self.kid: Optional[str] = None
         self._nonce: Optional[str] = None
         self._dir: Optional[dict] = None
@@ -225,11 +195,9 @@ class AcmeClient:
         if account_path and os.path.exists(account_path):
             self._load_account()
         if self.account_key is None:
-            self.account_key = ec.generate_private_key(ec.SECP256R1())
+            self.account_key = minicrypto.generate_ec_key_pem()
 
     def _load_account(self) -> None:
-        from cryptography.hazmat.primitives import serialization
-
         try:
             with open(self.account_path) as f:
                 data = json.load(f)
@@ -239,11 +207,11 @@ class AcmeClient:
                 logger.info("ACME directory changed (%s -> %s); registering anew",
                             data.get("directory_url"), self.directory_url)
                 return
-            self.account_key = serialization.load_pem_private_key(
-                data["key_pem"].encode(), password=None
-            )
+            key_pem = data["key_pem"]
+            minicrypto.pubkey_xy(key_pem)  # validates the stored key parses
+            self.account_key = key_pem
             self.kid = data.get("kid")
-        except (OSError, ValueError, KeyError, TypeError):
+        except (OSError, ValueError, KeyError, TypeError, minicrypto.CryptoError):
             logger.exception("unreadable ACME account file %s; re-registering",
                              self.account_path)
             self.account_key = None
@@ -255,13 +223,7 @@ class AcmeClient:
         rate limits and loses authorization caching)."""
         if not self.account_path:
             return
-        from cryptography.hazmat.primitives import serialization
-
-        key_pem = self.account_key.private_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.PKCS8,
-            serialization.NoEncryption(),
-        ).decode()
+        key_pem = self.account_key
         fd = os.open(self.account_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
             json.dump({"key_pem": key_pem, "kid": self.kid,
@@ -270,12 +232,12 @@ class AcmeClient:
     # -- low-level JOSE/HTTP plumbing ------------------------------------
 
     def _jwk(self) -> dict:
-        nums = self.account_key.public_key().public_numbers()
+        x, y = minicrypto.pubkey_xy(self.account_key)
         return {
             "crv": "P-256",
             "kty": "EC",
-            "x": _b64u(nums.x.to_bytes(32, "big")),
-            "y": _b64u(nums.y.to_bytes(32, "big")),
+            "x": _b64u(x.to_bytes(32, "big")),
+            "y": _b64u(y.to_bytes(32, "big")),
         }
 
     def thumbprint(self) -> str:
@@ -285,14 +247,10 @@ class AcmeClient:
         return _b64u(hashlib.sha256(canonical.encode()).digest())
 
     def _sign(self, protected_b64: str, payload_b64: str) -> str:
-        from cryptography.hazmat.primitives import hashes
-        from cryptography.hazmat.primitives.asymmetric import ec, utils
-
-        der = self.account_key.sign(
-            f"{protected_b64}.{payload_b64}".encode(), ec.ECDSA(hashes.SHA256())
+        raw = minicrypto.ecdsa_sign_p256(
+            self.account_key, f"{protected_b64}.{payload_b64}".encode()
         )
-        r, s = utils.decode_dss_signature(der)
-        return _b64u(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+        return _b64u(raw)
 
     def _http(self, method: str, url: str, data: Optional[bytes] = None,
               headers: Optional[dict] = None) -> Tuple[int, dict, bytes]:
@@ -368,11 +326,6 @@ class AcmeClient:
     def _obtain_locked(self, domain: str) -> Tuple[str, str]:
         import time
 
-        from cryptography import x509
-        from cryptography.hazmat.primitives import hashes, serialization
-        from cryptography.hazmat.primitives.asymmetric import ec
-        from cryptography.x509.oid import NameOID
-
         d = self._directory()
         # Account (idempotent: onlyReturnExisting is unnecessary, we keep kid).
         if self.kid is None:
@@ -427,16 +380,8 @@ class AcmeClient:
                 else:
                     raise AcmeError(f"authorization pending past deadline for {domain}")
 
-            cert_key = ec.generate_private_key(ec.SECP256R1())
-            csr = (
-                x509.CertificateSigningRequestBuilder()
-                .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, domain)]))
-                .add_extension(
-                    x509.SubjectAlternativeName([x509.DNSName(domain)]), critical=False
-                )
-                .sign(cert_key, hashes.SHA256())
-            )
-            csr_b64 = _b64u(csr.public_bytes(serialization.Encoding.DER))
+            cert_key = minicrypto.generate_ec_key_pem()
+            csr_b64 = _b64u(minicrypto.make_csr_der(cert_key, domain))
             status, _, body = self._post(order["finalize"], {"csr": csr_b64})
             if status != 200:
                 raise AcmeError(f"finalize failed: HTTP {status}: {body[:200]!r}")
@@ -456,12 +401,7 @@ class AcmeClient:
             status, _, body = self._post(cert_url, None)
             if status != 200:
                 raise AcmeError(f"certificate download failed: HTTP {status}")
-            key_pem = cert_key.private_bytes(
-                serialization.Encoding.PEM,
-                serialization.PrivateFormat.PKCS8,
-                serialization.NoEncryption(),
-            ).decode()
-            return body.decode(), key_pem
+            return body.decode(), cert_key
         finally:
             for token in published:
                 self.unpublish(token)

@@ -90,10 +90,19 @@ def start_background_tasks(app: web.Application) -> BackgroundScheduler:
         settings.PROCESS_METRICS_INTERVAL,
         "process_metrics",
     )
+    # Probes/stats-checkpoint and the scaling decisions run as separate loops
+    # (scaling reacts on a tighter cadence than the heavier probe pass);
+    # run_autoscaler=False stops the services pass from ALSO scaling — the
+    # dedicated loop is the single cadence in the live server.
     sched.add_periodic(
-        lambda: tasks.process_services(db),
+        lambda: tasks.process_services(db, run_autoscaler=False),
         settings.PROCESS_SERVICES_INTERVAL,
         "process_services",
+    )
+    sched.add_periodic(
+        lambda: tasks.process_autoscaler(db),
+        settings.PROCESS_AUTOSCALER_INTERVAL,
+        "process_autoscaler",
     )
     sched.add_periodic(
         lambda: tasks.process_volumes(db),

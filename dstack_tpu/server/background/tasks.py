@@ -1646,13 +1646,15 @@ async def process_metrics(db: Database) -> None:
 
 
 # =====================================================================================
-# process_services: RPS autoscaler (parity: reference autoscalers.py:60-110 RPSAutoscaler
-# + process_runs.py scale handling; stats come from the in-server proxy)
+# process_services: readiness probes + stats checkpoint; the scaling half lives
+# in process_autoscaler (parity: reference autoscalers.py:60-110 RPSAutoscaler
+# + process_runs.py scale handling; signals come from the in-server proxy)
 
 
-async def process_services(db: Database, batch: Optional[int] = None) -> None:
+async def process_services(
+    db: Database, batch: Optional[int] = None, run_autoscaler: bool = True
+) -> None:
     from dstack_tpu.server.services import proxy as proxy_service
-    from dstack_tpu.server.services.runs import classify_replicas, scale_run_replicas
 
     # Checkpoint the RPS window so a restart re-primes the autoscaler instead
     # of scaling on zero knowledge right after a deploy.
@@ -1674,7 +1676,37 @@ async def process_services(db: Database, batch: Optional[int] = None) -> None:
         await proxy_service.probe_service_replicas(
             db, run_row["project_id"], run_row["run_name"]
         )
-        if conf.scaling is None:
+    # Scaling rides along so single-pass drivers (tests, one-shot maintenance
+    # scripts) see the full behavior from one call. The LIVE server's
+    # background scheduler passes run_autoscaler=False here — the dedicated
+    # process_autoscaler loop is the only scaling cadence there, so two
+    # near-simultaneous passes can't each apply a scale step.
+    if run_autoscaler:
+        await process_autoscaler(db, batch=batch)
+
+
+async def process_autoscaler(db: Database, batch: Optional[int] = None) -> None:
+    """The autoscaling pass: converge every autoscaled service's replica count
+    onto its window signals — RPS for ``metric: rps``, p90 latency (TTFT for
+    token streams) + engine queue depth for ``metric: latency``. Decisions are
+    pure (`services/autoscaler.decide`); this pass only gathers signals,
+    enforces the scale delays, and applies the diff under the run lock.
+    Scale-ups insert replica jobs with actor="autoscaler" run_events, which is
+    where cold-start tracking hooks in (services/events)."""
+    from dstack_tpu.server.services import autoscaler as autoscaler_service
+    from dstack_tpu.server.services import proxy as proxy_service
+    from dstack_tpu.server.services.runs import classify_replicas, scale_run_replicas
+
+    rows = await db.fetchall(
+        "SELECT * FROM runs WHERE deleted = 0 AND status IN"
+        " ('submitted', 'provisioning', 'running')"
+        " ORDER BY last_processed_at IS NOT NULL, last_processed_at LIMIT ?",
+        (batch or settings.PROCESS_BATCH_SIZE,),
+    )
+    for run_row in rows:
+        run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+        conf = run_spec.configuration
+        if getattr(conf, "type", None) != "service" or conf.scaling is None:
             continue
         async with get_locker().lock(f"run:{run_row['id']}"):
             job_rows = await db.fetchall(
@@ -1682,12 +1714,20 @@ async def process_services(db: Database, batch: Optional[int] = None) -> None:
             )
             active, _ = classify_replicas(job_rows)
 
-            # Average RPS over the last minute -> target replicas (clamped).
-            import math
-
-            rps = proxy_service.stats.rps(run_row["id"], window=60.0)
-            target = math.ceil(rps / conf.scaling.target)
-            target = min(max(target, conf.replicas.min or 0), conf.replicas.max or 1)
+            quantiles = proxy_service.stats.latency_quantiles(
+                run_row["id"], window=60.0
+            ) or {}
+            sig = autoscaler_service.Signals(
+                rps=proxy_service.stats.rps(run_row["id"], window=60.0),
+                p50=quantiles.get("p50"),
+                p90=quantiles.get("p90"),
+                queue_depth=proxy_service.stats.queue_depth(run_row["id"]),
+                inflight=proxy_service.stats.inflight(run_row["id"]),
+            )
+            target = autoscaler_service.decide(
+                conf.scaling, conf.replicas.min or 0, conf.replicas.max or 1,
+                len(active), sig,
+            )
             diff = target - len(active)
             if diff == 0:
                 continue
@@ -1714,6 +1754,12 @@ async def process_services(db: Database, batch: Optional[int] = None) -> None:
             if diff < 0 and elapsed is not None and elapsed < conf.scaling.scale_down_delay:
                 continue
 
+            logger.info(
+                "autoscaler: %s %d -> %d replicas (rps=%.2f p90=%s queue=%s)",
+                run_row["run_name"], len(active), target, sig.rps,
+                f"{sig.p90:.3f}s" if sig.p90 is not None else "-",
+                sig.queue_depth if sig.queue_depth is not None else "-",
+            )
             await scale_run_replicas(db, run_row, diff)
             await db.execute(
                 "UPDATE runs SET desired_replica_count = ? WHERE id = ?",

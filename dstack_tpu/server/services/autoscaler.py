@@ -1,0 +1,95 @@
+"""Replica-count decisions for autoscaled services.
+
+Two control loops share this module (``background/tasks.process_autoscaler``):
+
+- ``metric: rps`` — the reference's RPS autoscaler (autoscalers.py:60-110):
+  target replicas = ceil(window RPS / per-replica target).
+- ``metric: latency`` — the serving-engine loop: scale on the windowed **p90**
+  latency the proxy records (TTFT for streamed token responses) and on the
+  **engine queue depth** replicas report via ``X-Dstack-Queue-Depth``.
+  Latency over target, or backlog over ``queue_depth_target`` per replica,
+  adds a replica; p90 under ``LATENCY_DOWN_FACTOR * target`` with a drained
+  queue removes one. Step (+-1) scaling, not proportional: latency is a lagging
+  nonlinear signal and a proportional controller on it oscillates.
+
+Both scale to zero when ``replicas.min == 0`` and the window shows no demand,
+and both scale from zero the moment demand appears (``ServiceStats.record``
+counts admitted requests even when no replica is up — that IS the wake
+signal). ``decide`` is pure: every branch is unit-testable from synthetic
+windows without a server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from dstack_tpu.core.models.services import ScalingMetric, ScalingSpec
+
+# Scale down only when p90 sits comfortably under target: between
+# DOWN_FACTOR*target and target is the hysteresis dead band that keeps the
+# controller from flapping around the setpoint.
+LATENCY_DOWN_FACTOR = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class Signals:
+    """One service's windowed demand signals, gathered by the caller from
+    ``proxy.stats`` (all in-memory; the pass touches the DB only to scale)."""
+
+    rps: float = 0.0
+    p50: Optional[float] = None
+    p90: Optional[float] = None
+    queue_depth: Optional[float] = None  # max reported over the gauge window
+    # Requests currently held open through the proxy. A long-running token
+    # stream stops tripping the RPS window after 60s but is still demand —
+    # without this, scale-to-zero would cut live streams.
+    inflight: int = 0
+
+    @property
+    def idle(self) -> bool:
+        return self.rps <= 0.0 and not self.queue_depth and self.inflight <= 0
+
+
+def decide(
+    scaling: ScalingSpec,
+    replicas_min: int,
+    replicas_max: int,
+    active: int,
+    sig: Signals,
+) -> int:
+    """Target replica count for one service (clamped to [min, max])."""
+    if scaling.metric == ScalingMetric.RPS:
+        target = math.ceil(sig.rps / scaling.target)
+        if target == 0 and sig.inflight > 0:
+            # A stream held open longer than the RPS window is still demand:
+            # never scale an rps service to zero out from under it.
+            target = 1
+    else:
+        target = _latency_target(scaling, active, sig)
+    return min(max(target, replicas_min), replicas_max)
+
+
+def _latency_target(scaling: ScalingSpec, active: int, sig: Signals) -> int:
+    if sig.idle:
+        return 0  # no demand in the window: clamp decides (min=0 -> zero)
+    if active == 0:
+        return 1  # demand against zero replicas: wake one up, no delay math
+    per_replica_queue = (sig.queue_depth or 0.0) / max(active, 1)
+    qd_target = scaling.queue_depth_target
+    if (sig.p90 is not None and sig.p90 > scaling.target) or (
+        qd_target is not None and per_replica_queue > qd_target
+    ):
+        return active + 1
+    if (
+        sig.p90 is not None
+        and sig.p90 < LATENCY_DOWN_FACTOR * scaling.target
+        and per_replica_queue <= (qd_target or 1) / 2
+    ):
+        # Comfortable latency shrinks the fleet but never below ONE while
+        # demand is present — zero is reserved for the idle path above, else
+        # a lightly-loaded scale-to-zero service would cycle kill/cold-start
+        # every scale_down_delay.
+        return max(active - 1, 1)
+    return active

@@ -50,6 +50,25 @@ def record_event_tx(
     """Append one event inside an open transaction (sqlite3 connection or the
     postgres adapter — both expose .execute with qmark SQL)."""
     now = now_utc()
+    if job_id is not None and new_status == "running":
+        # Cold-start tracking for autoscaled services: a replica the
+        # autoscaler submitted (scale-up, and especially scale-FROM-ZERO)
+        # reaching `running` closes the loop — observe submit->running into
+        # the cold-start histogram, labeled by whether the service was at
+        # zero (that's the latency a scale-to-zero policy trades away).
+        first_sub = conn.execute(
+            "SELECT timestamp, actor, reason FROM run_events WHERE job_id = ?"
+            " AND new_status = 'submitted' ORDER BY seq LIMIT 1",
+            (job_id,),
+        ).fetchone()
+        if first_sub is not None and first_sub["actor"] == "autoscaler":
+            elapsed = (now - from_iso(first_sub["timestamp"])).total_seconds()
+            if elapsed >= 0:
+                tracing.observe(
+                    "dstack_tpu_service_cold_start_seconds",
+                    elapsed,
+                    {"from_zero": str(first_sub["reason"] == "scale_from_zero").lower()},
+                )
     if job_id is not None and old_status in _PHASE_HISTOGRAMS:
         prev = conn.execute(
             "SELECT timestamp FROM run_events WHERE job_id = ?"

@@ -51,9 +51,18 @@ class ServiceStats:
 
     def __init__(self) -> None:
         self._requests: Dict[str, Deque[float]] = {}
-        # (ts, seconds) per completed proxied request: the autoscaler's future
-        # latency signal (scale on p50/mean latency, not just RPS).
+        # (ts, seconds) per completed proxied request — or TTFT for streamed
+        # responses: the latency autoscaler's signal (p50/p90, not just RPS).
         self._latencies: Dict[str, Deque[Tuple[float, float]]] = {}
+        # (ts, depth) engine-backlog gauge samples, reported by serving
+        # replicas via the X-Dstack-Queue-Depth response header and recorded
+        # by the proxy in-memory (zero DB cost on the hot path).
+        self._queue_depths: Dict[str, Deque[Tuple[float, float]]] = {}
+        # Requests currently being forwarded (held-open SSE streams included):
+        # the demand signal that stops the autoscaler from scaling a service
+        # to zero mid-generation — a long stream leaves no trace in the RPS
+        # window after 60s, but it is very much still demand.
+        self._inflight: Dict[str, int] = {}
         # (run_id, bucket) -> count at last persist; lets each checkpoint write
         # only buckets that changed instead of re-upserting the whole window.
         self.persisted: Dict[Tuple[str, int], int] = {}
@@ -88,6 +97,58 @@ class ServiceStats:
             return None
         return sum(samples) / len(samples)
 
+    def latency_quantiles(
+        self, run_id: str, window: float = 60.0
+    ) -> Optional[Dict[str, float]]:
+        """{"p50", "p90", "mean", "count"} over `window`, or None when no
+        request completed in it — the latency autoscaler's primary signal
+        (p90 catches the tail the mean hides)."""
+        dq = self._latencies.get(run_id)
+        if not dq:
+            return None
+        cutoff = time.monotonic() - window
+        samples = sorted(lat for ts, lat in dq if ts >= cutoff)
+        if not samples:
+            return None
+        from dstack_tpu.utils.common import nearest_rank
+
+        return {
+            "p50": nearest_rank(samples, 0.50),
+            "p90": nearest_rank(samples, 0.90),
+            "mean": sum(samples) / len(samples),
+            "count": len(samples),
+        }
+
+    def record_queue_depth(self, run_id: str, depth: float) -> None:
+        dq = self._queue_depths.setdefault(run_id, collections.deque())
+        dq.append((time.monotonic(), float(depth)))
+        cutoff = time.monotonic() - STATS_WINDOW
+        while dq and dq[0][0] < cutoff:
+            dq.popleft()
+
+    def queue_depth(self, run_id: str, window: float = 30.0) -> Optional[float]:
+        """Max engine queue depth reported over `window` (None = no reports).
+        Max, not mean: a backlog spike is exactly what scale-up must see."""
+        dq = self._queue_depths.get(run_id)
+        if not dq:
+            return None
+        cutoff = time.monotonic() - window
+        samples = [d for ts, d in dq if ts >= cutoff]
+        if not samples:
+            return None
+        return max(samples)
+
+    def record_inflight(self, run_id: str, delta: int) -> None:
+        n = self._inflight.get(run_id, 0) + delta
+        if n <= 0:
+            self._inflight.pop(run_id, None)
+        else:
+            self._inflight[run_id] = n
+
+    def inflight(self, run_id: str) -> int:
+        """Requests currently held open through the proxy for this run."""
+        return self._inflight.get(run_id, 0)
+
     def run_ids(self) -> List[str]:
         """Runs with any window state (requests or latencies) — the public
         surface for exporters; the internal deque layout is not a contract."""
@@ -97,6 +158,8 @@ class ServiceStats:
         """Forget a deleted run's window so per-run state can't grow unbounded."""
         self._requests.pop(run_id, None)
         self._latencies.pop(run_id, None)
+        self._queue_depths.pop(run_id, None)
+        self._inflight.pop(run_id, None)
         for key in [k for k in self.persisted if k[0] == run_id]:
             del self.persisted[key]
         for source_map in self._external.values():
@@ -178,6 +241,8 @@ class ServiceStats:
     def reset(self) -> None:
         self._requests.clear()
         self._latencies.clear()
+        self._queue_depths.clear()
+        self._inflight.clear()
         self.persisted.clear()
         self._external.clear()
 
@@ -346,6 +411,7 @@ def forget_run(run_id: str, run_name: Optional[str] = None) -> None:
         tracing.drop_series(
             "dstack_tpu_service_request_latency_seconds", {"run": run_name}
         )
+        tracing.drop_series("dstack_tpu_service_ttft_seconds", {"run": run_name})
 
 
 async def resolve_route(db: Database, project_name: str, run_name: str) -> RouteEntry:
@@ -560,17 +626,35 @@ async def proxy_request(
     from dstack_tpu.core.services.http_forward import forward
 
     t0 = time.monotonic()
+
+    def _on_first_chunk(upstream) -> None:
+        # Streamed/SSE responses: the first body chunk is the first token —
+        # record TTFT as the latency sample (the full stream duration would
+        # poison the autoscaler signal) plus the engine backlog it reported.
+        elapsed = time.monotonic() - t0
+        stats.record_latency(entry.run_id, elapsed)
+        tracing.observe(
+            "dstack_tpu_service_ttft_seconds", elapsed, {"run": run_name}
+        )
+        _record_queue_depth(entry.run_id, upstream.headers)
+
+    stats.record_inflight(entry.run_id, +1)
     try:
-        resp = await forward(request, host, local_port, tail, body=body)
+        resp = await forward(
+            request, host, local_port, tail, body=body,
+            on_first_chunk=_on_first_chunk,
+        )
     except web.HTTPBadGateway:
         # A cached endpoint went dark (replica died, tunnel dropped): rebuild
         # the route on the next request instead of pinning traffic to it.
         route_table.invalidate(*entry.key)
         raise
+    finally:
+        stats.record_inflight(entry.run_id, -1)
     if isinstance(resp, web.Response):
         # Buffered (known-length) responses only: for streamed/SSE output
-        # forward() returns after the WHOLE stream, and a 120s held-open
-        # completion would poison the mean-latency autoscaler signal.
+        # forward() returns after the WHOLE stream — TTFT was recorded by the
+        # first-chunk hook above instead.
         elapsed = time.monotonic() - t0
         stats.record_latency(entry.run_id, elapsed)
         # Latency distribution for /metrics (fixed-bucket histogram, rendered
@@ -579,4 +663,20 @@ async def proxy_request(
         tracing.observe(
             "dstack_tpu_service_request_latency_seconds", elapsed, {"run": run_name}
         )
+        _record_queue_depth(entry.run_id, resp.headers)
     return resp
+
+
+QUEUE_DEPTH_HEADER = "X-Dstack-Queue-Depth"
+
+
+def _record_queue_depth(run_id: str, headers) -> None:
+    """Serving replicas report engine backlog on every response; an absent or
+    malformed header is simply not a sample."""
+    raw = headers.get(QUEUE_DEPTH_HEADER)
+    if raw is None:
+        return
+    try:
+        stats.record_queue_depth(run_id, float(raw))
+    except (TypeError, ValueError):
+        pass

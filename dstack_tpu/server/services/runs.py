@@ -519,13 +519,18 @@ def classify_replicas(job_rows) -> Tuple[List[Tuple[int, int, List]], List[Tuple
     return active, inactive
 
 
-async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
+async def scale_run_replicas(
+    db: Database, run_row, diff: int, actor: str = "autoscaler"
+) -> None:
     """Add (+diff) or remove (-diff) service replicas.
 
     Scale-down marks the least-important replicas' jobs TERMINATING with reason
     SCALED_DOWN (the run FSM ignores such replicas); scale-up resubmits inactive
     replicas first, then mints new replica_nums. Inserts are per-replica-atomic
-    like the gang-retry path."""
+    like the gang-retry path. `actor` labels the run_events rows — manual
+    replica changes (update_run) must not masquerade as autoscaler actions,
+    and only autoscaler scale-ups feed the cold-start histogram
+    (services/events)."""
     if diff == 0:
         return
     job_rows = await db.fetchall("SELECT * FROM jobs WHERE run_id = ?", (run_row["id"],))
@@ -542,12 +547,21 @@ async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
         for _, _, rows in reversed(active[diff:]):
             for r in rows:
                 await terminate_job(
-                    db, r, JobTerminationReason.SCALED_DOWN, "scaled down by autoscaler"
+                    db, r, JobTerminationReason.SCALED_DOWN,
+                    f"scaled down by {actor}", actor=actor,
                 )
     else:
         now = to_iso(now_utc())
         scheduled = 0
         used_nums = set(_latest_by_replica(job_rows))
+        # Scale-from-zero is its own event flavor: the elapsed time from this
+        # event to the replica's `running` is the service's COLD START — the
+        # number a scale-to-zero policy is judged by (services/events observes
+        # it into dstack_tpu_service_cold_start_seconds, autoscaler actor only).
+        if actor == "autoscaler":
+            scale_reason = "scale_from_zero" if not active else "scaled_up"
+        else:
+            scale_reason = "manual_scale"
 
         async def _insert_replica(replica_num: int, specs, submission_num: int) -> None:
             from dstack_tpu.server.services import events as events_service
@@ -577,7 +591,7 @@ async def scale_run_replicas(db: Database, run_row, diff: int) -> None:
                 for r in rows:
                     events_service.record_event_tx(
                         conn, run_row["id"], "submitted", job_id=r[0],
-                        actor="autoscaler", reason="scaled_up",
+                        actor=actor, reason=scale_reason,
                     )
 
             await db.run(_tx)
@@ -675,7 +689,7 @@ async def update_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
         job_rows = await db.fetchall("SELECT * FROM jobs WHERE run_id = ?", (row["id"],))
         active, _ = classify_replicas(job_rows)
         if target != len(active):
-            await scale_run_replicas(db, row, target - len(active))
+            await scale_run_replicas(db, row, target - len(active), actor="user")
         await db.execute(
             "UPDATE runs SET desired_replica_count = ? WHERE id = ?", (target, row["id"])
         )

@@ -46,6 +46,9 @@ PROCESS_VOLUMES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_VOLUMES_INTERVAL"
 PROCESS_GATEWAYS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_GATEWAYS_INTERVAL", "5.0"))
 PROCESS_METRICS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_METRICS_INTERVAL", "10.0"))
 PROCESS_SERVICES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_SERVICES_INTERVAL", "5.0"))
+# The autoscaling decision pass runs tighter than the probe pass: latency
+# spikes and scale-from-zero wakeups should not wait out a 5s probe loop.
+PROCESS_AUTOSCALER_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_AUTOSCALER_INTERVAL", "2.0"))
 PROCESS_BATCH_SIZE = int(os.getenv("DSTACK_TPU_PROCESS_BATCH_SIZE", "10"))
 METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL", "3600"))
 

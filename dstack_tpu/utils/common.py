@@ -21,10 +21,24 @@ def to_iso(dt: Optional[datetime.datetime]) -> Optional[str]:
 def from_iso(s: Optional[str]) -> Optional[datetime.datetime]:
     if s is None:
         return None
+    # Python < 3.11 fromisoformat rejects the RFC 3339 'Z' suffix, which is
+    # exactly what pydantic's JSON serializer emits — clients echoing our own
+    # timestamps back (keyset-pagination cursors) must round-trip.
+    if isinstance(s, str) and s.endswith(("Z", "z")):
+        s = s[:-1] + "+00:00"
     dt = datetime.datetime.fromisoformat(s)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=datetime.timezone.utc)
     return dt
+
+
+def nearest_rank(sorted_samples, q: float):
+    """Nearest-rank percentile over an ascending list (q in [0, 1]); the one
+    definition shared by the autoscaler's latency window and the serve bench
+    so their p50/p90/p99 never silently diverge. None for an empty list."""
+    if not sorted_samples:
+        return None
+    return sorted_samples[min(len(sorted_samples) - 1, int(q * len(sorted_samples)))]
 
 
 def pretty_resources_duration(seconds: float) -> str:

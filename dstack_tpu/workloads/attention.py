@@ -226,6 +226,42 @@ def blockwise_attention(
     return _finalize(state)
 
 
+def paged_decode_attention(
+    q: jax.Array,        # [S, H, D] — ONE query per decode slot
+    k_pages: jax.Array,  # [N, page, Kh, D] — one layer's page pool
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [S, P] int32 page ids into the pool
+    kv_lens: jax.Array,     # [S] valid KV length per slot (past + current token)
+) -> jax.Array:
+    """Single-query attention over a paged KV cache (the decode half of a
+    continuous-batching engine; serve.py). Each slot gathers its own pages —
+    sequences share the pool but never each other's pages — then runs a
+    masked softmax over its valid prefix. Returns fp32 [S, H, D].
+
+    Slots with kv_lens == 0 (inactive) produce finite garbage (uniform
+    weights over masked scores), never NaN; the engine discards those rows.
+    """
+    s, p = page_table.shape
+    n, page, kh, d = k_pages.shape
+    k = k_pages[page_table].reshape(s, p * page, kh, d)
+    v = v_pages[page_table].reshape(s, p * page, kh, d)
+    n_rep = q.shape[1] // kh
+    if n_rep > 1:
+        k = jnp.broadcast_to(
+            k[:, :, :, None, :], (s, p * page, kh, n_rep, d)
+        ).reshape(s, p * page, kh * n_rep, d)
+        v = jnp.broadcast_to(
+            v[:, :, :, None, :], (s, p * page, kh, n_rep, d)
+        ).reshape(s, p * page, kh * n_rep, d)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    scores = jnp.einsum("shd,sthd->sht", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    valid = jnp.arange(p * page)[None, :] < kv_lens[:, None]  # [S, T]
+    scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("sht,sthd->shd", weights, v.astype(jnp.float32))
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,

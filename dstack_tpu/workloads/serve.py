@@ -1,0 +1,815 @@
+"""Continuous-batching inference engine over the llama workload.
+
+The serving half of the north star ("serve millions of users"): an in-flight
+batching token loop in the vLLM/JetStream mold, built on the repo's own model
+stack —
+
+- **Paged KV cache**: one page pool per layer (``[N_pages, page, Kh, D]``);
+  each request owns a page table of pool indices, so sequences of wildly
+  different lengths share HBM without reserving max_seq_len each. Pages are
+  allocated on demand as decode crosses page boundaries and returned to the
+  free list the step a request finishes.
+- **Prefill/decode split**: new requests' prompts run as a separate batched
+  prefill (blockwise/flash-style attention from ``attention.py``, KV scattered
+  into their pages), while the running decode batch advances one token per
+  step through a single-query paged-attention path
+  (``attention.paged_decode_attention``).
+- **Per-step admission**: every engine step first admits queued requests into
+  free decode slots (pages permitting), so short requests drain out and new
+  ones slide in without ever stalling the batch — the continuous-batching win
+  over static batching that ``bench.py bench_serve`` measures.
+- **Streaming**: tokens are emitted per step; the aiohttp app turns them into
+  SSE events that ride the proxy's unbuffered pass-through (PR 2) to clients.
+
+Everything runs under ``JAX_PLATFORMS=cpu`` (tests/bench: 1 device, tiny
+config); on TPU the same jitted prefill/decode functions land on the chip.
+Decoding is greedy (argmax) — deterministic, which is what makes the
+continuous-vs-sequential token-equivalence test meaningful.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import json
+import logging
+import threading
+import time
+from typing import Callable, Deque, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads.attention import blockwise_attention, paged_decode_attention
+from dstack_tpu.workloads.config import LlamaConfig, get_config
+
+logger = logging.getLogger(__name__)
+
+_LAYER_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine knobs, orthogonal to the model config (LlamaConfig)."""
+
+    page_size: int = 16        # tokens per KV page
+    num_pages: int = 256       # pool size, shared by all slots (per layer)
+    max_batch: int = 8         # decode slots = max in-flight sequences
+    max_seq: int = 0           # page-table width in tokens (0 = cfg.max_seq_len)
+    # "continuous" admits into any free slot every step; "static" only admits
+    # when the whole batch has drained (the classic static-batching baseline
+    # bench_serve compares against).
+    policy: str = "continuous"
+    eos_id: Optional[int] = None
+    max_new_default: int = 16
+
+
+class TokenEvent(NamedTuple):
+    req_id: str
+    token: int
+    index: int   # 0-based position in the generated sequence
+    done: bool
+
+
+@dataclasses.dataclass
+class GenRequest:
+    req_id: str
+    prompt: List[int]          # tokens prefilled on (re)admission
+    max_new_tokens: int
+    eos_id: Optional[int]
+    submitted_t: float = 0.0
+    tokens: List[int] = dataclasses.field(default_factory=list)  # generated
+    done: bool = False
+    preemptions: int = 0
+    # Generated tokens already folded into `prompt` by earlier preemptions —
+    # the resume prompt must append only tokens[absorbed:], or a second
+    # preemption would duplicate the first one's tokens into the context.
+    absorbed: int = 0
+
+
+def _rope_single(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding for one token per row: x [S,H,D], positions [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def make_prefill_fn(cfg: LlamaConfig):
+    """jit'd (params, tokens, k_pages, v_pages, write_page, write_off, lens)
+    -> (next_tokens, k_pages, v_pages). Memoized on the (frozen) config so
+    every engine over the same model shares one jit cache — bench variants
+    don't re-compile per engine.
+
+    tokens [B, T] right-padded prompts; write_page/write_off [B, T] map each
+    token position into the page pool (pool-size index = dropped write, which
+    is how padding — and padded batch rows — never touch the cache); lens [B]
+    true prompt lengths. Runs the same blockwise causal attention as training
+    forward(); returns the greedy next token after each prompt's LAST valid
+    position. Cache buffers are donated: the update is in-place on device.
+    """
+
+    def prefill(params, tokens, k_pages, v_pages, write_page, write_off, lens):
+        adt = jnp.dtype(cfg.dtype)
+        b, t = tokens.shape
+        hd, h, kh = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        x = params["embed"].astype(adt)[tokens]  # [B,T,D]
+        positions = jnp.arange(t)
+
+        def block(x, xs):
+            layer, kp, vp = xs
+            h_in = model_lib._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+            q = jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt)
+            k = jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt)
+            v = jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt)
+            q = q.reshape(b, t, h, hd)
+            k = k.reshape(b, t, kh, hd)
+            v = v.reshape(b, t, kh, hd)
+            q = model_lib._rope(q, positions, cfg.rope_theta)
+            k = model_lib._rope(k, positions, cfg.rope_theta)
+            kp = kp.at[write_page, write_off].set(k.astype(kp.dtype), mode="drop")
+            vp = vp.at[write_page, write_off].set(v.astype(vp.dtype), mode="drop")
+            o = blockwise_attention(q, k, v, causal=True)
+            o = o.astype(adt).reshape(b, t, h * hd)
+            attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
+                                  preferred_element_type=jnp.float32).astype(adt)
+            x = x + attn_out
+            h2 = model_lib._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+            gate = jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
+                              preferred_element_type=jnp.float32).astype(adt)
+            up = jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
+                            preferred_element_type=jnp.float32).astype(adt)
+            hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
+            mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
+                                 preferred_element_type=jnp.float32).astype(adt)
+            return x + mlp_out, (kp, vp)
+
+        layer_params = {key: params[key] for key in _LAYER_KEYS}
+        x, (k_pages, v_pages) = jax.lax.scan(
+            block, x, (layer_params, k_pages, v_pages)
+        )
+        x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
+        last_idx = jnp.clip(lens - 1, 0, t - 1)
+        last = x[jnp.arange(b), last_idx]  # [B, D]
+        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"].astype(adt),
+                            preferred_element_type=jnp.float32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+
+    return jax.jit(prefill, donate_argnums=(2, 3))
+
+
+@functools.lru_cache(maxsize=None)
+def make_decode_fn(cfg: LlamaConfig):
+    """jit'd single-token decode over the paged cache (memoized on config):
+    (params, last_tokens, positions, k_pages, v_pages, page_tables,
+     write_page, write_off) -> (next_tokens, k_pages, v_pages).
+
+    One query per slot: the last emitted token (position = tokens stored so
+    far) has its K/V appended to the slot's current page, then attends over
+    the slot's whole paged prefix. Inactive slots ride along with dropped
+    writes and garbage-but-finite outputs (fixed [max_batch] shape = one
+    compilation for the engine's whole life).
+    """
+
+    def decode(params, last_tokens, positions, k_pages, v_pages, page_tables,
+               write_page, write_off):
+        adt = jnp.dtype(cfg.dtype)
+        s = last_tokens.shape[0]
+        hd, h, kh = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        x = params["embed"].astype(adt)[last_tokens]  # [S, D]
+
+        def block(x, xs):
+            layer, kp, vp = xs
+            h_in = model_lib._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+            q = jnp.einsum("sd,dk->sk", h_in, layer["wq"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt)
+            k = jnp.einsum("sd,dk->sk", h_in, layer["wk"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt)
+            v = jnp.einsum("sd,dk->sk", h_in, layer["wv"].astype(adt),
+                           preferred_element_type=jnp.float32).astype(adt)
+            q = _rope_single(q.reshape(s, h, hd), positions, cfg.rope_theta)
+            k = _rope_single(k.reshape(s, kh, hd), positions, cfg.rope_theta)
+            v = v.reshape(s, kh, hd)
+            kp = kp.at[write_page, write_off].set(k.astype(kp.dtype), mode="drop")
+            vp = vp.at[write_page, write_off].set(v.astype(vp.dtype), mode="drop")
+            o = paged_decode_attention(q, kp, vp, page_tables, positions + 1)
+            attn_out = jnp.einsum("sk,kd->sd", o.astype(adt).reshape(s, h * hd),
+                                  layer["wo"].astype(adt),
+                                  preferred_element_type=jnp.float32).astype(adt)
+            x = x + attn_out
+            h2 = model_lib._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+            gate = jnp.einsum("sd,df->sf", h2, layer["w_gate"].astype(adt),
+                              preferred_element_type=jnp.float32).astype(adt)
+            up = jnp.einsum("sd,df->sf", h2, layer["w_up"].astype(adt),
+                            preferred_element_type=jnp.float32).astype(adt)
+            hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
+            mlp_out = jnp.einsum("sf,fd->sd", hidden, layer["w_down"].astype(adt),
+                                 preferred_element_type=jnp.float32).astype(adt)
+            return x + mlp_out, (kp, vp)
+
+        layer_params = {key: params[key] for key in _LAYER_KEYS}
+        x, (k_pages, v_pages) = jax.lax.scan(
+            block, x, (layer_params, k_pages, v_pages)
+        )
+        x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("sd,dv->sv", x, params["lm_head"].astype(adt),
+                            preferred_element_type=jnp.float32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+
+    return jax.jit(decode, donate_argnums=(3, 4))
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    """Smallest power of two >= n (min lo): bounds the number of distinct
+    prefill shapes XLA ever compiles."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServeEngine:
+    """Host-side continuous-batching loop over the jitted prefill/decode fns.
+
+    Not thread-safe except for ``submit``/gauge reads (``EngineRunner`` is the
+    one caller of ``step``). All scheduling state — free pages, page tables,
+    slot occupancy — lives on the host; the device only ever sees fixed-shape
+    batches, so the engine compiles one decode program plus a handful of
+    bucketed prefill shapes.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        engine_cfg: Optional[EngineConfig] = None,
+        params: Optional[dict] = None,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.ecfg = engine_cfg or EngineConfig()
+        if self.ecfg.policy not in ("continuous", "static"):
+            raise ValueError(f"unknown scheduling policy {self.ecfg.policy!r}")
+        self.params = params if params is not None else model_lib.init_params(
+            cfg, jax.random.PRNGKey(seed)
+        )
+        self._prefill_fn = make_prefill_fn(cfg)
+        self._decode_fn = make_decode_fn(cfg)
+
+        page, pool = self.ecfg.page_size, self.ecfg.num_pages
+        max_seq = self.ecfg.max_seq or cfg.max_seq_len
+        self.max_seq = max_seq
+        self.table_width = -(-max_seq // page)  # pages per sequence, ceil
+        shape = (cfg.n_layers, pool, page, cfg.n_kv_heads, cfg.head_dim)
+        cache_dtype = jnp.dtype(cfg.dtype)
+        self.k_pages = jnp.zeros(shape, cache_dtype)
+        self.v_pages = jnp.zeros(shape, cache_dtype)
+
+        self._free: List[int] = list(range(pool))
+        mb = self.ecfg.max_batch
+        self.page_tables = np.zeros((mb, self.table_width), np.int32)
+        self.seq_lens = np.zeros(mb, np.int64)       # KV positions stored
+        self.last_tokens = np.zeros(mb, np.int32)    # last emitted token
+        self.slots: List[Optional[GenRequest]] = [None] * mb
+        self.slot_pages: List[List[int]] = [[] for _ in range(mb)]
+
+        self.pending: Deque[GenRequest] = collections.deque()
+        self._lock = threading.Lock()
+        self._req_counter = 0
+        # Cumulative counters for /stats and bench extras.
+        self.total_steps = 0
+        self.total_tokens = 0
+        self.total_finished = 0
+        self.total_preemptions = 0
+
+    # -- submission (thread-safe) -----------------------------------------
+
+    def submit(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        req_id: Optional[str] = None,
+    ) -> GenRequest:
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        max_new = max_new_tokens or self.ecfg.max_new_default
+        if len(prompt_tokens) + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt_tokens)}) + max_new_tokens ({max_new})"
+                f" exceeds the engine's max_seq {self.max_seq}"
+            )
+        need = -(-(len(prompt_tokens) + max_new) // self.ecfg.page_size)
+        if need > self.ecfg.num_pages:
+            raise ValueError("request larger than the whole page pool")
+        with self._lock:
+            if req_id is None:
+                self._req_counter += 1
+                req_id = f"req-{self._req_counter}"
+            req = GenRequest(
+                req_id=req_id,
+                prompt=list(prompt_tokens),
+                max_new_tokens=max_new,
+                eos_id=eos_id if eos_id is not None else self.ecfg.eos_id,
+                submitted_t=time.monotonic(),
+            )
+            self.pending.append(req)
+        return req
+
+    # -- gauges ------------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.pending)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or self.active_count > 0
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "queue_depth": self.queue_depth,
+            "active": self.active_count,
+            "free_pages": self.free_pages,
+            "total_pages": self.ecfg.num_pages,
+            "max_batch": self.ecfg.max_batch,
+            "steps": self.total_steps,
+            "generated_tokens": self.total_tokens,
+            "finished_requests": self.total_finished,
+            "preemptions": self.total_preemptions,
+            "policy": self.ecfg.policy,
+        }
+
+    # -- the step loop -----------------------------------------------------
+
+    def step(self) -> List[TokenEvent]:
+        """One engine iteration: admit -> batched prefill -> one decode step.
+        Returns the tokens emitted this step, in emission order."""
+        events: List[TokenEvent] = []
+        admitted = self._admit()
+        if admitted:
+            self._run_prefill(admitted, events)
+        if self.active_count:
+            self._run_decode(events)
+        self.total_steps += 1
+        return events
+
+    def _pages_for(self, tokens: int) -> int:
+        return -(-tokens // self.ecfg.page_size)
+
+    def _admit(self) -> List[Tuple[int, GenRequest]]:
+        """Move queued requests into free slots (FIFO, head-of-line blocking
+        when pages are short — admission order is completion-signal order).
+        Static policy: only admit into an EMPTY batch."""
+        if self.ecfg.policy == "static" and self.active_count:
+            return []
+        admitted: List[Tuple[int, GenRequest]] = []
+        free_slots = [i for i, r in enumerate(self.slots) if r is None]
+        while free_slots:
+            with self._lock:
+                if not self.pending:
+                    break
+                req = self.pending[0]
+                # Reserve the prompt plus one decode page of headroom; growth
+                # beyond that allocates on demand (preempting if dry).
+                need = self._pages_for(len(req.prompt) + 1)
+                if need > len(self._free):
+                    break
+                self.pending.popleft()
+            slot = free_slots.pop(0)
+            pages = [self._free.pop() for _ in range(need)]
+            self.slot_pages[slot] = pages
+            row = self.page_tables[slot]
+            row[:] = 0
+            row[: len(pages)] = pages
+            self.seq_lens[slot] = 0
+            self.slots[slot] = req
+            admitted.append((slot, req))
+        return admitted
+
+    def _run_prefill(
+        self, admitted: List[Tuple[int, GenRequest]], events: List[TokenEvent]
+    ) -> None:
+        page = self.ecfg.page_size
+        pool = self.ecfg.num_pages
+        t_pad = _bucket(max(len(req.prompt) for _, req in admitted))
+        b_pad = _bucket(len(admitted), lo=1)
+        tokens = np.zeros((b_pad, t_pad), np.int32)
+        lens = np.zeros(b_pad, np.int32)
+        # pool-sized page index = out-of-bounds = dropped write: padding (and
+        # padded batch rows) never lands in the cache.
+        write_page = np.full((b_pad, t_pad), pool, np.int32)
+        write_off = np.zeros((b_pad, t_pad), np.int32)
+        for i, (slot, req) in enumerate(admitted):
+            n = len(req.prompt)
+            tokens[i, :n] = req.prompt
+            lens[i] = n
+            pos = np.arange(n)
+            pages = np.asarray(self.slot_pages[slot], np.int32)
+            write_page[i, :n] = pages[pos // page]
+            write_off[i, :n] = pos % page
+
+        next_tokens, self.k_pages, self.v_pages = self._prefill_fn(
+            self.params, jnp.asarray(tokens), self.k_pages, self.v_pages,
+            jnp.asarray(write_page), jnp.asarray(write_off), jnp.asarray(lens),
+        )
+        next_tokens = np.asarray(next_tokens)
+        for i, (slot, req) in enumerate(admitted):
+            self.seq_lens[slot] = len(req.prompt)
+            self._emit(slot, req, int(next_tokens[i]), events)
+
+    def _run_decode(self, events: List[TokenEvent]) -> None:
+        page = self.ecfg.page_size
+        pool = self.ecfg.num_pages
+        mb = self.ecfg.max_batch
+        self._ensure_decode_pages()
+        write_page = np.full(mb, pool, np.int32)
+        write_off = np.zeros(mb, np.int32)
+        active = []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            pos = int(self.seq_lens[slot])
+            write_page[slot] = self.page_tables[slot, pos // page]
+            write_off[slot] = pos % page
+            active.append(slot)
+        if not active:
+            return
+
+        next_tokens, self.k_pages, self.v_pages = self._decode_fn(
+            self.params,
+            jnp.asarray(self.last_tokens),
+            jnp.asarray(self.seq_lens, dtype=jnp.int32),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(self.page_tables),
+            jnp.asarray(write_page),
+            jnp.asarray(write_off),
+        )
+        next_tokens = np.asarray(next_tokens)
+        for slot in active:
+            req = self.slots[slot]
+            self.seq_lens[slot] += 1  # the last token's KV just landed
+            self._emit(slot, req, int(next_tokens[slot]), events)
+
+    def _ensure_decode_pages(self) -> None:
+        """Every active slot about to write position seq_len needs page
+        seq_len // page_size allocated; a dry pool preempts the youngest
+        request (fewest generated tokens) back to the queue — its pages fund
+        the older requests, and it re-prefills later from prompt + generated
+        so no emitted token is ever lost."""
+        page = self.ecfg.page_size
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            need_idx = int(self.seq_lens[slot]) // page
+            while need_idx >= len(self.slot_pages[slot]):
+                if not self._free:
+                    victim = self._pick_victim(exclude=slot)
+                    if victim is None:
+                        # Nothing to steal from: this slot itself is the
+                        # youngest; requeue it.
+                        self._preempt(slot)
+                        break
+                    self._preempt(victim)
+                    continue
+                new_page = self._free.pop()
+                self.slot_pages[slot].append(new_page)
+                self.page_tables[slot, len(self.slot_pages[slot]) - 1] = new_page
+            # If this slot was itself preempted, move on.
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        candidates = [
+            (len(req.tokens), slot)
+            for slot, req in enumerate(self.slots)
+            if req is not None and slot != exclude
+        ]
+        if not candidates:
+            return None
+        return min(candidates)[1]
+
+    def _preempt(self, slot: int) -> None:
+        req = self.slots[slot]
+        logger.info(
+            "engine: preempting %s (%d generated) — page pool dry",
+            req.req_id, len(req.tokens),
+        )
+        req.preemptions += 1
+        self.total_preemptions += 1
+        # Resume prompt carries everything decoded so far (but each generated
+        # token exactly once, however many times this request is preempted);
+        # re-admission prefills it and the next emitted token is genuinely new.
+        req.prompt = req.prompt + req.tokens[req.absorbed:]
+        req.absorbed = len(req.tokens)
+        self._release_slot(slot)
+        with self._lock:
+            self.pending.appendleft(req)
+
+    def _emit(
+        self, slot: int, req: GenRequest, token: int, events: List[TokenEvent]
+    ) -> None:
+        req.tokens.append(token)
+        self.total_tokens += 1
+        done = (
+            len(req.tokens) >= req.max_new_tokens
+            or (req.eos_id is not None and token == req.eos_id)
+        )
+        events.append(TokenEvent(req.req_id, token, len(req.tokens) - 1, done))
+        if done:
+            req.done = True
+            self.total_finished += 1
+            self._release_slot(slot)
+        else:
+            self.last_tokens[slot] = token
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.extend(self.slot_pages[slot])
+        self.slot_pages[slot] = []
+        self.page_tables[slot, :] = 0
+        self.seq_lens[slot] = 0
+        self.last_tokens[slot] = 0
+        self.slots[slot] = None
+
+
+# ---------------------------------------------------------------------------
+# Reference decoding (tests): full-context greedy decode, no cache.
+
+
+def greedy_reference_decode(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: List[int],
+    max_new_tokens: int,
+    eos_id: Optional[int] = None,
+) -> List[int]:
+    """O(T^2) greedy decode re-running the full forward per token — the
+    ground truth the paged engine must match token for token."""
+    toks = list(prompt)
+    out: List[int] = []
+    for _ in range(max_new_tokens):
+        logits = model_lib.forward(params, jnp.asarray([toks], jnp.int32), cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+        toks.append(nxt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Byte-level "tokenizer" for the HTTP surface: the engine serves synthetic
+# weights, so the contract is tokens in/tokens out; text is a convenience.
+
+
+def encode_text(text: str, vocab_size: int) -> List[int]:
+    return [b % vocab_size for b in text.encode("utf-8")] or [0]
+
+
+def decode_token(token: int) -> str:
+    return chr(token) if 0x20 <= token < 0x7F else ""
+
+
+# ---------------------------------------------------------------------------
+# Engine thread + aiohttp app (the runnable service behind the proxy).
+
+
+class EngineRunner(threading.Thread):
+    """Owns the step loop on a background thread; bridges token events into
+    per-request asyncio queues on the server's event loop. JAX compute blocks,
+    so it must not run on the event loop — the classic host-scheduling/device-
+    step overlap: while the device decodes, the loop streams tokens out."""
+
+    def __init__(self, engine: ServeEngine, idle_wait: float = 0.05) -> None:
+        super().__init__(name="serve-engine", daemon=True)
+        self.engine = engine
+        self.idle_wait = idle_wait
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._subs: Dict[str, Callable[[TokenEvent], None]] = {}
+        self._subs_lock = threading.Lock()
+        self._sub_counter = 0
+
+    def submit(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: Optional[int],
+        on_event: Callable[[TokenEvent], None],
+    ) -> GenRequest:
+        """Register a per-token callback (invoked on the ENGINE thread; wrap
+        with loop.call_soon_threadsafe for asyncio consumers) and enqueue.
+        The callback is registered BEFORE the engine sees the request — the
+        step loop runs on another thread and could otherwise emit the first
+        token into the void."""
+        with self._subs_lock:
+            self._sub_counter += 1
+            req_id = f"http-{self._sub_counter}"
+            self._subs[req_id] = on_event
+        try:
+            req = self.engine.submit(prompt_tokens, max_new_tokens, req_id=req_id)
+        except Exception:
+            with self._subs_lock:
+                self._subs.pop(req_id, None)
+            raise
+        self._wake.set()
+        return req
+
+    def step_once(self) -> None:
+        """One engine step + event dispatch (run()'s body; tests gate on it)."""
+        try:
+            events = self.engine.step()
+        except Exception:
+            logger.exception("engine step failed")
+            return
+        for ev in events:
+            with self._subs_lock:
+                callback = self._subs.get(ev.req_id)
+                if ev.done and callback is not None:
+                    del self._subs[ev.req_id]
+            if callback is not None:
+                try:
+                    callback(ev)
+                except Exception:
+                    logger.exception("token subscriber failed")
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if not self.engine.has_work():
+                self._wake.wait(self.idle_wait)
+                self._wake.clear()
+                continue
+            self.step_once()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+
+def create_serve_app(runner: EngineRunner):
+    """aiohttp app: POST /generate (SSE token stream or buffered JSON),
+    GET /stats (engine gauges — what the autoscaler's queue-depth signal
+    reads), GET /health. Every response carries X-Dstack-Queue-Depth so the
+    in-server proxy can record engine backlog without a single extra hop."""
+    import asyncio
+
+    from aiohttp import web
+
+    engine = runner.engine
+
+    def qd_headers() -> dict:
+        return {"X-Dstack-Queue-Depth": str(engine.queue_depth)}
+
+    async def generate(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(text="body must be JSON")
+        tokens = body.get("prompt_tokens")
+        if tokens is None:
+            tokens = encode_text(str(body.get("prompt", "")), engine.cfg.vocab_size)
+        if not isinstance(tokens, list) or not all(
+            isinstance(t, int) and 0 <= t < engine.cfg.vocab_size for t in tokens
+        ):
+            raise web.HTTPBadRequest(text="prompt_tokens must be valid token ids")
+        max_new = body.get("max_tokens")
+        if max_new is not None and (
+            not isinstance(max_new, int) or isinstance(max_new, bool)
+            or max_new < 1
+        ):
+            raise web.HTTPBadRequest(text="max_tokens must be a positive integer")
+        stream = bool(body.get("stream", True))
+
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def on_event(ev: TokenEvent) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, ev)
+
+        try:
+            runner.submit(tokens, max_new, on_event)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+
+        if not stream:
+            out: List[int] = []
+            while True:
+                ev = await queue.get()
+                out.append(ev.token)
+                if ev.done:
+                    break
+            return web.json_response(
+                {"tokens": out, "text": "".join(decode_token(t) for t in out)},
+                headers=qd_headers(),
+            )
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-store",
+                **qd_headers(),
+            }
+        )
+        await resp.prepare(request)
+        # Nothing is written until the first token lands: the first SSE chunk
+        # through the proxy IS time-to-first-token.
+        while True:
+            ev = await queue.get()
+            payload = {"token": ev.token, "index": ev.index,
+                       "text": decode_token(ev.token)}
+            await resp.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
+            if ev.done:
+                break
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    async def stats(request: web.Request) -> web.Response:
+        return web.json_response(engine.stats(), headers=qd_headers())
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"}, headers=qd_headers())
+
+    app = web.Application()
+    app.router.add_post("/generate", generate)
+    app.router.add_get("/stats", stats)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main() -> None:
+    """``python -m dstack_tpu.workloads.serve`` — the runnable serving
+    entrypoint (examples/serve-llama.dstack.yml). Binds DSTACK_SERVICE_PORT
+    (the control plane's contract) unless --port says otherwise."""
+    import argparse
+    import os
+
+    from aiohttp import web
+
+    from dstack_tpu.workloads import xla_flags
+    from dstack_tpu.workloads.config import PRESETS
+
+    applied = xla_flags.apply()
+    if applied:
+        print(f"overlap XLA defaults applied: {applied['XLA_FLAGS']}", flush=True)
+
+    parser = argparse.ArgumentParser(prog="dstack_tpu.workloads.serve")
+    parser.add_argument("--config", default="test", choices=sorted(PRESETS))
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("DSTACK_SERVICE_PORT", "8000")))
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--pages", type=int, default=512,
+                        help="KV page pool size (per layer)")
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="decode slots (max in-flight sequences)")
+    parser.add_argument("--max-new", type=int, default=64,
+                        help="default max_tokens when a request names none")
+    parser.add_argument("--policy", default="continuous",
+                        choices=["continuous", "static"])
+    args = parser.parse_args()
+
+    cfg = get_config(args.config)
+    engine = ServeEngine(
+        cfg,
+        EngineConfig(
+            page_size=args.page_size,
+            num_pages=args.pages,
+            max_batch=args.max_batch,
+            max_new_default=args.max_new,
+            policy=args.policy,
+        ),
+    )
+    runner = EngineRunner(engine)
+    runner.start()
+    print(
+        f"serving config={args.config} on :{args.port} "
+        f"(pages={args.pages}x{args.page_size}, slots={args.max_batch}, "
+        f"policy={args.policy})",
+        flush=True,
+    )
+    try:
+        web.run_app(create_serve_app(runner), host="0.0.0.0", port=args.port,
+                    print=None)
+    finally:
+        runner.shutdown()
+
+
+if __name__ == "__main__":
+    main()

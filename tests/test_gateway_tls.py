@@ -8,7 +8,6 @@ domain gets a cert and the HTTPS listener serves it under SNI (VERDICT #6)."""
 
 import asyncio
 import base64
-import datetime
 import hashlib
 import json
 import socket
@@ -18,6 +17,7 @@ import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestServer
 
+from dstack_tpu.gateway import minicrypto
 from dstack_tpu.gateway.app import create_app
 from dstack_tpu.gateway.tls import CertStore, self_signed_cert
 from dstack_tpu.gateway.tls_manager import TlsManager
@@ -31,47 +31,13 @@ class TestCa:
     """In-test CA that signs CSRs (what the fake ACME finalize uses)."""
 
     def __init__(self):
-        from cryptography import x509
-        from cryptography.hazmat.primitives import hashes, serialization
-        from cryptography.hazmat.primitives.asymmetric import ec
-        from cryptography.x509.oid import NameOID
-
-        self.key = ec.generate_private_key(ec.SECP256R1())
-        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "fake-acme-ca")])
-        now = datetime.datetime.now(datetime.timezone.utc)
-        self.cert = (
-            x509.CertificateBuilder()
-            .subject_name(name).issuer_name(name)
-            .public_key(self.key.public_key())
-            .serial_number(x509.random_serial_number())
-            .not_valid_before(now - datetime.timedelta(minutes=5))
-            .not_valid_after(now + datetime.timedelta(days=30))
-            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
-            .sign(self.key, hashes.SHA256())
+        self.ca_pem, self.ca_key_pem = minicrypto.self_signed_cert(
+            "fake-acme-ca", days=30, is_ca=True
         )
-        self.ca_pem = self.cert.public_bytes(serialization.Encoding.PEM).decode()
 
     def sign_csr(self, csr_der: bytes) -> str:
-        from cryptography import x509
-        from cryptography.hazmat.primitives import hashes, serialization
-
-        csr = x509.load_der_x509_csr(csr_der)
-        now = datetime.datetime.now(datetime.timezone.utc)
-        cert = (
-            x509.CertificateBuilder()
-            .subject_name(csr.subject)
-            .issuer_name(self.cert.subject)
-            .public_key(csr.public_key())
-            .serial_number(x509.random_serial_number())
-            .not_valid_before(now - datetime.timedelta(minutes=5))
-            .not_valid_after(now + datetime.timedelta(days=30))
-            .add_extension(
-                csr.extensions.get_extension_for_class(x509.SubjectAlternativeName).value,
-                critical=False,
-            )
-            .sign(self.key, hashes.SHA256())
-        )
-        return cert.public_bytes(serialization.Encoding.PEM).decode() + self.ca_pem
+        leaf = minicrypto.sign_csr(csr_der, self.ca_pem, self.ca_key_pem, days=30)
+        return leaf + self.ca_pem
 
 
 class FakeAcme:
@@ -204,8 +170,6 @@ class FakeAcme:
 
 def _tls_get(port: int, server_name: str, path: str, ca_pem: str = None) -> tuple:
     """Raw TLS GET with SNI; returns (status_line, body, peer_cn)."""
-    from cryptography import x509
-
     if ca_pem:
         ctx = ssl.create_default_context(cadata=ca_pem)
     else:
@@ -215,7 +179,7 @@ def _tls_get(port: int, server_name: str, path: str, ca_pem: str = None) -> tupl
     sock = socket.create_connection(("127.0.0.1", port), timeout=10)
     tls = ctx.wrap_socket(sock, server_hostname=server_name)
     der = tls.getpeercert(binary_form=True)
-    cn = x509.load_der_x509_certificate(der).subject.rfc4514_string()
+    cn = minicrypto.cert_subject(der, inform="DER")
     tls.sendall(
         f"GET {path} HTTP/1.1\r\nHost: {server_name}\r\nConnection: close\r\n\r\n".encode()
     )
@@ -339,8 +303,6 @@ class TestAcmeEndToEnd:
     async def test_near_expiry_cert_is_renewed(self, tmp_path):
         """A stored cert inside the renewal window is re-issued over ACME and
         the SNI store picks up the fresh one (certbot-renewal parity)."""
-        from cryptography import x509
-
         ca = TestCa()
         fake = FakeAcme(ca, challenge_host="")
         acme_server = TestServer(fake.app())
@@ -374,8 +336,7 @@ class TestAcmeEndToEnd:
                 await asyncio.sleep(0.1)
             assert tm.store.expiry("svc.test") != old_exp, "never renewed"
             pem = (tmp_path / "svc.test" / "fullchain.pem").read_bytes()
-            cert = x509.load_pem_x509_certificate(pem)
-            assert "fake-acme-ca" in cert.issuer.rfc4514_string()
+            assert "fake-acme-ca" in minicrypto.cert_issuer(pem)
             # The fresh 30-day cert sits outside the 10-day window.
             assert not tm.renewal_due("svc.test")
             assert tm.check_renewals() == []
@@ -414,9 +375,9 @@ class TestAcmeEndToEnd:
             # registration instead of creating a fresh account.
             tm2 = TlsManager(str(tmp_path), acme_directory=f"{fake.base}/directory")
             assert tm2.acme.kid == tm.acme.kid
-            old_pub = tm.acme.account_key.public_key().public_numbers()
-            new_pub = tm2.acme.account_key.public_key().public_numbers()
-            assert (old_pub.x, old_pub.y) == (new_pub.x, new_pub.y)
+            assert minicrypto.pubkey_xy(tm.acme.account_key) == minicrypto.pubkey_xy(
+                tm2.acme.account_key
+            )
         finally:
             await gw_server.close()
             await acme_server.close()

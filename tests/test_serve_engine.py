@@ -1,0 +1,488 @@
+"""Continuous-batching serving engine: paged KV correctness, scheduling, the
+SSE stream through the proxy, and the latency autoscaler's decisions.
+
+The engine invariant everything here leans on: continuous batching is a
+SCHEDULING optimization — it must never change a single emitted token. The
+equivalence tests pin that against (a) a full-context greedy reference decode
+and (b) the same engine run one-request-at-a-time, in fp32 on CPU so argmax
+ties can't blur the comparison."""
+
+import asyncio
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from dstack_tpu.core.models.services import ScalingMetric, ScalingSpec
+from dstack_tpu.server.services import autoscaler as autoscaler_service
+from dstack_tpu.server.services import proxy as proxy_service
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import serve as serve_lib
+from dstack_tpu.workloads.config import get_config
+
+TINY = get_config(
+    "test", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+    vocab_size=251, max_seq_len=128, dtype="float32", param_dtype="float32",
+    remat=False,
+)
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model_lib.init_params(TINY, jax.random.PRNGKey(0))
+
+
+def make_engine(params, **overrides) -> serve_lib.ServeEngine:
+    kwargs = dict(page_size=8, num_pages=32, max_batch=4, max_seq=128)
+    kwargs.update(overrides)
+    return serve_lib.ServeEngine(
+        TINY, serve_lib.EngineConfig(**kwargs), params=params
+    )
+
+
+def run_to_completion(engine, limit=500):
+    steps = 0
+    while engine.has_work():
+        engine.step()
+        steps += 1
+        assert steps < limit, "engine never drained"
+    return steps
+
+
+class TestEquivalence:
+    def test_continuous_batch_matches_full_forward_reference(self, params):
+        """In-flight batched decode over the paged cache emits exactly the
+        tokens a full-context forward() greedy loop emits."""
+        engine = make_engine(params)
+        reqs = [engine.submit(p, max_new_tokens=6) for p in PROMPTS]
+        run_to_completion(engine)
+        for prompt, req in zip(PROMPTS, reqs):
+            ref = serve_lib.greedy_reference_decode(params, TINY, prompt, 6)
+            assert req.tokens == ref, f"paged decode diverged for {prompt}"
+
+    def test_continuous_batch_matches_one_at_a_time(self, params):
+        """Same engine, max_batch=1 (sequential): batching changes nothing."""
+        batched = make_engine(params)
+        reqs = [batched.submit(p, max_new_tokens=8) for p in PROMPTS]
+        run_to_completion(batched)
+
+        sequential = make_engine(params, max_batch=1)
+        for prompt, batched_req in zip(PROMPTS, reqs):
+            solo = sequential.submit(prompt, max_new_tokens=8)
+            run_to_completion(sequential)
+            assert solo.tokens == batched_req.tokens
+
+    def test_eos_stops_generation_early(self, params):
+        probe = make_engine(params)
+        req = probe.submit(PROMPTS[0], max_new_tokens=6)
+        run_to_completion(probe)
+        eos = req.tokens[2]  # deterministic: greedy always reproduces this
+
+        engine = make_engine(params)
+        stopped = engine.submit(PROMPTS[0], max_new_tokens=6, eos_id=eos)
+        run_to_completion(engine)
+        assert stopped.tokens == req.tokens[:3]  # eos token included, then stop
+        assert stopped.done
+
+
+class TestPagedCache:
+    def test_pages_freed_across_request_churn(self, params):
+        """Way more requests than the pool fits concurrently: every page must
+        come back; no leak, no double-free."""
+        engine = make_engine(params, num_pages=16, page_size=8, max_batch=2)
+        total = engine.ecfg.num_pages
+        reqs = [
+            engine.submit([(i * 3 + j) % 200 + 1 for j in range(5)],
+                          max_new_tokens=5)
+            for i in range(12)
+        ]
+        run_to_completion(engine, limit=1000)
+        assert all(r.done for r in reqs)
+        assert engine.free_pages == total
+        assert sorted(engine._free) == list(range(total))  # each page exactly once
+        assert all(not p for p in engine.slot_pages)
+        assert not engine.page_tables.any()
+
+    def test_admission_waits_for_pages(self, params):
+        """A request that doesn't fit the free pool stays queued (visible as
+        queue depth — the autoscaler's signal) and is admitted once pages free."""
+        engine = make_engine(params, num_pages=4, page_size=8, max_batch=2)
+        # 17 prompt tokens + headroom = 3 of 4 pages.
+        big = engine.submit(list(range(1, 18)), max_new_tokens=4)
+        engine.step()
+        # Second big request can't fit alongside: 2 pages needed, 1 free.
+        queued = engine.submit(list(range(1, 10)), max_new_tokens=4)
+        engine.step()
+        assert engine.queue_depth == 1 and not queued.tokens
+        run_to_completion(engine)
+        assert big.done and queued.done
+        assert queued.tokens == serve_lib.greedy_reference_decode(
+            params, TINY, queued.prompt, 4
+        )
+
+    def test_preemption_under_page_pressure_keeps_tokens_identical(self, params):
+        """When decode growth drains the pool, the youngest request is
+        preempted and later re-prefilled from prompt + generated — emitted
+        tokens still match the reference exactly. The pool is sized so the
+        SAME request gets preempted more than once: a resume prompt that
+        re-appended already-absorbed tokens would corrupt its context here."""
+        engine = make_engine(params, num_pages=7, page_size=4, max_batch=3,
+                             max_seq=96)
+        prompts = [[i + 1, i + 2, i + 3, i + 4, i + 5] for i in (0, 10, 20)]
+        reqs = [engine.submit(p, max_new_tokens=20) for p in prompts]
+        run_to_completion(engine, limit=2000)
+        assert max(r.preemptions for r in reqs) >= 2, (
+            "pool was sized to preempt one request repeatedly"
+        )
+        for prompt, req in zip(prompts, reqs):
+            assert req.tokens == serve_lib.greedy_reference_decode(
+                params, TINY, prompt, 20
+            )
+        assert engine.free_pages == engine.ecfg.num_pages
+
+
+class TestInterleave:
+    def test_midflight_admission_does_not_disturb_running_decode(self, params):
+        """Admit B while A is mid-decode: A's token stream continues one per
+        step (prefill of B batches separately), and both match the reference."""
+        engine = make_engine(params)
+        a = engine.submit(PROMPTS[0], max_new_tokens=10)
+        for _ in range(3):
+            engine.step()
+        a_before = len(a.tokens)
+        # Admission step emits prefill token + a decode token; then 1/step.
+        assert a_before == 4
+        b = engine.submit(PROMPTS[2], max_new_tokens=6)
+        events = engine.step()
+        # The admission step emits B's prefill token AND A's next decode token.
+        assert {ev.req_id for ev in events} == {a.req_id, b.req_id}
+        assert len(a.tokens) == a_before + 1
+        run_to_completion(engine)
+        assert a.tokens == serve_lib.greedy_reference_decode(
+            params, TINY, PROMPTS[0], 10
+        )
+        assert b.tokens == serve_lib.greedy_reference_decode(
+            params, TINY, PROMPTS[2], 6
+        )
+
+    def test_static_policy_admits_only_into_drained_batch(self, params):
+        engine = make_engine(params, policy="static")
+        a = engine.submit(PROMPTS[0], max_new_tokens=4)
+        engine.step()
+        b = engine.submit(PROMPTS[1], max_new_tokens=4)
+        while not a.done:
+            engine.step()
+        assert not b.tokens  # nothing until the whole batch drained
+        run_to_completion(engine)
+        assert b.tokens == serve_lib.greedy_reference_decode(
+            params, TINY, PROMPTS[1], 4
+        )
+
+
+class _GatedRunner(serve_lib.EngineRunner):
+    """EngineRunner whose step loop advances only when the test releases it —
+    makes 'the stream is open mid-generation' deterministic, no timing."""
+
+    def __init__(self, engine):
+        super().__init__(engine)
+        self.gate = threading.Semaphore(0)
+
+    def release(self, steps: int = 1) -> None:
+        for _ in range(steps):
+            self.gate.release()
+
+    def run(self):
+        while not self._stop.is_set():
+            if not self.gate.acquire(timeout=0.05):
+                continue
+            self.step_once()
+
+    def shutdown(self):
+        super().shutdown()
+        self.gate.release()
+
+
+class TestSseThroughProxy:
+    async def test_tokens_stream_unbuffered_and_record_ttft(self, params):
+        """Extends the PR 2 pass-through test with the REAL engine upstream:
+        the client receives the first SSE token while generation is still
+        gated (so nothing buffered the stream), and the proxy's first-chunk
+        hook has already recorded TTFT + the engine queue-depth gauge."""
+        from aiohttp import web as aioweb
+
+        from tests.common import api_server
+        from tests.test_serving_fast_path import _Fixture, seed_service
+
+        engine = make_engine(params)
+        gated = _GatedRunner(engine)
+        gated.start()
+        app_runner = aioweb.AppRunner(serve_lib.create_serve_app(gated))
+        await app_runner.setup()
+        site = aioweb.TCPSite(app_runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            with _Fixture():
+                async with api_server() as api:
+                    run_id, _ = await seed_service(api.db, "engine", port)
+                    resp = await api.client.post(
+                        "/proxy/services/main/engine/generate",
+                        json={"prompt_tokens": PROMPTS[0], "max_tokens": 5,
+                              "stream": True},
+                    )
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"].startswith(
+                        "text/event-stream"
+                    )
+                    # One engine step = prefill = exactly one token event.
+                    gated.release(1)
+                    first = await asyncio.wait_for(
+                        resp.content.readuntil(b"\n\n"), timeout=10
+                    )
+                    payload = json.loads(first[len(b"data: "):])
+                    assert payload["index"] == 0
+                    # Generation is still gated: the stream being readable NOW
+                    # proves the path is unbuffered end to end. And the proxy
+                    # recorded TTFT + queue depth off that first chunk, while
+                    # the held-open stream counts as in-flight demand (what
+                    # stops scale-to-zero mid-generation).
+                    assert proxy_service.stats.latency_quantiles(run_id)
+                    assert proxy_service.stats.queue_depth(run_id) is not None
+                    assert proxy_service.stats.inflight(run_id) == 1
+                    gated.release(10)
+                    rest = await asyncio.wait_for(resp.content.read(), timeout=10)
+                    events = [l for l in rest.split(b"\n\n") if l]
+                    assert events[-1] == b"data: [DONE]"
+                    assert len(events) == 5  # 4 more tokens + DONE
+                    for _ in range(50):  # let the proxy handler's finally run
+                        if proxy_service.stats.inflight(run_id) == 0:
+                            break
+                        await asyncio.sleep(0.01)
+                    assert proxy_service.stats.inflight(run_id) == 0
+        finally:
+            gated.shutdown()
+            await app_runner.cleanup()
+
+    async def test_generate_rejects_bad_tokens(self, params):
+        from aiohttp import web as aioweb
+        from aiohttp.test_utils import TestClient, TestServer
+
+        runner = serve_lib.EngineRunner(make_engine(params))
+        runner.start()
+        try:
+            client = TestClient(TestServer(serve_lib.create_serve_app(runner)))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/generate", json={"prompt_tokens": [999999]}
+                )
+                assert resp.status == 400
+                resp = await client.post(
+                    "/generate", json={"prompt": "hi", "max_tokens": "8"}
+                )
+                assert resp.status == 400  # not a 500 from deep in submit()
+                resp = await client.post("/generate", json={"prompt": "hi",
+                                                            "max_tokens": 2,
+                                                            "stream": False})
+                assert resp.status == 200
+                body = await resp.json()
+                assert len(body["tokens"]) == 2
+                assert "X-Dstack-Queue-Depth" in resp.headers
+                stats = await (await client.get("/stats")).json()
+                assert stats["finished_requests"] >= 1
+            finally:
+                await client.close()
+        finally:
+            runner.shutdown()
+
+
+def _spec(metric="latency", target=0.2, qd=4, rmin=0, rmax=4) -> ScalingSpec:
+    return ScalingSpec(
+        metric=metric, target=target, queue_depth_target=qd,
+        scale_up_delay=0, scale_down_delay=0,
+    )
+
+
+class TestAutoscalerDecisions:
+    """decide() from synthetic windows: the satellite's up/down/zero matrix."""
+
+    def test_high_p90_scales_up(self):
+        sig = autoscaler_service.Signals(rps=2.0, p50=0.1, p90=0.5)
+        assert autoscaler_service.decide(_spec(), 0, 4, 2, sig) == 3
+
+    def test_deep_engine_queue_scales_up_despite_healthy_latency(self):
+        sig = autoscaler_service.Signals(rps=2.0, p50=0.05, p90=0.08,
+                                         queue_depth=12)
+        assert autoscaler_service.decide(_spec(), 0, 4, 2, sig) == 3
+
+    def test_comfortable_latency_scales_down(self):
+        sig = autoscaler_service.Signals(rps=2.0, p50=0.02, p90=0.05,
+                                         queue_depth=0)
+        assert autoscaler_service.decide(_spec(), 0, 4, 3, sig) == 2
+
+    def test_dead_band_holds_steady(self):
+        # p90 between 0.5*target and target: neither direction.
+        sig = autoscaler_service.Signals(rps=2.0, p50=0.1, p90=0.15)
+        assert autoscaler_service.decide(_spec(), 0, 4, 2, sig) == 2
+
+    def test_idle_window_scales_to_zero_only_when_min_allows(self):
+        idle = autoscaler_service.Signals(rps=0.0)
+        assert autoscaler_service.decide(_spec(), 0, 4, 2, idle) == 0
+        assert autoscaler_service.decide(_spec(rmin=1), 1, 4, 2, idle) == 1
+
+    def test_inflight_stream_blocks_scale_to_zero(self):
+        """A >60s SSE generation leaves no RPS trace but is still demand:
+        the held-open stream must pin the service above zero — on BOTH
+        metrics (the rps branch computes ceil(0/target)=0 otherwise)."""
+        streaming = autoscaler_service.Signals(rps=0.0, inflight=1)
+        assert not streaming.idle
+        assert autoscaler_service.decide(_spec(), 0, 4, 1, streaming) == 1
+        rps_spec = ScalingSpec(metric="rps", target=2)
+        assert autoscaler_service.decide(rps_spec, 0, 4, 1, streaming) == 1
+
+    def test_live_traffic_never_scales_below_one(self):
+        """Healthy fast traffic on the last replica: comfortable p90 must not
+        step active-1 down to zero — that would kill/cold-start-cycle every
+        lightly-loaded scale-to-zero service. Zero is the idle path only."""
+        light = autoscaler_service.Signals(rps=5.0, p50=0.02, p90=0.03,
+                                           queue_depth=0, inflight=3)
+        assert autoscaler_service.decide(_spec(), 0, 4, 1, light) == 1
+
+    def test_demand_against_zero_replicas_wakes_one(self):
+        sig = autoscaler_service.Signals(rps=0.5)  # no latency samples yet
+        assert autoscaler_service.decide(_spec(), 0, 4, 0, sig) == 1
+
+    def test_max_clamps_runaway_latency(self):
+        sig = autoscaler_service.Signals(rps=9.0, p50=1.0, p90=3.0)
+        assert autoscaler_service.decide(_spec(), 0, 2, 2, sig) == 2
+
+    def test_rps_metric_unchanged(self):
+        spec = ScalingSpec(metric="rps", target=2)
+        sig = autoscaler_service.Signals(rps=5.0)
+        assert autoscaler_service.decide(spec, 0, 8, 1, sig) == 3
+
+
+class TestStatsSignals:
+    def test_latency_quantiles_and_queue_depth_window(self):
+        stats = proxy_service.ServiceStats()
+        for v in (0.1, 0.2, 0.3, 0.4, 1.0):
+            stats.record_latency("r1", v)
+        q = stats.latency_quantiles("r1")
+        assert q["count"] == 5
+        assert q["p50"] == pytest.approx(0.3)
+        assert q["p90"] == pytest.approx(1.0)
+        assert stats.latency_quantiles("ghost") is None
+
+        stats.record_queue_depth("r1", 3)
+        stats.record_queue_depth("r1", 7)
+        stats.record_queue_depth("r1", 2)
+        assert stats.queue_depth("r1") == 7  # max in window: spikes must show
+        assert stats.queue_depth("ghost") is None
+        stats.drop_run("r1")
+        assert stats.latency_quantiles("r1") is None
+        assert stats.queue_depth("r1") is None
+
+
+class TestAutoscalerIntegration:
+    """The background pass end to end against a fake service: injected p90
+    scales up (run_events carries the autoscaler actor), an idle window
+    scales back to zero — no cloud, no runner."""
+
+    async def test_latency_scale_up_then_to_zero(self):
+        from dstack_tpu.server.background import tasks
+        from tests.common import api_server, setup_mock_backend
+
+        proxy_service.stats.reset()
+        try:
+            async with api_server() as api:
+                await setup_mock_backend(api)
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    {"run_spec": {
+                        "run_name": "lat-svc",
+                        "configuration": {
+                            "type": "service",
+                            "commands": ["python -m dstack_tpu.workloads.serve"],
+                            "port": 8000,
+                            "replicas": "0..2",
+                            "resources": {"tpu": "v5e-8"},
+                            "scaling": {
+                                "metric": "latency", "target": 0.2,
+                                "queue_depth_target": 2,
+                                "scale_up_delay": 0, "scale_down_delay": 0,
+                            },
+                        },
+                    }},
+                )
+                row = await api.db.fetchone(
+                    "SELECT * FROM runs WHERE run_name = 'lat-svc'"
+                )
+                assert not await api.db.fetchall(
+                    "SELECT * FROM jobs WHERE run_id = ?", (row["id"],)
+                )  # replicas.min = 0: born scaled to zero
+
+                for _ in range(30):
+                    proxy_service.stats.record(row["id"])
+                    proxy_service.stats.record_latency(row["id"], 0.9)
+                await tasks.process_autoscaler(api.db)
+                jobs = await api.db.fetchall(
+                    "SELECT * FROM jobs WHERE run_id = ?", (row["id"],)
+                )
+                assert len(jobs) == 1 and jobs[0]["status"] == "submitted"
+
+                data = await api.post(
+                    "/api/project/main/runs/get_events", {"run_name": "lat-svc"}
+                )
+                auto = [e for e in data["events"] if e["actor"] == "autoscaler"]
+                assert auto and auto[0]["reason"] == "scale_from_zero"
+
+                # Demand evaporates -> back to zero; the replica's jobs get
+                # the scaled_down termination the run FSM ignores.
+                proxy_service.stats.reset()
+                await tasks.process_autoscaler(api.db)
+                jobs = await api.db.fetchall(
+                    "SELECT * FROM jobs WHERE run_id = ?", (row["id"],)
+                )
+                assert {j["status"] for j in jobs} <= {"terminating", "terminated"}
+                assert all(
+                    j["termination_reason"] == "scaled_down" for j in jobs
+                )
+                run = await api.post(
+                    "/api/project/main/runs/get", {"run_name": "lat-svc"}
+                )
+                assert run["status"] not in ("failed", "terminated")
+        finally:
+            proxy_service.stats.reset()
+
+    async def test_queue_depth_header_recorded_through_proxy(self):
+        """A replica reporting X-Dstack-Queue-Depth feeds the gauge the
+        latency autoscaler reads — via the normal proxied-response path."""
+        from aiohttp import web as aioweb
+
+        from tests.common import api_server
+        from tests.test_serving_fast_path import _Fixture, seed_service
+
+        async def handler(request):
+            return aioweb.Response(text="ok",
+                                   headers={"X-Dstack-Queue-Depth": "5"})
+
+        upstream = aioweb.Application()
+        upstream.router.add_get("/{tail:.*}", handler)
+        app_runner = aioweb.AppRunner(upstream)
+        await app_runner.setup()
+        site = aioweb.TCPSite(app_runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            with _Fixture():
+                async with api_server() as api:
+                    run_id, _ = await seed_service(api.db, "qd", port)
+                    resp = await api.client.get("/proxy/services/main/qd/ping")
+                    assert resp.status == 200
+                    assert proxy_service.stats.queue_depth(run_id) == 5.0
+        finally:
+            await app_runner.cleanup()
