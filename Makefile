@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve smoke-observability smoke-serve release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels smoke-observability smoke-serve release publish clean
 
 all: runner wheel
 
@@ -60,6 +60,14 @@ bench-train:
 # inter-token latency; vs_baseline is continuous over static batching.
 bench-serve:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_serve()))"
+
+# Kernel smoke: every in-repo Pallas kernel (flash fwd+bwd, paged decode),
+# the int8 quantized matmul, and the collective-matmul ring, in CPU interpret
+# mode — one JSON line with max error vs the XLA references; >1e-4 is a
+# non-zero exit. Run this before a TPU submit touching kernel code.
+bench-kernels:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -c "import json, bench; print(json.dumps(bench.bench_kernels()))"
 
 # Observability smoke: boots the server in-process, drives one run through the
 # full FSM, and asserts the events timeline + /metrics histograms are live.

@@ -40,12 +40,16 @@ def _run_train_variant(
     steps: int = 8,
     mesh=None,
     batch_spec=None,
+    cfg_overrides=None,
 ) -> dict:
-    """One (grad_accum, prefetch) variant of the train step: returns
-    compile_s + p50/p90/median step seconds. prefetch=0 feeds one static
-    device-resident batch (the legacy path); prefetch>0 streams fresh host
-    batches through the data-pipeline prefetcher so the host->HBM transfer
-    overlaps the previous step."""
+    """One variant of the train step: returns compile_s + p50/p90/median step
+    seconds. prefetch=0 feeds one static device-resident batch (the legacy
+    path); prefetch>0 streams fresh host batches through the data-pipeline
+    prefetcher so the host->HBM transfer overlaps the previous step.
+    cfg_overrides (attn_impl/quant/tp_overlap — the PR 7 kernel levers) are
+    dataclass-replaced onto cfg so the sweep attributes each lever
+    separately."""
+    import dataclasses
     import statistics
 
     import jax
@@ -53,6 +57,8 @@ def _run_train_variant(
     from dstack_tpu.workloads import data as data_lib
     from dstack_tpu.workloads import train as train_lib
 
+    if cfg_overrides:
+        cfg = dataclasses.replace(cfg, **cfg_overrides)
     optimizer = train_lib.make_optimizer(mu_dtype="bfloat16")
     state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
     step_fn = train_lib.make_train_step(cfg, optimizer, mesh, grad_accum=grad_accum)
@@ -115,7 +121,7 @@ def _run_train_variant(
             feed.close()
 
     stats = train_lib._step_time_stats(times)
-    return {
+    out = {
         "compile_s": round(compile_s, 2),
         "median_s": statistics.median(times),
         "p50_ms": round(stats["p50_s"] * 1000, 1),
@@ -124,16 +130,44 @@ def _run_train_variant(
         "prefetch": prefetch,
         "batch": batch,
     }
+    if cfg_overrides:
+        out.update({k: v for k, v in cfg_overrides.items()})
+    return out
 
 
 def _variant_plan(batch: int) -> list:
-    """The (grad_accum, prefetch) sweep shared by the TPU bench and the
-    `make bench-train` CPU smoke — one list so the smoke always covers every
-    variant the headline MFU can be attributed to."""
+    """The variant sweep shared by the TPU bench and the `make bench-train`
+    CPU smoke — one list so the smoke always covers every variant the
+    headline MFU can be attributed to. Pipeline variants (accum/prefetch,
+    PR 4) plus the kernel/precision levers (PR 7): the in-repo flash kernel,
+    int8 quantized matmuls, and their combination. The tp_overlap collective-
+    matmul variant needs a tp>1 mesh and is planned separately
+    (_tp_variant_plan)."""
     return [
         ("static", dict(batch=batch, grad_accum=1, prefetch=0)),
         ("prefetch2", dict(batch=batch, grad_accum=1, prefetch=2)),
         ("accum2_prefetch2", dict(batch=2 * batch, grad_accum=2, prefetch=2)),
+        ("flash", dict(batch=batch, grad_accum=1, prefetch=2,
+                       cfg_overrides={"attn_impl": "flash"})),
+        ("int8", dict(batch=batch, grad_accum=1, prefetch=2,
+                      cfg_overrides={"quant": "int8"})),
+        ("flash_int8", dict(batch=batch, grad_accum=1, prefetch=2,
+                            cfg_overrides={"attn_impl": "flash",
+                                           "quant": "int8"})),
+    ]
+
+
+def _tp_variant_plan(batch: int) -> list:
+    """Collective-matmul variants; callers supply a tp>1 mesh (skipped — with
+    the reason recorded — on a single chip). Attribution-only: they run on a
+    different device count than the 1-chip headline, so they never compete
+    for best_variant."""
+    return [
+        ("tp_overlap", dict(batch=batch, grad_accum=1, prefetch=2,
+                            cfg_overrides={"tp_overlap": True})),
+        ("tp_overlap_int8", dict(batch=batch, grad_accum=1, prefetch=2,
+                                 cfg_overrides={"tp_overlap": True,
+                                                "quant": "int8"})),
     ]
 
 
@@ -160,6 +194,36 @@ def bench_tpu_train() -> dict:
         try:
             variants[name] = _run_train_variant(cfg, seq=seq, **kw)
         except Exception as e:  # noqa: BLE001 — typically RESOURCE_EXHAUSTED
+            variants[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # Collective-matmul attribution: needs a tp mesh, so it runs across ALL
+    # local chips and reports per-chip tok/s in its own record — never in the
+    # 1-chip headline race ("median_s" is dropped before the best-variant
+    # scan below).
+    n_dev = jax.device_count()
+    for name, kw in _tp_variant_plan(batch):
+        if n_dev < 2:
+            variants[name] = {"skipped": f"needs >1 device for tp (have {n_dev})"}
+            continue
+        if cfg.n_kv_heads % n_dev:
+            variants[name] = {
+                "skipped": f"tp={n_dev} does not divide n_kv_heads={cfg.n_kv_heads}"
+            }
+            continue
+        try:
+            from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh
+
+            mesh = make_mesh(dp=1, fsdp=1, tp=n_dev, sp=1)
+            with mesh:
+                v = _run_train_variant(
+                    cfg, seq=seq, mesh=mesh, batch_spec=BATCH_SPEC, **kw
+                )
+            v["devices"] = n_dev
+            v["tok_per_sec_per_chip"] = round(
+                v["batch"] * seq / v.pop("median_s") / n_dev, 1
+            )
+            variants[name] = v
+        except Exception as e:  # noqa: BLE001
             variants[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     ok = {k: v for k, v in variants.items() if "median_s" in v}
@@ -220,9 +284,21 @@ def bench_train_pipeline() -> dict:
             variants[name] = _run_train_variant(
                 cfg, seq=seq, steps=steps, mesh=mesh, batch_spec=BATCH_SPEC, **kw
             )
+    # Collective-matmul variants on a tp=4 mesh (same 8 devices, different
+    # axes) — proves the ppermute ring end to end on CPU.
+    tp_mesh = make_mesh(dp=1, fsdp=2, tp=4, devices=devices)
+    with tp_mesh:
+        for name, kw in _tp_variant_plan(batch):
+            variants[name] = _run_train_variant(
+                cfg, seq=seq, steps=steps, mesh=tp_mesh, batch_spec=BATCH_SPEC,
+                **kw
+            )
 
     rate = {k: v["batch"] * seq / v.pop("median_s") for k, v in variants.items()}
-    best = max(rate, key=rate.get)
+    # tp variants ran under different sharding (tp=4 mesh) — attribution only,
+    # never the headline, matching bench_tpu_train's _tp_variant_plan contract.
+    tp_names = {name for name, _ in _tp_variant_plan(batch)}
+    best = max((k for k in rate if k not in tp_names), key=rate.get)
     return {
         "metric": "train_pipeline_smoke_tok_per_sec",
         "value": round(rate[best], 1),
@@ -657,6 +733,43 @@ def _run_serve_variant(cfg, params, schedule, **engine_kwargs) -> dict:
     }
 
 
+def _decode_itl_compare(cfg, params, steps: int = 12) -> dict:
+    """Per-step decode latency (the inter-token-latency floor) with the
+    Pallas paged-attention kernel vs the XLA gather, on identical engine
+    state. On CPU the Pallas kernel runs in interpret mode — expect it to
+    LOSE there (the comparison proves token-path parity and records the
+    shape of the trade); on a TPU host the same code times the compiled
+    kernel against the gather's full-window materialization."""
+    from dstack_tpu.workloads import serve as serve_lib
+
+    out = {}
+    for impl in ("xla", "pallas"):
+        eng = serve_lib.ServeEngine(
+            cfg,
+            serve_lib.EngineConfig(page_size=16, num_pages=96, max_batch=4,
+                                   max_seq=160, decode_impl=impl),
+            params=params,
+        )
+        for i in range(4):
+            eng.submit([7 + i, 3, 11, 2], max_new_tokens=steps + 8)
+        eng.step()  # admit + prefill (+ compile)
+        eng.step()  # first pure-decode step (+ decode compile)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            eng.step()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        out[impl] = {
+            "itl_p50_ms": round(times[len(times) // 2] * 1000, 2),
+            "itl_mean_ms": round(sum(times) / len(times) * 1000, 2),
+        }
+    out["pallas_over_xla"] = round(
+        out["pallas"]["itl_p50_ms"] / max(out["xla"]["itl_p50_ms"], 1e-9), 2
+    )
+    return out
+
+
 def bench_serve() -> dict:
     """`make bench-serve`: the continuous-batching engine under an open-loop
     synthetic load — continuous vs static batching plus a page-size sweep, PR 4
@@ -721,6 +834,13 @@ def bench_serve() -> dict:
         except Exception as e:  # noqa: BLE001
             variants[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    # Decode-kernel attribution: Pallas paged kernel vs XLA gather per-step
+    # latency on identical state (PR 7).
+    try:
+        decode_itl = _decode_itl_compare(cfg, params)
+    except Exception as e:  # noqa: BLE001
+        decode_itl = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     n_dev = max(jax.device_count(), 1)
     return {
         "metric": "serve_tokens_per_sec_per_chip",
@@ -738,8 +858,126 @@ def bench_serve() -> dict:
             "itl_p50_ms": cont["itl_p50_ms"],
             "itl_p99_ms": cont["itl_p99_ms"],
             "per_round_ratio": [round(r, 2) for r in ratios],
+            "decode_itl": decode_itl,
             "variants": variants,
         },
+    }
+
+
+def bench_kernels() -> dict:
+    """`make bench-kernels`: every in-repo Pallas kernel + quantized matmul +
+    the collective-matmul ring, end to end in CPU interpret mode — one JSON
+    line with per-kernel wall time and max error vs the XLA reference. Not a
+    speed bench (interpret mode measures correctness, not the chip); its job
+    is to prove the exact kernel code paths the TPU runs are importable,
+    traceable, and numerically tight, one command before a TPU submit."""
+    import os
+
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads import quantize as quant_lib
+    from dstack_tpu.workloads.attention import (
+        blockwise_attention,
+        paged_decode_attention,
+    )
+    from dstack_tpu.workloads.kernels import (
+        collective_matmul,
+        flash_attention,
+        paged_decode_attention_pallas,
+    )
+    from dstack_tpu.workloads.sharding import make_mesh
+
+    results = {}
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+
+    # -- flash fwd + bwd vs blockwise --------------------------------------
+    q = jax.random.normal(ks[0], (2, 128, 4, 32))
+    k = jax.random.normal(ks[1], (2, 128, 2, 32))
+    v = jax.random.normal(ks[2], (2, 128, 2, 32))
+    t0 = time.perf_counter()
+    out = flash_attention(q, k, v, causal=True)
+    ref = blockwise_attention(q, k, v, causal=True)
+    fwd_err = float(jnp.max(jnp.abs(out - ref)))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, causal=True)))
+
+    gk = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(blockwise_attention), argnums=(0, 1, 2))(q, k, v)
+    bwd_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gk, gr))
+    results["flash"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "fwd_max_err": fwd_err,
+        "bwd_max_err": bwd_err,
+    }
+
+    # -- paged decode kernel vs XLA gather ---------------------------------
+    qd = jax.random.normal(ks[3], (4, 4, 32))
+    kp = jax.random.normal(ks[4], (24, 8, 2, 32))
+    vp = jax.random.normal(ks[5], (24, 8, 2, 32))
+    pt = jax.random.randint(ks[6], (4, 8), 0, 24)
+    lens = jnp.array([3, 17, 40, 64], jnp.int32)
+    t0 = time.perf_counter()
+    pk = paged_decode_attention_pallas(qd, kp, vp, pt, lens)
+    px = paged_decode_attention(qd, kp, vp, pt, lens)
+    results["paged_decode"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "max_err": float(jnp.max(jnp.abs(pk - px))),
+    }
+
+    # -- int8 matmul error bound -------------------------------------------
+    x = jax.random.normal(ks[0], (64, 256))
+    w = jax.random.normal(ks[1], (256, 128))
+    t0 = time.perf_counter()
+    yq = quant_lib.int8_matmul(x, w)
+    yr = x @ w
+    rel = float(
+        jnp.linalg.norm(yq - yr) / jnp.maximum(jnp.linalg.norm(yr), 1e-9)
+    )
+    results["int8_matmul"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "rel_err": round(rel, 5),
+    }
+
+    # -- collective matmul == all-reduce matmul on an 8-device mesh --------
+    mesh = make_mesh(dp=1, fsdp=2, tp=4, sp=1)
+    xb = jax.random.normal(ks[2], (8, 16, 64))
+    wb = jax.random.normal(ks[3], (64, 32))
+    t0 = time.perf_counter()
+    with mesh:
+        yc = jax.jit(lambda a, b: collective_matmul(a, b, mesh))(xb, wb)
+    cerr = float(jnp.max(jnp.abs(yc - jnp.einsum("btk,kn->btn", xb, wb))))
+    results["collective_matmul"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "max_err": cerr,
+    }
+
+    worst = max(
+        results["flash"]["fwd_max_err"],
+        results["flash"]["bwd_max_err"],
+        results["paged_decode"]["max_err"],
+        results["collective_matmul"]["max_err"],
+    )
+    # int8 is lossy by design — gauged against its own rounding-noise bound
+    # (~1% on gaussian operands) rather than the exact-kernel 1e-4 floor.
+    int8_rel = results["int8_matmul"]["rel_err"]
+    if worst > 1e-4 or int8_rel > 0.05:
+        raise RuntimeError(
+            f"kernel smoke out of bounds (exact>{1e-4} or int8_rel>0.05): "
+            f"{results}"
+        )
+    return {
+        "metric": "kernel_smoke_max_err",
+        "value": worst,
+        "unit": "abs_err",
+        # A returned record always passed the floor; failure raises above.
+        "vs_baseline": 1.0,
+        "extra": results,
     }
 
 

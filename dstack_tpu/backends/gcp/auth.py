@@ -8,7 +8,8 @@ token-provider hierarchy:
 - ``MetadataTokenProvider`` — GCE/TPU-VM metadata server (the zero-config path when the
   control plane itself runs on GCP).
 - ``ServiceAccountTokenProvider`` — service-account JSON key: RS256-signed JWT grant
-  against the oauth2 token endpoint (RFC 7523), using ``cryptography`` for signing.
+  against the oauth2 token endpoint (RFC 7523), signed via the openssl-CLI shim
+  (gateway/minicrypto.py — no ``cryptography`` wheel needed).
 """
 
 from __future__ import annotations
@@ -79,9 +80,11 @@ def _b64url(data: bytes) -> str:
 
 
 def sign_jwt_rs256(claims: dict, private_key_pem: str) -> str:
-    """Build a compact RS256 JWT (header.claims.signature) for the OAuth JWT grant."""
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import padding
+    """Build a compact RS256 JWT (header.claims.signature) for the OAuth JWT
+    grant. Signing goes through the gateway's openssl-CLI crypto shim
+    (gateway/minicrypto.py) — the same zero-python-dependency replacement for
+    the ``cryptography`` wheel the TLS stack uses."""
+    from dstack_tpu.gateway import minicrypto
 
     header = {"alg": "RS256", "typ": "JWT"}
     signing_input = (
@@ -89,8 +92,7 @@ def sign_jwt_rs256(claims: dict, private_key_pem: str) -> str:
         + "."
         + _b64url(json.dumps(claims, separators=(",", ":")).encode())
     )
-    key = serialization.load_pem_private_key(private_key_pem.encode(), password=None)
-    signature = key.sign(signing_input.encode(), padding.PKCS1v15(), hashes.SHA256())
+    signature = minicrypto.rsa_sign_sha256(private_key_pem, signing_input.encode())
     return signing_input + "." + _b64url(signature)
 
 

@@ -119,6 +119,41 @@ def _parse_ecdsa_der(sig: bytes) -> Tuple[int, int]:
     return r, s
 
 
+def generate_rsa_key_pem(bits: int = 2048) -> str:
+    """Fresh RSA private key, PKCS#8 PEM (tests / local service accounts)."""
+    return _run(
+        ["genpkey", "-algorithm", "RSA", "-pkeyopt", f"rsa_keygen_bits:{bits}"]
+    ).decode()
+
+
+def rsa_sign_sha256(key_pem: str, data: bytes) -> bytes:
+    """RS256 (RSASSA-PKCS1-v1_5 over SHA-256) signature — the GCP OAuth JWT
+    grant's algorithm (backends/gcp/auth.sign_jwt_rs256). ``openssl dgst
+    -sign`` with an RSA key emits exactly this scheme."""
+    with _TempFiles() as tf:
+        return _run(["dgst", "-sha256", "-sign", tf.write("k.pem", key_pem)],
+                    input_bytes=data)
+
+
+def rsa_verify_sha256(key_pem: str, data: bytes, signature: bytes) -> bool:
+    """Verify an RS256 signature; accepts the private key PEM (the public key
+    is derived) or a public key PEM."""
+    with _TempFiles() as tf:
+        priv = tf.write("k.pem", key_pem)
+        if "PRIVATE KEY" in key_pem:
+            pub = tf.path("pub.pem")
+            with open(pub, "wb") as f:
+                f.write(_run(["pkey", "-in", priv, "-pubout"]))
+        else:
+            pub = priv
+        try:
+            _run(["dgst", "-sha256", "-verify", pub, "-signature",
+                  tf.write("sig.bin", signature)], input_bytes=data)
+            return True
+        except CryptoError:
+            return False
+
+
 # -- certificates -----------------------------------------------------------
 
 

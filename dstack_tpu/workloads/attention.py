@@ -262,6 +262,69 @@ def paged_decode_attention(
     return jnp.einsum("sht,sthd->shd", weights, v.astype(jnp.float32))
 
 
+def attention_core(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, T, Kh, D]
+    v: jax.Array,
+    attn_impl: str,
+    mesh: Optional[Mesh] = None,
+    *,
+    causal: bool = True,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+) -> jax.Array:
+    """The one attention dispatch the model/MoE/pipeline forwards share.
+
+    Precedence: sequence parallelism (sp > 1) always runs ring attention —
+    it's the only core that understands rotating KV chunks. Otherwise
+    ``attn_impl`` picks the core:
+
+    - ``auto``: the public Pallas TPU kernel when it can run (real TPU, no
+      mesh — it has no SPMD rule), blockwise everywhere else. The safe
+      default.
+    - ``flash``: the in-repo Pallas kernel (kernels/flash.py) — compiled on
+      TPU, interpreted elsewhere, shard_map'd over (batch, tp) under a mesh.
+      Falls back to blockwise when the sequence isn't block-divisible or tp
+      doesn't divide the KV heads (config.validate_config raises loudly for
+      CLI-requested combos; mid-model we degrade instead of crashing).
+    - ``flash_tpu``: the public kernel explicitly (meshless TPU only).
+    - ``xla``/``blockwise``: the online-softmax scan; ``plain``: materialized
+      scores.
+    """
+    from dstack_tpu.workloads import kernels
+
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              batch_axes=batch_axes)
+    impl = attn_impl
+    if impl == "auto":
+        impl = "flash_tpu" if (mesh is None and flash_available()) else "blockwise"
+    if impl == "flash":
+        t, s_len = q.shape[1], k.shape[1]
+        if (kernels.pick_flash_block(t) is None
+                or kernels.pick_flash_block(s_len) is None):
+            return blockwise_attention(q, k, v, causal=causal)
+        if mesh is not None:
+            tp = mesh.shape.get("tp", 1)
+            data = 1
+            for a in batch_axes:
+                data *= mesh.shape.get(a, 1)
+            # shard_map needs whole shards: batch over the data axes, whole
+            # GQA groups over tp — ragged shapes degrade like odd seq does.
+            if q.shape[0] % data or q.shape[2] % tp or k.shape[2] % tp:
+                return blockwise_attention(q, k, v, causal=causal)
+            return kernels.flash_attention_sharded(
+                q, k, v, mesh, causal=causal, batch_axes=batch_axes
+            )
+        return kernels.flash_attention(q, k, v, causal=causal)
+    if impl == "flash_tpu":
+        if mesh is None and flash_available():
+            return flash_attention_tpu(q, k, v, causal=causal)
+        return blockwise_attention(q, k, v, causal=causal)
+    if impl == "plain":
+        return plain_attention(q, k, v, causal=causal)
+    return blockwise_attention(q, k, v, causal=causal)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,

@@ -23,10 +23,23 @@ class LlamaConfig:
     # "full" recomputes everything; "dots" saves MXU outputs and recomputes only
     # elementwise ops (less recompute, more HBM).
     remat_policy: str = "full"
-    # Attention core: "blockwise" (online-softmax scan; O(block) memory, long-seq),
-    # "plain" (materialize [T,S] scores; fastest via XLA fusion when T is moderate).
+    # Attention core (see attention.attention_core for the dispatch):
+    #   "auto"      — public Pallas kernel on a meshless TPU, blockwise else;
+    #   "xla"/"blockwise" — online-softmax scan (O(block) memory, long-seq);
+    #   "flash"     — the in-repo Pallas kernel (kernels/flash.py): compiled
+    #                 on TPU, interpreted on CPU so tests run the real kernel;
+    #   "flash_tpu" — the public jax.experimental.pallas.ops TPU kernel;
+    #   "plain"     — materialize [T,S] scores (fastest for moderate T).
     # Ring attention over `sp` always uses the blockwise accumulator.
     attn_impl: str = "blockwise"
+    # Matmul precision: "none" (bf16/fp32 per dtype) or "int8" — dynamically
+    # quantized int8 dot with fp32 accumulation and straight-through gradients
+    # (workloads/quantize.py). Serving quantizes weights only.
+    quant: str = "none"
+    # Collective-matmul overlap for the TP down-projections: decompose the
+    # local matmul into a ppermute ring so the tp all-reduce hides under MXU
+    # compute (kernels/collective.py). No-op when tp == 1.
+    tp_overlap: bool = False
     # Cross-entropy: chunk the vocab projection over the sequence so [B,T,V] fp32
     # logits are never fully materialized (0 = off). Trades ~2*d*V flops/token of
     # recompute for ~2 * B*T*V*4 bytes of HBM.
@@ -79,7 +92,7 @@ PRESETS = {
     "v5e_bench": LlamaConfig(
         vocab_size=32000, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=16,
         d_ff=8192, max_seq_len=2048, remat=True, remat_policy="full",
-        attn_impl="flash", loss_chunk=256,
+        attn_impl="auto", loss_chunk=256,
     ),
     # GPT-2-124M geometry (BASELINE north-star "GPT-2 125M single-node CPU
     # task"): d=768/L=12/h=12, vocab padded to a 128 multiple for clean tiling.
@@ -95,3 +108,80 @@ def get_config(name: str, **overrides) -> LlamaConfig:
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     return cfg
+
+
+ATTN_IMPLS = ("auto", "xla", "blockwise", "plain", "flash", "flash_tpu")
+
+
+def validate_config(
+    cfg: LlamaConfig,
+    mesh=None,
+    batch: Optional[int] = None,
+    seq: Optional[int] = None,
+) -> None:
+    """Loud trace-time/CLI validation of the perf-dispatch flags.
+
+    The model-side dispatchers fall back silently where a combination merely
+    degrades (e.g. flash under a mesh whose tp doesn't divide the KV heads);
+    an *explicitly requested* invalid combination at the CLI is a config
+    error and must fail before compile, not quietly run the slow path."""
+    from dstack_tpu.workloads.quantize import check_quant
+
+    if cfg.attn_impl not in ATTN_IMPLS:
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r}; expected one of {ATTN_IMPLS}"
+        )
+    check_quant(cfg.quant)
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if cfg.attn_impl == "flash_tpu" and mesh is not None:
+        # attention_core only routes to the public kernel on a MESHLESS TPU
+        # (a Pallas call has no SPMD rule); under any mesh — and train always
+        # builds one — the request would silently run blockwise.
+        raise ValueError(
+            "attn_impl=flash_tpu only runs meshless (the public kernel has "
+            "no sharding rule) and would silently fall back to blockwise "
+            "under a device mesh; use attn_impl=flash (the in-repo sharded "
+            "kernel) or attn_impl=auto"
+        )
+    if cfg.attn_impl in ("flash", "flash_tpu"):
+        if sp > 1:
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} does not compose with sequence "
+                f"parallelism (sp={sp} runs ring attention, whose rotating KV "
+                f"chunks don't meet the kernel's block-divisibility contract);"
+                f" use attn_impl=xla or sp=1"
+            )
+        if seq:
+            # Each impl has its own block menu: the public kernel only takes
+            # 512/256/128 (attention._flash_block) while the in-repo kernel
+            # goes down to 8 — validating flash_tpu with the in-repo picker
+            # would pass seqs (e.g. 576) the public kernel then silently
+            # degrades to blockwise on.
+            if cfg.attn_impl == "flash_tpu":
+                from dstack_tpu.workloads.attention import _flash_block as _pick
+            else:
+                from dstack_tpu.workloads.kernels import pick_flash_block as _pick
+
+            if _pick(seq // sp) is None:
+                raise ValueError(
+                    f"attn_impl={cfg.attn_impl!r} needs a block-divisible "
+                    f"sequence length; seq={seq} has no power-of-two block "
+                    f"(pad the sequence or use attn_impl=xla)"
+                )
+        if cfg.attn_impl == "flash" and tp > 1 and cfg.n_kv_heads % tp:
+            raise ValueError(
+                f"attn_impl=flash shards heads over tp={tp}, which must "
+                f"divide n_kv_heads={cfg.n_kv_heads} (whole GQA groups per "
+                f"shard); adjust the mesh or use attn_impl=xla"
+            )
+    if cfg.tp_overlap and tp > 1 and batch and seq:
+        from dstack_tpu.workloads.kernels.collective import can_overlap
+
+        if not can_overlap(mesh, batch, seq):
+            raise ValueError(
+                f"tp_overlap needs the per-device row count (batch x seq "
+                f"after dp/fsdp/sp sharding) to split into tp={tp} ring "
+                f"chunks; batch={batch} seq={seq} mesh={dict(mesh.shape)} "
+                f"doesn't — grow the batch or drop --tp-overlap"
+            )

@@ -1,0 +1,33 @@
+"""In-repo Pallas TPU kernels for the workload hot paths.
+
+Every kernel here runs in two modes from the same source:
+
+- **compiled** (Mosaic) on a real TPU — the MFU/latency win;
+- **interpreted** (``interpret=True``) everywhere else — tier-1 CPU tests
+  exercise the *exact* kernel code, not a lookalike reference.
+
+Modules:
+
+- ``flash``      — block-tiled online-softmax flash attention, forward +
+                   custom-VJP backward (training).
+- ``paged``      — single-query paged-KV decode attention (serving).
+- ``collective`` — collective matmul: ``shard_map``-decomposed einsum that
+                   interleaves partial matmuls with ``ppermute`` ring steps so
+                   tensor-parallel ICI transfers hide under MXU compute.
+"""
+
+from dstack_tpu.workloads.kernels.collective import collective_matmul
+from dstack_tpu.workloads.kernels.flash import (
+    flash_attention,
+    flash_attention_sharded,
+    pick_flash_block,
+)
+from dstack_tpu.workloads.kernels.paged import paged_decode_attention_pallas
+
+__all__ = [
+    "collective_matmul",
+    "flash_attention",
+    "flash_attention_sharded",
+    "paged_decode_attention_pallas",
+    "pick_flash_block",
+]

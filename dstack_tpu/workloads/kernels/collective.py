@@ -1,0 +1,131 @@
+"""Collective matmul: overlap tensor-parallel ICI transfers with compute.
+
+The TP down-projections (``wo``: [H·Dh, D], ``w_down``: [F, D]) contract a
+tp-sharded axis: XLA computes the local partial matmul, then emits one big
+all-reduce the MXU sits idle behind. The collective-matmul decomposition (the
+TPU-concurrency paper's "move latency hiding into the program") splits the
+local matmul into ``tp`` row chunks and rides a ``ppermute`` ring:
+
+  step s: send the accumulating chunk to the next device (async ICI hop),
+          compute the next partial chunk (MXU),
+          add the received accumulator.
+
+After tp-1 steps each device owns one fully-reduced output chunk (a
+reduce-scatter whose transfers hid under the partial matmuls), and one tiled
+all-gather rebuilds the replicated activation. Same math as
+matmul-then-all-reduce — the 8-device CPU-mesh test asserts equality — but on
+TPU the per-step ppermute (1/tp of the tensor, neighbor hop) overlaps with the
+next chunk's matmul under XLA's async collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _default_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    # Master weights may be fp32 while activations are bf16: compute in the
+    # activation dtype with fp32 accumulation, like every model projection.
+    return jax.lax.dot_general(
+        x, w.astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def can_overlap(
+    mesh: Optional[Mesh],
+    batch: int,
+    seq: int,
+    axis: str = "tp",
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+) -> bool:
+    """True when the ring decomposition applies: tp > 1 and the LOCAL row
+    count (batch and sequence after dp/fsdp/sp sharding) splits into tp
+    chunks."""
+    if mesh is None:
+        return False
+    tp = mesh.shape.get(axis, 1)
+    if tp <= 1:
+        return False
+    data = 1
+    for a in batch_axes:
+        data *= mesh.shape.get(a, 1)
+    sp = mesh.shape.get("sp", 1)
+    if batch % data or seq % sp:
+        return False
+    rows = (batch // data) * (seq // sp)
+    return rows % tp == 0
+
+
+def collective_matmul(
+    x: jax.Array,   # [B, T, K] — K sharded over `axis`
+    w: jax.Array,   # [K, N]    — K sharded over `axis`
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    matmul: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
+) -> jax.Array:
+    """y = x @ w with the contraction axis sharded over ``axis`` on both
+    operands; returns fp32 [B, T, N] replicated over ``axis`` (sharded over
+    the batch axes / sp like any activation).
+
+    ``matmul(x2d, w2d) -> f32`` computes each partial chunk — the default is a
+    plain fp dot; pass the int8 path to quantize the partials (scales are
+    per-shard, which is exactly per-channel on the local contraction rows).
+
+    Caller contract: local rows (B/|batch_axes| · T/sp) divide tp — check with
+    ``can_overlap`` and fall back to the plain einsum otherwise.
+    """
+    mm = matmul or _default_matmul
+    tp = mesh.shape[axis]
+    # One explicit gather for any other sharding on w's contraction dim (the
+    # fsdp gather-on-use XLA inserts anyway); inside shard_map w is then
+    # exactly [K/tp, N] per shard.
+    w = jax.lax.with_sharding_constraint(w, NamedSharding(mesh, P(axis, None)))
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(batch_axes, "sp", axis), P(axis, None)),
+        out_specs=P(batch_axes, "sp", None),
+        check_rep=False,
+    )
+    def _ring(x_loc, w_loc):
+        b, t, k = x_loc.shape
+        n = w_loc.shape[1]
+        rows = b * t
+        chunk = rows // tp
+        xf = x_loc.reshape(rows, k)
+        my = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % tp) for i in range(tp)]
+
+        def partial_chunk(c):
+            xc = jax.lax.dynamic_slice_in_dim(xf, c * chunk, chunk, axis=0)
+            return mm(xc, w_loc)  # [chunk, N] f32
+
+        # Chunk c's accumulator starts at device c+1, rides the ring adding
+        # each host's partial, and lands fully reduced on its owner c after
+        # tp-1 hops. So device d seeds chunk d-1, and at step s it receives
+        # the accumulator seeded s hops back — chunk d-s-1 — and adds its own
+        # partial for that chunk.
+        acc = partial_chunk((my - 1) % tp)
+
+        def step(acc, s):
+            acc = jax.lax.ppermute(acc, axis, perm)
+            # ppermute does not depend on the next partial: XLA's async
+            # collectives start the hop, the MXU fills it with this matmul.
+            return acc + partial_chunk((my - s - 1) % tp), None
+
+        if tp > 1:
+            acc, _ = jax.lax.scan(step, acc, jnp.arange(1, tp))
+        full = jax.lax.all_gather(acc, axis, axis=0, tiled=True)  # [rows, N]
+        return full.reshape(b, t, n)
+
+    return _ring(x, w)

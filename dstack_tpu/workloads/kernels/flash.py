@@ -1,0 +1,432 @@
+"""In-repo Pallas flash attention: block-tiled online softmax, fwd + bwd.
+
+The memory-bound half of the 52%-MFU plateau (ROADMAP item 4): the XLA
+blockwise scan keeps scores out of HBM but still round-trips the online-softmax
+state through layout shuffles XLA chooses; this kernel owns the tiling.
+Layout mirrors the public ``jax.experimental.pallas.ops.tpu.flash_attention``
+([B*H, T, D] with one (batch·head, q-block) program per grid cell) but the
+backward pass is in-repo too (custom VJP, separate dq and dk/dv kernels), so
+``interpret=True`` runs the *identical* code CPU-side — tier-1 tests assert
+fwd+grad equivalence against ``blockwise_attention`` to 1e-4.
+
+Differences vs the public kernel worth knowing:
+- GQA never materializes repeated KV: q rows for one KV head are contiguous
+  after the [B*H, T, D] reshape (head = group·n_rep + rep), so the forward/dq
+  index maps point program b at KV row b // n_rep, and the dk/dv grid streams
+  each KV row's n_rep q rows block-by-block into a resident accumulator —
+  same head convention as ``attention._repeat_kv``, none of the n_rep× KV
+  HBM traffic.
+- Sequence lengths must divide the chosen block sizes; ``pick_flash_block``
+  picks the largest power-of-two block that fits, and the dispatcher
+  (attention.attention_core) falls back to blockwise when none does.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+# Candidate block edges, largest first. 128 matches the MXU tile; smaller
+# blocks only exist so tiny CPU-test shapes can run the same kernel.
+_BLOCKS = (512, 256, 128, 64, 32, 16, 8)
+
+
+def pick_flash_block(seq_len: int, cap: int = 512) -> Optional[int]:
+    """Largest candidate block <= cap that divides seq_len (None when none
+    does — e.g. prime lengths — in which case flash cannot run)."""
+    for b in _BLOCKS:
+        if b <= cap and seq_len >= b and seq_len % b == 0:
+            return b
+    return None
+
+
+from dstack_tpu.workloads.kernels.platform import use_interpret as _use_interpret
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_q,
+                block_k, scale):
+    """One (batch·head, q-block) program: online softmax over KV blocks.
+
+    Refs: q [1, block_q, D]; k/v [1, S, D]; o [1, block_q, D]; lse [1, block_q].
+    """
+    iq = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+
+    n_kv = s_len // block_k
+    if causal:
+        # Only blocks whose first position can be <= the last q position.
+        hi = (iq * block_q + block_q + block_k - 1) // block_k
+        hi = jnp.minimum(hi, n_kv)
+    else:
+        hi = n_kv
+
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(jk, carry):
+        o, l, m = carry
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            kv_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = kv_pos <= q_pos
+            s = jnp.where(mask, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m, m_blk)
+        # All-masked rows keep m_new == NEG_INF; clamp the reference point so
+        # exp(NEG_INF - NEG_INF) can't poison l (same guard as blockwise).
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
+        p = jnp.exp(s - safe_m)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o * corr + pv, l_new, m_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(0, hi, body, (o0, l0, m0))
+
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    # logsumexp residual for the backward pass: p = exp(s - lse).
+    lse_ref[0] = (jnp.where(m == NEG_INF, NEG_INF, m) + jnp.log(l_safe))[:, 0]
+
+
+def _flash_fwd_3d(q3, k3, v3, causal, block_q, block_k, interpret):
+    """q3 [BH, T, D], k3/v3 [BKh, S, D] -> (o [BH, T, D] f32, lse [BH, T] f32).
+
+    GQA rides the index maps: program b reads KV row b // n_rep, so shared KV
+    heads are never copied n_rep× into HBM."""
+    bh, t, d = q3.shape
+    bkh, s_len, _ = k3.shape
+    n_rep = bh // bkh
+    scale = float(1.0 / (d ** 0.5))
+    grid = (bh, t // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# backward
+#
+# Standard flash backward split: dq accumulates over KV blocks (same grid as
+# the forward); dk/dv stream (repeat-head, q-block) pairs through an inner
+# grid axis into a resident output tile — each (KV row, KV block) tile is
+# owned by one grid column, so no cross-program races. delta = rowsum(do*o)
+# is precomputed outside (one cheap fused elementwise reduce).
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   causal, block_q, block_k, scale):
+    iq = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]      # [bq, 1]
+    delta = delta_ref[0][:, None]  # [bq, 1]
+
+    n_kv = s_len // block_k
+    if causal:
+        hi = jnp.minimum((iq * block_q + block_q + block_k - 1) // block_k, n_kv)
+    else:
+        hi = n_kv
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(jk, dq):
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        # Fully-masked rows carry lse == NEG_INF (so s - lse would be +inf);
+        # clamp the reference and zero p so their gradients stay 0, matching
+        # the forward's guard.
+        p = jnp.where(
+            lse == NEG_INF, 0.0,
+            jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse)),
+        )
+        if causal:
+            kv_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(kv_pos <= q_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq_ref[0] = jax.lax.fori_loop(
+        0, hi, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, *, causal, block_q, block_k, scale, n_q):
+    """Grid (bkh, kv-block, n_rep·n_q): the innermost axis streams one
+    (repeat-head, q-block) pair per step while the (b, j) output block stays
+    resident in VMEM, accumulating across steps — VMEM holds one q block, not
+    the repeat group's whole [n_rep, T, D] (which at llama-8k shapes would
+    blow the budget)."""
+    jk = pl.program_id(1)
+    qi = pl.program_id(2)
+    iq = jax.lax.rem(qi, n_q)  # q-block index within this repeat head
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    def contrib():
+        q_blk = q_ref[0].astype(jnp.float32)   # [bq, D]
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        k_blk = k_ref[0].astype(jnp.float32)   # [bk, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        # Same fully-masked-row guard as the dq pass: lse == NEG_INF rows
+        # contribute nothing (not inf).
+        p = jnp.where(
+            lse == NEG_INF, 0.0,
+            jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse)),
+        )
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kv_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(kv_pos <= q_pos, p, 0.0)
+        dv_ref[0] = dv_ref[0] + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_ref[0] = dk_ref[0] + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # q blocks strictly before this KV block contribute nothing.
+        pl.when(iq >= (jk * block_k) // block_q)(contrib)
+    else:
+        contrib()
+
+
+def _flash_bwd_3d(q3, k3, v3, o3, lse, do3, causal, block_q, block_k,
+                  interpret):
+    bh, t, d = q3.shape
+    bkh, s_len, _ = k3.shape
+    n_rep = bh // bkh
+    scale = float(1.0 / (d ** 0.5))
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, scale=scale),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    # One output block per (KV row, KV block); the innermost grid axis streams
+    # the q-side repeat group one (repeat-head qi // n_q, q-block qi % n_q)
+    # pair at a time (q3 rows for KV row b are the contiguous [b·n_rep,
+    # (b+1)·n_rep)), accumulating into the resident dk/dv block.
+    n_q = t // block_q
+    q_map = lambda b, j, qi, n=n_rep, m=n_q: (b * n + qi // m, qi % m, 0)
+    stat_map = lambda b, j, qi, n=n_rep, m=n_q: (b * n + qi // m, qi % m)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, scale=scale, n_q=n_q),
+        grid=(bkh, s_len // block_k, n_rep * n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q), stat_map),
+            pl.BlockSpec((1, block_q), stat_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bkh, s_len, d), jnp.float32),
+            jax.ShapeDtypeStruct((bkh, s_len, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper on the [BH, T, D] layout
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_3d(q3, k3, v3, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd_3d(q3, k3, v3, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_3d_fwd(q3, k3, v3, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd_3d(q3, k3, v3, causal, block_q, block_k, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_3d_bwd(causal, block_q, block_k, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    dq, dk, dv = _flash_bwd_3d(
+        q3, k3, v3, o3, lse, do3, causal, block_q, block_k, interpret
+    )
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry points (attention.py layout: [B, T, H, D])
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, Kh, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused flash attention; returns fp32 [B, T, H, D] (the blockwise
+    contract). Raises ValueError when the sequence lengths admit no block
+    size — dispatchers that want a silent fallback must check
+    ``pick_flash_block`` first."""
+    b, t, h, d = q.shape
+    s_len, kh = k.shape[1], k.shape[2]
+    bq = block_q or pick_flash_block(t)
+    bk = block_k or pick_flash_block(s_len)
+    if bq is None or bk is None or t % bq or s_len % bk:
+        raise ValueError(
+            f"flash attention needs block-divisible sequence lengths; "
+            f"T={t} S={s_len} have no usable block (pass attn_impl=xla "
+            f"or pad the sequence)"
+        )
+    # GQA: q rows for one KV head are adjacent after the reshape (q3 row
+    # b·h + g·n_rep + r floors to KV row b·kh + g under // n_rep), so the
+    # kernels index the shared KV row directly — no repeated copies.
+    q3 = q.swapaxes(1, 2).reshape(b * h, t, d)
+    k3 = k.swapaxes(1, 2).reshape(b * kh, s_len, d)
+    v3 = v.swapaxes(1, 2).reshape(b * kh, s_len, d)
+    o3 = _flash_3d(q3, k3, v3, causal, bq, bk, _use_interpret(interpret))
+    return o3.reshape(b, h, t, d).swapaxes(1, 2)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """flash_attention under a (dp, fsdp, tp) mesh via shard_map.
+
+    A Pallas custom call has no SPMD partitioning rule, so under a sharded jit
+    it would force operand replication; attention is embarrassingly parallel
+    over (batch, head), so shard_map over the batch axes and tp (heads) makes
+    each shard run the kernel on its local [b_loc, T, h_loc, D] block. Requires
+    sp == 1 (sequence-parallel runs use ring attention) and tp | n_kv_heads
+    (each shard must keep whole GQA groups) — attention_core validates."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(batch_axes, None, "tp", None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    def _local(q_loc, k_loc, v_loc):
+        return flash_attention(
+            q_loc, k_loc, v_loc, causal=causal, interpret=interpret
+        )
+
+    return _local(q, k, v)

@@ -1,0 +1,130 @@
+"""Pallas paged-decode attention: the serving engine's decode hot path.
+
+The XLA reference (``attention.paged_decode_attention``) gathers every slot's
+page table into a dense [S, P·page, Kh, D] tensor each step — on TPU that is a
+full HBM materialization of the padded KV window per layer per token. This
+kernel walks each slot's page list directly: pages stay in HBM, each one is
+DMA'd into a VMEM scratch buffer exactly once, and the online softmax
+accumulates per page, so the working set is one page instead of the whole
+padded window. Page ids and KV lengths ride the scalar-prefetch lane
+(``PrefetchScalarGridSpec``) so the DMA addresses are known before the body
+runs.
+
+Semantics are identical to the XLA reference (tests assert token-identity
+through the engine, preemption included): slots attend to their first
+``kv_lens[s]`` positions; ``kv_lens == 0`` slots produce finite garbage the
+engine discards, never NaN.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(page_table_ref, kv_lens_ref, q_ref, k_pages_ref,
+                  v_pages_ref, o_ref, k_scratch, v_scratch, sems, *,
+                  page: int, n_rep: int):
+    """One program per decode slot. q [1, H, D]; k/v pages stay in HBM and are
+    DMA'd per page; out [1, H, D] fp32."""
+    slot = pl.program_id(0)
+    kh, d = k_pages_ref.shape[2], k_pages_ref.shape[3]
+    kv_len = kv_lens_ref[slot]
+    n_pages = pl.cdiv(kv_len, page)
+
+    q = q_ref[0].astype(jnp.float32).reshape(kh, n_rep, d)
+    scale = 1.0 / (d ** 0.5)
+
+    def body(p_idx, carry):
+        o, l, m = carry
+        page_id = page_table_ref[slot, p_idx]
+        k_dma = pltpu.make_async_copy(
+            k_pages_ref.at[page_id], k_scratch, sems.at[0]
+        )
+        v_dma = pltpu.make_async_copy(
+            v_pages_ref.at[page_id], v_scratch, sems.at[1]
+        )
+        k_dma.start()
+        v_dma.start()
+        k_dma.wait()
+        v_dma.wait()
+        k_blk = k_scratch[...].astype(jnp.float32)  # [page, Kh, D]
+        v_blk = v_scratch[...].astype(jnp.float32)
+        # s[kh, n_rep, page]: contract D per KV head group.
+        s = jax.lax.dot_general(
+            q, k_blk, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        pos = p_idx * page + jax.lax.broadcasted_iota(
+            jnp.int32, (kh, n_rep, page), 2
+        )
+        valid = pos < kv_len
+        s = jnp.where(valid, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
+        prob = jnp.where(valid, jnp.exp(s - safe_m), 0.0)
+        l_new = l * corr + jnp.sum(prob, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            prob, v_blk, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )  # [kh, n_rep, D]
+        return o * corr + pv, l_new, m_new
+
+    o0 = jnp.zeros((kh, n_rep, d), jnp.float32)
+    l0 = jnp.zeros((kh, n_rep, 1), jnp.float32)
+    m0 = jnp.full((kh, n_rep, 1), NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(0, n_pages, body, (o0, l0, m0))
+    # Inactive slots (kv_len == 0) never looped: l == 0 -> zeros, not NaN.
+    # The XLA reference emits uniform weights over garbage instead; both are
+    # finite and both rows are discarded by the engine.
+    o_ref[0] = (o / jnp.maximum(l, 1e-20)).reshape(kh * n_rep, d)
+
+
+from dstack_tpu.workloads.kernels.platform import use_interpret as _use_interpret
+
+
+def paged_decode_attention_pallas(
+    q: jax.Array,           # [S, H, D] — one query per decode slot
+    k_pages: jax.Array,     # [N, page, Kh, D]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [S, P] int32 page ids
+    kv_lens: jax.Array,     # [S] valid KV length per slot
+    *,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for ``attention.paged_decode_attention`` (fp32 [S, H, D])."""
+    s, h, d = q.shape
+    n, page, kh, _ = k_pages.shape
+    n_rep = h // kh
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((page, kh, d), k_pages.dtype),
+            pltpu.VMEM((page, kh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, page=page, n_rep=n_rep)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), jnp.float32),
+        interpret=_use_interpret(interpret),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), q,
+      k_pages, v_pages)

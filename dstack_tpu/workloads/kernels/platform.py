@@ -1,0 +1,28 @@
+"""One place that answers "will this computation land on a TPU?".
+
+Tests pin ``jax_default_device`` to CPU while the axon TPU plugin still owns
+``jax.devices()[0]``, so the default device wins when set — the same probe
+``attention.flash_available`` uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def is_tpu_default_device() -> bool:
+    try:
+        dev = jax.config.jax_default_device or jax.devices()[0]
+        return getattr(dev, "platform", None) == "tpu"
+    except Exception:
+        return False
+
+
+def use_interpret(interpret: Optional[bool]) -> bool:
+    """Kernel default: compiled (Mosaic) on TPU, interpreted elsewhere so CPU
+    tests run the exact kernel code."""
+    if interpret is not None:
+        return interpret
+    return not is_tpu_default_device()

@@ -22,16 +22,45 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dstack_tpu.workloads.attention import (
-    blockwise_attention,
-    flash_attention_tpu,
-    flash_available,
-    plain_attention,
-    ring_attention,
-)
+from dstack_tpu.workloads import quantize as quant_lib
+from dstack_tpu.workloads.attention import attention_core
 from dstack_tpu.workloads.config import LlamaConfig
+from dstack_tpu.workloads.kernels.collective import can_overlap, collective_matmul
 
 Params = Dict[str, jax.Array]
+
+
+def dense_proj(x: jax.Array, w: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """``x[..., K] @ w[K, N]`` in the activation dtype, under cfg.quant:
+    the fp path is the einsum-with-fp32-accumulation every projection used
+    before; ``int8`` runs the dynamically-quantized STE dot."""
+    return quant_lib.matmul(x, w, cfg.quant, adt=x.dtype)
+
+
+def down_proj(
+    x: jax.Array,   # [B, T, K] — K (heads/ff hidden) tp-sharded
+    w: jax.Array,   # [K, D]
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh],
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+) -> jax.Array:
+    """The TP down-projections (wo, w_down): contraction dim tp-sharded, so
+    XLA's plain path is matmul-then-all-reduce. With cfg.tp_overlap the
+    collective-matmul ring (kernels/collective.py) hides that all-reduce
+    under the partial matmuls; falls back to the plain path when the ring
+    doesn't divide (validate_config raises loudly for CLI-requested combos).
+    """
+    if (
+        cfg.tp_overlap
+        and mesh is not None
+        and mesh.shape.get("tp", 1) > 1
+        and can_overlap(mesh, x.shape[0], x.shape[1], batch_axes=batch_axes)
+    ):
+        mm = quant_lib.int8_matmul_ste if cfg.quant == "int8" else None
+        return collective_matmul(
+            x, w, mesh, batch_axes=batch_axes, matmul=mm
+        ).astype(x.dtype)
+    return dense_proj(x, w, cfg)
 
 
 def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
@@ -161,15 +190,11 @@ def attention_sublayer(
     adt = x.dtype
     b, t = x.shape[0], x.shape[1]
     name = checkpoint_name
-    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
 
     h_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = name(jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
-                        preferred_element_type=jnp.float32).astype(adt), "proj")
-    k = name(jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
-                        preferred_element_type=jnp.float32).astype(adt), "proj")
-    v = name(jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
-                        preferred_element_type=jnp.float32).astype(adt), "proj")
+    q = name(dense_proj(h_in, layer["wq"], cfg), "proj")
+    k = name(dense_proj(h_in, layer["wk"], cfg), "proj")
+    v = name(dense_proj(h_in, layer["wv"], cfg), "proj")
     q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
     k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
@@ -178,20 +203,9 @@ def attention_sublayer(
     v = act_constraint(v, P(batch_axes, "sp", "tp", None))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if use_sp:
-        o = ring_attention(q, k, v, mesh, batch_axes=batch_axes)
-    elif cfg.attn_impl == "flash" and mesh is None and flash_available():
-        # Flash only without a mesh: a Pallas tpu_custom_call has no SPMD
-        # partitioning rule, so under a sharded jit it would force operand
-        # replication. Sharded runs use blockwise/ring (shard_map) instead.
-        o = flash_attention_tpu(q, k, v)
-    elif cfg.attn_impl == "plain":
-        o = plain_attention(q, k, v)
-    else:
-        o = blockwise_attention(q, k, v)
+    o = attention_core(q, k, v, cfg.attn_impl, mesh, batch_axes=batch_axes)
     o = name(o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim), "proj")
-    attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
-                          preferred_element_type=jnp.float32).astype(adt)
+    attn_out = down_proj(o, layer["wo"], cfg, mesh, batch_axes).astype(adt)
     return x + act_constraint(attn_out, P(batch_axes, "sp", None))
 
 
@@ -214,14 +228,11 @@ def transformer_block(
     x = attention_sublayer(x, layer, cfg, positions, mesh, act_constraint)
 
     h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = name(jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt), "proj")
-    up = name(jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
-                         preferred_element_type=jnp.float32).astype(adt), "proj")
+    gate = name(dense_proj(h2, layer["w_gate"], cfg), "proj")
+    up = name(dense_proj(h2, layer["w_up"], cfg), "proj")
     hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
     hidden = act_constraint(hidden, P(("dp", "fsdp"), "sp", "tp"))
-    mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
-                         preferred_element_type=jnp.float32).astype(adt)
+    mlp_out = down_proj(hidden, layer["w_down"], cfg, mesh).astype(adt)
     return x + act_constraint(mlp_out, P(("dp", "fsdp"), "sp", None))
 
 
@@ -281,8 +292,11 @@ def forward(
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     if return_hidden:
         return x
-    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(adt),
-                        preferred_element_type=jnp.float32)
+    if cfg.quant == "int8":
+        logits = quant_lib.int8_matmul_ste(x, params["lm_head"])
+    else:
+        logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(adt),
+                            preferred_element_type=jnp.float32)
     return act_constraint(logits, P(("dp", "fsdp"), "sp", None))
 
 
@@ -291,6 +305,7 @@ def _chunked_nll(
     lm_head: jax.Array,  # [D, V]
     targets: jax.Array,  # [B, T]; -1 = ignore
     chunk: int,
+    quant: str = "none",
 ) -> Tuple[jax.Array, jax.Array]:
     """Cross-entropy without materializing [B,T,V] fp32 logits: scan the sequence
     in chunks; each chunk's logits+log_softmax live only inside its scan step and
@@ -303,8 +318,11 @@ def _chunked_nll(
 
     @jax.checkpoint
     def chunk_nll(x_blk, t_blk):
-        logits = jnp.einsum("bcd,dv->bcv", x_blk, lm_head,
-                            preferred_element_type=jnp.float32)
+        if quant == "int8":
+            logits = quant_lib.int8_matmul_ste(x_blk, lm_head)
+        else:
+            logits = jnp.einsum("bcd,dv->bcv", x_blk, lm_head,
+                                preferred_element_type=jnp.float32)
         mask = t_blk >= 0
         safe = jnp.where(mask, t_blk, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -364,6 +382,7 @@ def loss_fn(
     if chunk:
         hidden = forward(params, tokens, cfg, mesh, return_hidden=True)
         lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
-        total_nll, total_cnt = _chunked_nll(hidden, lm_head, targets, chunk)
+        total_nll, total_cnt = _chunked_nll(hidden, lm_head, targets, chunk,
+                                            quant=cfg.quant)
         return total_nll / jnp.maximum(total_cnt, 1)
     return masked_ce(forward(params, tokens, cfg, mesh), targets)

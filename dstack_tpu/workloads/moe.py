@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import quantize as quant_lib
 from dstack_tpu.workloads.config import LlamaConfig
 
 Params = Dict[str, jax.Array]
@@ -220,11 +221,23 @@ def moe_mlp(
     x = x.reshape(g, group, d)
     cap = expert_capacity(cfg, group)
 
+    # Routing stays full-precision under quant=int8 (a mis-rounded router
+    # flips token->expert assignments, which costs far more than the matmul
+    # flops it would save); the expert matmuls below fake-quantize their
+    # weights to the int8 grid with straight-through gradients — the
+    # einsum-shaped path for per-expert [E, D, F] tensors that the dense
+    # model's int8 dot_general can't express (quantize.fake_quant).
     router_logits = jnp.einsum(
         "gsd,de->gse", x, layer["router"].astype(adt),
         preferred_element_type=jnp.float32,
     )
     combine, dispatch, aux = top_k_routing(router_logits, cfg.top_k, cap)
+
+    def expert_w(key: str) -> jax.Array:
+        w = layer[key].astype(adt)
+        if cfg.quant == "int8":
+            w = quant_lib.fake_quant(w, axis=1)  # contraction dim of [E, K, N]
+        return w
 
     def constrain(a, spec):
         if mesh is None:
@@ -239,13 +252,13 @@ def moe_mlp(
     expert_in = jnp.einsum("gsec,gsd->egcd", dispatch.astype(adt), x)
     expert_in = constrain(expert_in, P("ep", ("dp", "fsdp"), None, None))
 
-    gate = jnp.einsum("egcd,edf->egcf", expert_in, layer["w_gate"].astype(adt),
+    gate = jnp.einsum("egcd,edf->egcf", expert_in, expert_w("w_gate"),
                       preferred_element_type=jnp.float32).astype(adt)
-    up = jnp.einsum("egcd,edf->egcf", expert_in, layer["w_up"].astype(adt),
+    up = jnp.einsum("egcd,edf->egcf", expert_in, expert_w("w_up"),
                     preferred_element_type=jnp.float32).astype(adt)
     hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
     hidden = constrain(hidden, P("ep", ("dp", "fsdp"), None, "tp"))
-    expert_out = jnp.einsum("egcf,efd->egcd", hidden, layer["w_down"].astype(adt),
+    expert_out = jnp.einsum("egcf,efd->egcd", hidden, expert_w("w_down"),
                             preferred_element_type=jnp.float32).astype(adt)
     expert_out = constrain(expert_out, P("ep", ("dp", "fsdp"), None, None))
 

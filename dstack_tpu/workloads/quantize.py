@@ -1,0 +1,165 @@
+"""Int8 quantized matmuls for train (STE) and serve (weight-only).
+
+Two regimes, one scale scheme (per-channel absmax, symmetric, no zero point —
+the TPU-friendly layout: scales broadcast along lanes, the MXU runs the int8
+dot natively with int32 accumulation):
+
+- **Dynamic int8 for training** (``int8_matmul_ste``): both operands are
+  quantized on the fly — activations per row (over the contraction dim),
+  weights per output channel — the dot runs int8×int8→int32, and the result
+  is rescaled in fp32. The custom VJP is a straight-through estimator: the
+  backward pass uses the ORIGINAL fp operands, so gradients flow exactly as
+  in the fp step and the quantization noise acts as forward-only
+  regularization. This is what makes the tiny-config convergence test ("int8
+  not worse") meaningful.
+- **Weight-only int8 for serving** (``quantize_weight`` +
+  ``weight_only_matmul``): weights are quantized ONCE at engine build
+  (halving their HBM vs bf16, the usual serve bottleneck), dequantized on the
+  fly into the activation dtype, and the matmul accumulates in fp32. No
+  activation quantization — decode batches are small, so the matmul is
+  bandwidth-bound on weights and the fp activation path keeps greedy-decode
+  drift minimal.
+
+Everything is expressed over the one matmul shape the model uses after
+``lax.scan`` unstacks the layer axis: ``x[..., K] @ w[K, N]``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+
+
+class QuantizedWeight(NamedTuple):
+    """int8 values + fp32 per-output-channel scales (shape [..., 1, N] so a
+    stacked [L, K, N] weight carries [L, 1, N] scales that slice cleanly
+    under scan)."""
+
+    values: jax.Array  # int8
+    scales: jax.Array  # float32
+
+
+def absmax_scales(x: jax.Array, axis: int) -> jax.Array:
+    """Symmetric per-channel scales over ``axis`` (fp32, keepdims). Zero
+    channels get scale 1 so dequantization never divides by zero."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    s = s / INT8_MAX
+    return jnp.where(s == 0.0, 1.0, s)
+
+
+def quantize_int8(x: jax.Array, axis: int) -> Tuple[jax.Array, jax.Array]:
+    """(int8 values, fp32 keepdims scales); round-to-nearest-even, clipped."""
+    scales = absmax_scales(x, axis)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scales), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), scales
+
+
+def dequantize(values: jax.Array, scales: jax.Array) -> jax.Array:
+    return values.astype(jnp.float32) * scales
+
+
+def quantize_weight(w: jax.Array, axis: int = -2) -> QuantizedWeight:
+    """Per-output-channel weight quantization; ``axis`` is the contraction
+    dim (default: second-to-last, i.e. K of [..., K, N])."""
+    values, scales = quantize_int8(w, axis)
+    return QuantizedWeight(values, scales)
+
+
+def int8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Dynamically-quantized ``x[..., K] @ w[K, N]`` -> fp32.
+
+    Activations: per-row scales (each [..., K] row quantized over K).
+    Weights: per-output-channel scales (each column over K). The dot itself is
+    int8×int8 with int32 accumulation (``preferred_element_type`` routes it to
+    the MXU's native int8 path on TPU); both scales factor out exactly, so the
+    only error is the rounding of the operands.
+    """
+    xq, xs = quantize_int8(x, axis=-1)   # xs [..., 1]
+    wq, ws = quantize_int8(w, axis=0)    # ws [1, N]
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * xs * ws
+
+
+@jax.custom_vjp
+def int8_matmul_ste(x: jax.Array, w: jax.Array) -> jax.Array:
+    """int8_matmul with straight-through gradients (train path)."""
+    return int8_matmul(x, w)
+
+
+def _ste_fwd(x, w):
+    return int8_matmul(x, w), (x, w)
+
+
+def _ste_bwd(res, g):
+    # Straight-through: differentiate y = x @ w as if no quantization
+    # happened, against the ORIGINAL operands. g is fp32 [..., N].
+    x, w = res
+    dx = jax.lax.dot_general(
+        g, w.astype(jnp.float32), (((g.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    gf = g.reshape(-1, g.shape[-1])
+    xf = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    dw = jax.lax.dot_general(
+        xf, gf, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+int8_matmul_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def weight_only_matmul(
+    x: jax.Array,          # [..., K] activation dtype
+    values: jax.Array,     # [K, N] int8
+    scales: jax.Array,     # [1, N] fp32
+) -> jax.Array:
+    """Serve path: dequantize-on-use, fp32 accumulation; returns fp32."""
+    w = values.astype(x.dtype)
+    acc = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return acc * scales
+
+
+def fake_quant(w: jax.Array, axis: int) -> jax.Array:
+    """Quantize-dequantize with straight-through gradients — the einsum-shaped
+    escape hatch for weights ``int8_matmul`` can't express (the MoE per-expert
+    [E, D, F] tensors): numerics are int8-grid exact, accumulation stays fp.
+    """
+    values, scales = quantize_int8(w, axis)
+    deq = (values.astype(jnp.float32) * scales).astype(w.dtype)
+    return w + jax.lax.stop_gradient(deq - w)
+
+
+def matmul(x: jax.Array, w: jax.Array, quant: str, adt=None) -> jax.Array:
+    """The model-side dispatch: ``x[..., K] @ w[K, N]`` under the config's
+    ``quant`` mode, returned in ``adt`` (default: x.dtype). ``w`` is the fp
+    master weight — serve's pre-quantized path uses ``weight_only_matmul``
+    directly."""
+    adt = adt or x.dtype
+    if quant == "int8":
+        return int8_matmul_ste(x, w).astype(adt)
+    out = jax.lax.dot_general(
+        x, w.astype(adt), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(adt)
+
+
+QUANT_MODES = ("none", "int8")
+
+
+def check_quant(quant: str) -> None:
+    if quant not in QUANT_MODES:
+        raise ValueError(
+            f"unknown quant mode {quant!r}; expected one of {QUANT_MODES}"
+        )

@@ -43,14 +43,79 @@ import jax.numpy as jnp
 import numpy as np
 
 from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import quantize as quant_lib
 from dstack_tpu.workloads.attention import blockwise_attention, paged_decode_attention
 from dstack_tpu.workloads.config import LlamaConfig, get_config
+from dstack_tpu.workloads.kernels.paged import paged_decode_attention_pallas
 
 logger = logging.getLogger(__name__)
 
-_LAYER_KEYS = (
-    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "attn_norm", "mlp_norm",
-)
+_WEIGHT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+_NORM_KEYS = ("attn_norm", "mlp_norm")
+_LAYER_KEYS = _WEIGHT_KEYS + _NORM_KEYS
+
+DECODE_IMPLS = ("auto", "xla", "pallas")
+
+
+def resolve_decode_impl(impl: str) -> str:
+    """"auto" = the Pallas paged kernel on TPU (pages stay in HBM, one DMA per
+    page), the XLA gather elsewhere (interpret-mode Pallas is orders slower
+    than compiled XLA on CPU — tests/bench opt in explicitly)."""
+    if impl != "auto":
+        return impl
+    from dstack_tpu.workloads.kernels.platform import is_tpu_default_device
+
+    return "pallas" if is_tpu_default_device() else "xla"
+
+
+def quantize_serve_params(params: dict) -> dict:
+    """Weight-only int8 for serving: every projection weight becomes an int8
+    tensor + per-output-channel fp32 scales (``<k>_q`` / ``<k>_s``), halving
+    weight HBM vs bf16; embeddings and norms stay full-precision (the embed
+    is a gather, the norms are tiny)."""
+    out = {
+        "embed": params["embed"],
+        "final_norm": params["final_norm"],
+        "attn_norm": params["attn_norm"],
+        "mlp_norm": params["mlp_norm"],
+    }
+    for k in _WEIGHT_KEYS + ("lm_head",):
+        qw = quant_lib.quantize_weight(params[k])  # contraction = 2nd-to-last
+        out[k + "_q"] = qw.values
+        out[k + "_s"] = qw.scales
+    return out
+
+
+def _serve_layer_keys(quant: str):
+    if quant != "int8":
+        return _LAYER_KEYS
+    return tuple(
+        f"{k}_{suffix}" for k in _WEIGHT_KEYS for suffix in ("q", "s")
+    ) + _NORM_KEYS
+
+
+def _proj(x: jax.Array, layer: dict, key: str, adt, quant: str) -> jax.Array:
+    """x[..., K] @ layer[key] in adt: fp einsum, or weight-only int8."""
+    if quant == "int8":
+        return quant_lib.weight_only_matmul(
+            x, layer[key + "_q"], layer[key + "_s"]
+        ).astype(adt)
+    w = layer[key].astype(adt)
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(adt)
+
+
+def _logits(x: jax.Array, params: dict, adt, quant: str) -> jax.Array:
+    if quant == "int8":
+        return quant_lib.weight_only_matmul(
+            x, params["lm_head_q"], params["lm_head_s"]
+        )
+    return jax.lax.dot_general(
+        x, params["lm_head"].astype(adt), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +132,12 @@ class EngineConfig:
     policy: str = "continuous"
     eos_id: Optional[int] = None
     max_new_default: int = 16
+    # Decode attention: "auto" = Pallas paged kernel on TPU / XLA gather on
+    # CPU; "xla"/"pallas" force one (kernels/paged.py).
+    decode_impl: str = "auto"
+    # "int8" = weight-only quantization (quantize_serve_params): projection
+    # weights stored int8 + per-channel scales, dequantized on use.
+    quant: str = "none"
 
 
 class TokenEvent(NamedTuple):
@@ -105,11 +176,11 @@ def _rope_single(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=None)
-def make_prefill_fn(cfg: LlamaConfig):
+def make_prefill_fn(cfg: LlamaConfig, quant: str = "none"):
     """jit'd (params, tokens, k_pages, v_pages, write_page, write_off, lens)
-    -> (next_tokens, k_pages, v_pages). Memoized on the (frozen) config so
-    every engine over the same model shares one jit cache — bench variants
-    don't re-compile per engine.
+    -> (next_tokens, k_pages, v_pages). Memoized on the (frozen) config +
+    quant mode so every engine over the same model shares one jit cache —
+    bench variants don't re-compile per engine.
 
     tokens [B, T] right-padded prompts; write_page/write_off [B, T] map each
     token position into the page pool (pool-size index = dropped write, which
@@ -117,6 +188,8 @@ def make_prefill_fn(cfg: LlamaConfig):
     true prompt lengths. Runs the same blockwise causal attention as training
     forward(); returns the greedy next token after each prompt's LAST valid
     position. Cache buffers are donated: the update is in-place on device.
+    With quant="int8" the params are the ``quantize_serve_params`` layout
+    (weight-only int8 + per-channel scales).
     """
 
     def prefill(params, tokens, k_pages, v_pages, write_page, write_off, lens):
@@ -129,12 +202,9 @@ def make_prefill_fn(cfg: LlamaConfig):
         def block(x, xs):
             layer, kp, vp = xs
             h_in = model_lib._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-            q = jnp.einsum("btd,dk->btk", h_in, layer["wq"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt)
-            k = jnp.einsum("btd,dk->btk", h_in, layer["wk"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt)
-            v = jnp.einsum("btd,dk->btk", h_in, layer["wv"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt)
+            q = _proj(h_in, layer, "wq", adt, quant)
+            k = _proj(h_in, layer, "wk", adt, quant)
+            v = _proj(h_in, layer, "wv", adt, quant)
             q = q.reshape(b, t, h, hd)
             k = k.reshape(b, t, kh, hd)
             v = v.reshape(b, t, kh, hd)
@@ -144,36 +214,31 @@ def make_prefill_fn(cfg: LlamaConfig):
             vp = vp.at[write_page, write_off].set(v.astype(vp.dtype), mode="drop")
             o = blockwise_attention(q, k, v, causal=True)
             o = o.astype(adt).reshape(b, t, h * hd)
-            attn_out = jnp.einsum("btk,kd->btd", o, layer["wo"].astype(adt),
-                                  preferred_element_type=jnp.float32).astype(adt)
-            x = x + attn_out
+            x = x + _proj(o, layer, "wo", adt, quant)
             h2 = model_lib._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-            gate = jnp.einsum("btd,df->btf", h2, layer["w_gate"].astype(adt),
-                              preferred_element_type=jnp.float32).astype(adt)
-            up = jnp.einsum("btd,df->btf", h2, layer["w_up"].astype(adt),
-                            preferred_element_type=jnp.float32).astype(adt)
+            gate = _proj(h2, layer, "w_gate", adt, quant)
+            up = _proj(h2, layer, "w_up", adt, quant)
             hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
-            mlp_out = jnp.einsum("btf,fd->btd", hidden, layer["w_down"].astype(adt),
-                                 preferred_element_type=jnp.float32).astype(adt)
-            return x + mlp_out, (kp, vp)
+            return x + _proj(hidden, layer, "w_down", adt, quant), (kp, vp)
 
-        layer_params = {key: params[key] for key in _LAYER_KEYS}
+        layer_params = {key: params[key] for key in _serve_layer_keys(quant)}
         x, (k_pages, v_pages) = jax.lax.scan(
             block, x, (layer_params, k_pages, v_pages)
         )
         x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
         last_idx = jnp.clip(lens - 1, 0, t - 1)
         last = x[jnp.arange(b), last_idx]  # [B, D]
-        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"].astype(adt),
-                            preferred_element_type=jnp.float32)
+        logits = _logits(last, params, adt, quant)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
     return jax.jit(prefill, donate_argnums=(2, 3))
 
 
 @functools.lru_cache(maxsize=None)
-def make_decode_fn(cfg: LlamaConfig):
-    """jit'd single-token decode over the paged cache (memoized on config):
+def make_decode_fn(cfg: LlamaConfig, quant: str = "none",
+                   decode_impl: str = "xla"):
+    """jit'd single-token decode over the paged cache (memoized on config +
+    quant + resolved decode_impl):
     (params, last_tokens, positions, k_pages, v_pages, page_tables,
      write_page, write_off) -> (next_tokens, k_pages, v_pages).
 
@@ -181,7 +246,10 @@ def make_decode_fn(cfg: LlamaConfig):
     far) has its K/V appended to the slot's current page, then attends over
     the slot's whole paged prefix. Inactive slots ride along with dropped
     writes and garbage-but-finite outputs (fixed [max_batch] shape = one
-    compilation for the engine's whole life).
+    compilation for the engine's whole life). decode_impl="pallas" runs the
+    in-repo paged-attention kernel (kernels/paged.py) instead of the XLA
+    gather — pages are DMA'd page-at-a-time instead of materializing every
+    slot's padded KV window.
     """
 
     def decode(params, last_tokens, positions, k_pages, v_pages, page_tables,
@@ -194,39 +262,34 @@ def make_decode_fn(cfg: LlamaConfig):
         def block(x, xs):
             layer, kp, vp = xs
             h_in = model_lib._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-            q = jnp.einsum("sd,dk->sk", h_in, layer["wq"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt)
-            k = jnp.einsum("sd,dk->sk", h_in, layer["wk"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt)
-            v = jnp.einsum("sd,dk->sk", h_in, layer["wv"].astype(adt),
-                           preferred_element_type=jnp.float32).astype(adt)
+            q = _proj(h_in, layer, "wq", adt, quant)
+            k = _proj(h_in, layer, "wk", adt, quant)
+            v = _proj(h_in, layer, "wv", adt, quant)
             q = _rope_single(q.reshape(s, h, hd), positions, cfg.rope_theta)
             k = _rope_single(k.reshape(s, kh, hd), positions, cfg.rope_theta)
             v = v.reshape(s, kh, hd)
             kp = kp.at[write_page, write_off].set(k.astype(kp.dtype), mode="drop")
             vp = vp.at[write_page, write_off].set(v.astype(vp.dtype), mode="drop")
-            o = paged_decode_attention(q, kp, vp, page_tables, positions + 1)
-            attn_out = jnp.einsum("sk,kd->sd", o.astype(adt).reshape(s, h * hd),
-                                  layer["wo"].astype(adt),
-                                  preferred_element_type=jnp.float32).astype(adt)
-            x = x + attn_out
+            if decode_impl == "pallas":
+                o = paged_decode_attention_pallas(
+                    q, kp, vp, page_tables, positions + 1
+                )
+            else:
+                o = paged_decode_attention(q, kp, vp, page_tables, positions + 1)
+            x = x + _proj(o.astype(adt).reshape(s, h * hd), layer, "wo", adt,
+                          quant)
             h2 = model_lib._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-            gate = jnp.einsum("sd,df->sf", h2, layer["w_gate"].astype(adt),
-                              preferred_element_type=jnp.float32).astype(adt)
-            up = jnp.einsum("sd,df->sf", h2, layer["w_up"].astype(adt),
-                            preferred_element_type=jnp.float32).astype(adt)
+            gate = _proj(h2, layer, "w_gate", adt, quant)
+            up = _proj(h2, layer, "w_up", adt, quant)
             hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
-            mlp_out = jnp.einsum("sf,fd->sd", hidden, layer["w_down"].astype(adt),
-                                 preferred_element_type=jnp.float32).astype(adt)
-            return x + mlp_out, (kp, vp)
+            return x + _proj(hidden, layer, "w_down", adt, quant), (kp, vp)
 
-        layer_params = {key: params[key] for key in _LAYER_KEYS}
+        layer_params = {key: params[key] for key in _serve_layer_keys(quant)}
         x, (k_pages, v_pages) = jax.lax.scan(
             block, x, (layer_params, k_pages, v_pages)
         )
         x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
-        logits = jnp.einsum("sd,dv->sv", x, params["lm_head"].astype(adt),
-                            preferred_element_type=jnp.float32)
+        logits = _logits(x, params, adt, quant)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
     return jax.jit(decode, donate_argnums=(3, 4))
@@ -262,11 +325,28 @@ class ServeEngine:
         self.ecfg = engine_cfg or EngineConfig()
         if self.ecfg.policy not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling policy {self.ecfg.policy!r}")
+        if self.ecfg.decode_impl not in DECODE_IMPLS:
+            raise ValueError(
+                f"unknown decode_impl {self.ecfg.decode_impl!r}; expected one"
+                f" of {DECODE_IMPLS}"
+            )
+        quant_lib.check_quant(self.ecfg.quant)
         self.params = params if params is not None else model_lib.init_params(
             cfg, jax.random.PRNGKey(seed)
         )
-        self._prefill_fn = make_prefill_fn(cfg)
-        self._decode_fn = make_decode_fn(cfg)
+        # Weight-only int8: quantize once at engine build; the jitted fns see
+        # only the quantized layout. The fp originals are released — keeping
+        # them would hold bf16/fp32 weights in HBM *alongside* the int8 copy,
+        # inverting the memory win. Reference decoders keep their own tree.
+        quant = self.ecfg.quant
+        if quant == "int8":
+            self._serve_params = quantize_serve_params(self.params)
+            self.params = None
+        else:
+            self._serve_params = self.params
+        self.decode_impl = resolve_decode_impl(self.ecfg.decode_impl)
+        self._prefill_fn = make_prefill_fn(cfg, quant)
+        self._decode_fn = make_decode_fn(cfg, quant, self.decode_impl)
 
         page, pool = self.ecfg.page_size, self.ecfg.num_pages
         max_seq = self.ecfg.max_seq or cfg.max_seq_len
@@ -357,6 +437,8 @@ class ServeEngine:
             "finished_requests": self.total_finished,
             "preemptions": self.total_preemptions,
             "policy": self.ecfg.policy,
+            "decode_impl": self.decode_impl,
+            "quant": self.ecfg.quant,
         }
 
     # -- the step loop -----------------------------------------------------
@@ -429,7 +511,7 @@ class ServeEngine:
             write_off[i, :n] = pos % page
 
         next_tokens, self.k_pages, self.v_pages = self._prefill_fn(
-            self.params, jnp.asarray(tokens), self.k_pages, self.v_pages,
+            self._serve_params, jnp.asarray(tokens), self.k_pages, self.v_pages,
             jnp.asarray(write_page), jnp.asarray(write_off), jnp.asarray(lens),
         )
         next_tokens = np.asarray(next_tokens)
@@ -456,7 +538,7 @@ class ServeEngine:
             return
 
         next_tokens, self.k_pages, self.v_pages = self._decode_fn(
-            self.params,
+            self._serve_params,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.seq_lens, dtype=jnp.int32),
             self.k_pages,
@@ -783,6 +865,14 @@ def main() -> None:
                         help="default max_tokens when a request names none")
     parser.add_argument("--policy", default="continuous",
                         choices=["continuous", "static"])
+    parser.add_argument("--decode-impl", default="auto", dest="decode_impl",
+                        choices=list(DECODE_IMPLS),
+                        help="decode attention: auto = Pallas paged kernel on"
+                             " TPU, XLA gather elsewhere")
+    parser.add_argument("--quant", default="none", choices=["none", "int8"],
+                        help="int8 = weight-only quantization (projection"
+                             " weights stored int8 + per-channel scales —"
+                             " half the weight HBM)")
     args = parser.parse_args()
 
     cfg = get_config(args.config)
@@ -794,6 +884,8 @@ def main() -> None:
             max_batch=args.max_batch,
             max_new_default=args.max_new,
             policy=args.policy,
+            decode_impl=args.decode_impl,
+            quant=args.quant,
         ),
     )
     runner = EngineRunner(engine)
@@ -801,7 +893,8 @@ def main() -> None:
     print(
         f"serving config={args.config} on :{args.port} "
         f"(pages={args.pages}x{args.page_size}, slots={args.max_batch}, "
-        f"policy={args.policy})",
+        f"policy={args.policy}, decode={engine.decode_impl}, "
+        f"quant={args.quant})",
         flush=True,
     )
     try:

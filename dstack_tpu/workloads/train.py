@@ -238,19 +238,42 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     return stats
 
 
+def apply_perf_overrides(cfg, args):
+    """--attn-impl / --quant / --tp-overlap -> config fields (shared by the
+    dense and MoE CLI branches; empty flag = keep the config default)."""
+    reps = {}
+    if getattr(args, "attn_impl", ""):
+        reps["attn_impl"] = args.attn_impl
+    if getattr(args, "quant", ""):
+        reps["quant"] = args.quant
+    if getattr(args, "tp_overlap", False):
+        reps["tp_overlap"] = True
+    return dataclasses.replace(cfg, **reps) if reps else cfg
+
+
 def _moe_main(args, moe_lib, data_lib) -> None:
     """MoE training entrypoint branch: experts over ep, the rest on dp."""
     import math
 
+    from dstack_tpu.workloads.config import validate_config
+
     if args.multislice:
         raise SystemExit("--multislice is not supported for MoE configs yet")
+    if args.tp > 1 or args.tp_overlap:
+        # MoE meshes spend their devices on ep (per-expert matmuls never
+        # contract a sharded axis) — silently ignoring the flags would break
+        # the fail-loudly contract for explicitly requested perf levers.
+        raise SystemExit(
+            "--tp/--tp-overlap are not supported for MoE configs (the mesh "
+            "is dp×ep; expert matmuls have no tp-sharded contraction to "
+            "overlap) — drop the flags or pick a dense config"
+        )
     devices = jax.devices()
     n = len(devices)
     cfg = moe_lib.MOE_PRESETS[args.config]
     if args.remat_policy:
-        import dataclasses
-
         cfg = dataclasses.replace(cfg, remat=True, remat_policy=args.remat_policy)
+    cfg = apply_perf_overrides(cfg, args)
     # ep must divide both the device count and the expert count; the default
     # is the largest such axis (gcd), degrading to pure dp on odd fits.
     ep = args.ep or math.gcd(n, cfg.n_experts)
@@ -267,6 +290,7 @@ def _moe_main(args, moe_lib, data_lib) -> None:
     # Scale the default with accumulation: 2 rows per data shard per microbatch.
     batch = args.batch or 2 * data_shards * args.grad_accum
     seq = args.seq or cfg.max_seq_len
+    validate_config(cfg, mesh, batch=batch // max(args.grad_accum, 1), seq=seq)
     print(f"config={args.config} devices={n} mesh={dict(mesh.shape)} "
           f"experts={cfg.n_experts} top_k={cfg.top_k} batch={batch} seq={seq} "
           f"grad_accum={args.grad_accum} prefetch={args.prefetch}",
@@ -319,7 +343,12 @@ def main() -> None:
 
     from dstack_tpu.workloads import data as data_lib
     from dstack_tpu.workloads import moe as moe_lib
-    from dstack_tpu.workloads.config import PRESETS, get_config
+    from dstack_tpu.workloads.config import (
+        ATTN_IMPLS,
+        PRESETS,
+        get_config,
+        validate_config,
+    )
     from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh, make_multislice_mesh
 
     parser = argparse.ArgumentParser(prog="dstack_tpu.workloads.train")
@@ -344,6 +373,24 @@ def main() -> None:
                         choices=["", "full", "dots", "save_proj"],
                         help="rematerialization policy override (config default"
                              " if empty)")
+    parser.add_argument("--attn-impl", default="", dest="attn_impl",
+                        choices=[""] + list(ATTN_IMPLS),
+                        help="attention core: auto (public Pallas kernel on a"
+                             " meshless TPU, blockwise else), xla/blockwise,"
+                             " flash (in-repo Pallas kernel; interpreted off-"
+                             "TPU), flash_tpu, plain (config default if empty)")
+    parser.add_argument("--quant", default="", choices=["", "none", "int8"],
+                        help="matmul precision: int8 = dynamically-quantized"
+                             " dots with fp32 accumulation and straight-"
+                             "through gradients (config default if empty)")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel axis size (fsdp absorbs the"
+                             " rest); >1 is what makes --tp-overlap and the"
+                             " sharded flash kernel's head split meaningful")
+    parser.add_argument("--tp-overlap", action="store_true", dest="tp_overlap",
+                        help="collective-matmul ring for the TP down-"
+                             "projections: ICI transfers hide under partial"
+                             " matmuls (requires --tp > 1)")
     parser.add_argument("--prefetch", type=int, default=2,
                         help="input prefetch depth: batches staged to HBM ahead"
                              " of the step (0 = synchronous feed)")
@@ -359,18 +406,31 @@ def main() -> None:
     cfg = get_config(args.config)
     if args.remat_policy:
         cfg = dataclasses.replace(cfg, remat=True, remat_policy=args.remat_policy)
+    cfg = apply_perf_overrides(cfg, args)
     devices = jax.devices()
 
     num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
     if args.multislice and num_slices > 1:
-        mesh = make_multislice_mesh(num_slices, devices=devices)
+        mesh = make_multislice_mesh(num_slices, tp=args.tp, devices=devices)
     else:
-        mesh = make_mesh(devices=devices)  # all devices on fsdp
+        # fsdp absorbs whatever --tp leaves (tp=1 -> all devices on fsdp).
+        mesh = make_mesh(tp=args.tp, devices=devices)
+    if args.tp_overlap and mesh.shape["tp"] <= 1:
+        raise ValueError(
+            "--tp-overlap needs a tensor-parallel mesh axis (pass --tp > 1);"
+            " with tp=1 there is no all-reduce to hide and the ring is a"
+            " silent no-op"
+        )
     data_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
     # The default batch scales with accumulation so each MICROBATCH keeps 2
     # rows per data shard (an explicit --batch must divide accordingly).
     batch = args.batch or 2 * data_shards * args.grad_accum
     seq = args.seq or cfg.max_seq_len
+    # An explicitly requested invalid perf combo (flash + ring attention,
+    # non-divisible blocks, a tp_overlap ring that can't split the batch)
+    # must die HERE, before a multi-minute compile silently takes the slow
+    # path.
+    validate_config(cfg, mesh, batch=batch // max(args.grad_accum, 1), seq=seq)
 
     print(f"config={args.config} devices={len(devices)} mesh={dict(mesh.shape)} "
           f"batch={batch} seq={seq} grad_accum={args.grad_accum} "

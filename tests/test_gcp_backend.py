@@ -364,17 +364,12 @@ class TestSchedulerIntegration:
 
 class TestAuth:
     def test_sign_jwt_rs256_roundtrip(self):
-        from cryptography.hazmat.primitives import hashes, serialization
-        from cryptography.hazmat.primitives.asymmetric import padding, rsa
-
+        # Signing rides the openssl-CLI shim (gateway/minicrypto.py), same as
+        # the gateway TLS tests — no cryptography wheel in the image.
         from dstack_tpu.backends.gcp.auth import sign_jwt_rs256
+        from dstack_tpu.gateway import minicrypto
 
-        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-        pem = key.private_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.PKCS8,
-            serialization.NoEncryption(),
-        ).decode()
+        pem = minicrypto.generate_rsa_key_pem()
         jwt = sign_jwt_rs256({"iss": "x@y", "scope": "s"}, pem)
         header_b64, claims_b64, sig_b64 = jwt.split(".")
         import base64
@@ -385,11 +380,12 @@ class TestAuth:
 
         assert _json.loads(unb64(header_b64)) == {"alg": "RS256", "typ": "JWT"}
         assert _json.loads(unb64(claims_b64))["iss"] == "x@y"
-        key.public_key().verify(
-            unb64(sig_b64),
-            f"{header_b64}.{claims_b64}".encode(),
-            padding.PKCS1v15(),
-            hashes.SHA256(),
+        assert minicrypto.rsa_verify_sha256(
+            pem, f"{header_b64}.{claims_b64}".encode(), unb64(sig_b64)
+        )
+        # A tampered payload must not verify.
+        assert not minicrypto.rsa_verify_sha256(
+            pem, f"{header_b64}.{claims_b64}x".encode(), unb64(sig_b64)
         )
 
     def test_token_provider_selection(self):
