@@ -58,6 +58,9 @@ bench-train:
 # Serving-engine bench: open-loop synthetic load against the continuous-
 # batching engine on CPU — one JSON line with tokens/s/chip, p50/p99 TTFT and
 # inter-token latency; vs_baseline is continuous over static batching.
+# Extras attribute the tier-2 levers: shared-prefix tok/s with the prefix
+# cache on vs off, injected-long-prompt ITL chunked vs not, and speculative
+# decode (which FAILS the bench if it ever diverges from greedy).
 bench-serve:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_serve()))"
 
@@ -75,10 +78,13 @@ bench-kernels:
 smoke-observability:
 	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_observability()"
 
-# Serving smoke: boots the server + a real engine replica, streams SSE tokens
-# through the proxy, and asserts the latency autoscaler scales a service from
-# zero (run_events carries the autoscaler actor + cold-start histogram) and
-# back. Prints one JSON line; any missing piece is a non-zero exit.
+# Serving smoke: boots the server + a real tier-2 engine replica (prefix
+# cache + chunked prefill + speculative decode), streams SSE tokens through
+# the proxy, drives shared-prefix + speculative requests and asserts their
+# hit/accept ratios land on /metrics, then asserts the latency autoscaler
+# scales a service from zero (run_events carries the autoscaler actor +
+# cold-start histogram) and back. One JSON line; any missing piece is a
+# non-zero exit.
 smoke-serve:
 	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_serve()"
 

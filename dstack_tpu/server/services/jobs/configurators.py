@@ -158,6 +158,17 @@ def get_job_specs(run_spec: RunSpec, replica_num: int = 0) -> List[JobSpec]:
     return specs
 
 
+def _pkg_root() -> str:
+    """Shell-quoted directory containing the ``dstack_tpu`` package the server
+    itself imports (the repo root on a checkout, site-packages on a wheel)."""
+    import shlex
+    from pathlib import Path
+
+    import dstack_tpu
+
+    return shlex.quote(str(Path(dstack_tpu.__file__).resolve().parent.parent))
+
+
 def _build_commands(conf) -> List[str]:
     if isinstance(conf, DevEnvironmentConfiguration):
         # init, then an IDE backend on the assigned port. Four-tier chain
@@ -196,9 +207,18 @@ def _build_commands(conf) -> List[str]:
             '  exec "$HOME/.dstack-ide/bin/openvscode-server" --host 127.0.0.1'
             ' --port "$DSTACK_SERVICE_PORT" --without-connection-token\n'
             "fi",
-            'if python3 -c "import dstack_tpu.ide" >/dev/null 2>&1; then\n'
+            # The package root the SERVER runs from rides along on PYTHONPATH:
+            # local/test runs execute jobs on the same filesystem where
+            # dstack_tpu is a repo checkout, not an installed wheel, and the
+            # runner's job cwd is its own base dir — without the prefix the
+            # import probe fails and every air-gapped dev env lands on the
+            # bare http.server tier. On remote hosts the path simply doesn't
+            # exist and the probe decides on the image's own install.
+            f'if env PYTHONPATH={_pkg_root()}:"$PYTHONPATH"'
+            ' python3 -c "import dstack_tpu.ide" >/dev/null 2>&1; then\n'
             '  echo "ide: dstack-tpu web IDE on port $DSTACK_SERVICE_PORT"\n'
-            '  exec python3 -m dstack_tpu.ide --port "$DSTACK_SERVICE_PORT" --root .\n'
+            f'  exec env PYTHONPATH={_pkg_root()}:"$PYTHONPATH"'
+            ' python3 -m dstack_tpu.ide --port "$DSTACK_SERVICE_PORT" --root .\n'
             "fi",
             'echo "ide: serving workspace over http on port $DSTACK_SERVICE_PORT"',
             'exec python3 -m http.server "$DSTACK_SERVICE_PORT" --bind 127.0.0.1',

@@ -198,6 +198,7 @@ async def render_metrics(db: Database) -> str:
         )
         for r in rows:
             svc_rps.append(({"run": r["run_name"]}, proxy_service.stats.rps(r["id"])))
+    run_names = {r["id"]: r["run_name"] for r in rows} if run_ids else {}
     sections.append(
         _fmt(
             "dstack_tpu_service_requests_per_second",
@@ -206,6 +207,29 @@ async def render_metrics(db: Database) -> str:
             svc_rps,
         )
     )
+
+    # Tier-2 serving-engine gauges reported by replicas on response headers
+    # (proxy ENGINE_GAUGE_HEADERS): prefix-cache hit ratio and speculative-
+    # decode accept ratio, last value per run within the stats window.
+    engine_families = {
+        "prefix_cache_hit_ratio": (
+            "dstack_tpu_service_prefix_cache_hit_ratio",
+            "Fraction of admitted prompt tokens served from the engine's prefix cache",
+        ),
+        "spec_accept_ratio": (
+            "dstack_tpu_service_spec_accept_ratio",
+            "Fraction of speculative draft tokens accepted by the verify step",
+        ),
+    }
+    engine_samples: Dict[str, List[Tuple[Dict[str, str], float]]] = {
+        key: [] for key in engine_families
+    }
+    for run_id, run_name in run_names.items():
+        for name, value in proxy_service.stats.engine_gauges(run_id).items():
+            if name in engine_samples:
+                engine_samples[name].append(({"run": run_name}, value))
+    for key, (family, help_) in engine_families.items():
+        sections.append(_fmt(family, help_, "gauge", engine_samples[key]))
 
     # Background loop lag: how far behind schedule each processing loop started
     # its latest pass (0 = on time; sustained growth = an overloaded loop).

@@ -63,6 +63,11 @@ class ServiceStats:
         # to zero mid-generation — a long stream leaves no trace in the RPS
         # window after 60s, but it is very much still demand.
         self._inflight: Dict[str, int] = {}
+        # run_id -> {gauge name -> (ts, value)}: last-value engine gauges
+        # reported by serving replicas via response headers (prefix-cache hit
+        # ratio, speculative accept ratio — ENGINE_GAUGE_HEADERS), rendered
+        # per service on /metrics.
+        self._engine_gauges: Dict[str, Dict[str, Tuple[float, float]]] = {}
         # (run_id, bucket) -> count at last persist; lets each checkpoint write
         # only buckets that changed instead of re-upserting the whole window.
         self.persisted: Dict[Tuple[str, int], int] = {}
@@ -138,6 +143,23 @@ class ServiceStats:
             return None
         return max(samples)
 
+    def record_engine_gauge(self, run_id: str, name: str, value: float) -> None:
+        self._engine_gauges.setdefault(run_id, {})[name] = (
+            time.monotonic(), float(value)
+        )
+
+    def engine_gauges(
+        self, run_id: str, window: float = STATS_WINDOW
+    ) -> Dict[str, float]:
+        """Latest engine-reported gauge per name, or {} when none was seen in
+        `window` (a dead replica's stale ratio must age out of /metrics)."""
+        cutoff = time.monotonic() - window
+        return {
+            name: value
+            for name, (ts, value) in self._engine_gauges.get(run_id, {}).items()
+            if ts >= cutoff
+        }
+
     def record_inflight(self, run_id: str, delta: int) -> None:
         n = self._inflight.get(run_id, 0) + delta
         if n <= 0:
@@ -160,6 +182,7 @@ class ServiceStats:
         self._latencies.pop(run_id, None)
         self._queue_depths.pop(run_id, None)
         self._inflight.pop(run_id, None)
+        self._engine_gauges.pop(run_id, None)
         for key in [k for k in self.persisted if k[0] == run_id]:
             del self.persisted[key]
         for source_map in self._external.values():
@@ -243,6 +266,7 @@ class ServiceStats:
         self._latencies.clear()
         self._queue_depths.clear()
         self._inflight.clear()
+        self._engine_gauges.clear()
         self.persisted.clear()
         self._external.clear()
 
@@ -669,14 +693,29 @@ async def proxy_request(
 
 QUEUE_DEPTH_HEADER = "X-Dstack-Queue-Depth"
 
+# Tier-2 engine gauges riding the same response-header channel as the queue
+# depth: recorded last-value in-memory (zero DB cost on the hot path) and
+# rendered per service on /metrics as dstack_tpu_service_<name>.
+ENGINE_GAUGE_HEADERS = {
+    "X-Dstack-Prefix-Hit-Rate": "prefix_cache_hit_ratio",
+    "X-Dstack-Spec-Accept-Rate": "spec_accept_ratio",
+}
+
 
 def _record_queue_depth(run_id: str, headers) -> None:
-    """Serving replicas report engine backlog on every response; an absent or
-    malformed header is simply not a sample."""
+    """Serving replicas report engine backlog (and tier-2 engine gauges) on
+    every response; an absent or malformed header is simply not a sample."""
     raw = headers.get(QUEUE_DEPTH_HEADER)
-    if raw is None:
-        return
-    try:
-        stats.record_queue_depth(run_id, float(raw))
-    except (TypeError, ValueError):
-        pass
+    if raw is not None:
+        try:
+            stats.record_queue_depth(run_id, float(raw))
+        except (TypeError, ValueError):
+            pass
+    for header, name in ENGINE_GAUGE_HEADERS.items():
+        raw = headers.get(header)
+        if raw is None:
+            continue
+        try:
+            stats.record_engine_gauge(run_id, name, float(raw))
+        except (TypeError, ValueError):
+            pass

@@ -1,18 +1,24 @@
 """Server SSH identity: ed25519 keypair generated on first use.
 
 Parity: reference utils/crypto.py (RSA keygen for project keys) — ed25519 here
-(smaller, modern default), serialized in OpenSSH format via ``cryptography``.
+(smaller, modern default), serialized in OpenSSH format via ``cryptography``
+when that wheel is installed, or the OpenSSH ``ssh-keygen`` binary otherwise
+(the images this repo targets ship the OpenSSH client suite for the tunnel
+layer but not the cryptography wheel — returning an empty key here silently
+skipped authorized_keys installation on SSH fleets, so every healthcheck
+tunnel died at auth and hosts were torn down at PROVISIONING_TIMEOUT).
 """
 
 from __future__ import annotations
 
 import os
+import subprocess
+import tempfile
 from pathlib import Path
 from typing import Tuple
 
 
-def generate_ed25519_keypair() -> Tuple[str, str]:
-    """Returns (private_key_openssh, public_key_line)."""
+def _generate_with_cryptography() -> Tuple[str, str]:
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import ed25519
 
@@ -29,6 +35,27 @@ def generate_ed25519_keypair() -> Tuple[str, str]:
         + " dstack-tpu-server"
     )
     return private, public
+
+
+def _generate_with_ssh_keygen() -> Tuple[str, str]:
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "id_ed25519"
+        subprocess.run(
+            ["ssh-keygen", "-t", "ed25519", "-N", "", "-q",
+             "-C", "dstack-tpu-server", "-f", str(path)],
+            check=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+        return path.read_text(), path.with_suffix(".pub").read_text().strip()
+
+
+def generate_ed25519_keypair() -> Tuple[str, str]:
+    """Returns (private_key_openssh, public_key_line)."""
+    try:
+        return _generate_with_cryptography()
+    except ImportError:
+        return _generate_with_ssh_keygen()
 
 
 def get_server_ssh_keypair(server_dir: Path) -> Tuple[str, str]:

@@ -262,6 +262,50 @@ def paged_decode_attention(
     return jnp.einsum("sht,sthd->shd", weights, v.astype(jnp.float32))
 
 
+def paged_chunk_attention(
+    q: jax.Array,        # [S, C, H, D] — C chunk queries per decode slot
+    k_pages: jax.Array,  # [N, page, Kh, D] — one layer's page pool
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [S, P] int32 page ids into the pool
+    starts: jax.Array,      # [S] absolute position of each slot's first query
+) -> jax.Array:
+    """Multi-query paged attention: the chunked-prefill / speculative-verify
+    generalization of ``paged_decode_attention`` (which is the C == 1 special
+    case with ``starts = kv_lens - 1``). Query i of slot s sits at absolute
+    position ``starts[s] + i`` and attends causally over the slot's paged
+    prefix INCLUDING the chunk itself — the chunk's K/V must already be
+    scattered into the pages before this runs. Returns fp32 [S, C, H, D].
+
+    Positions beyond each query's own (unwritten page slots, other slots'
+    stale data behind zero-padded table entries) are masked by causality
+    alone: every position <= starts[s] + i is valid written KV for slot s by
+    the engine's append-only write discipline. Padded batch rows (starts 0,
+    zeroed table rows) produce finite garbage the engine discards.
+    """
+    s, c, h, d = q.shape
+    n, page, kh, _ = k_pages.shape
+    p = page_table.shape[1]
+    k = k_pages[page_table].reshape(s, p * page, kh, d)
+    v = v_pages[page_table].reshape(s, p * page, kh, d)
+    n_rep = h // kh
+    if n_rep > 1:
+        k = jnp.broadcast_to(
+            k[:, :, :, None, :], (s, p * page, kh, n_rep, d)
+        ).reshape(s, p * page, kh * n_rep, d)
+        v = jnp.broadcast_to(
+            v[:, :, :, None, :], (s, p * page, kh, n_rep, d)
+        ).reshape(s, p * page, kh * n_rep, d)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    scores = jnp.einsum(
+        "schd,sthd->scht", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = starts[:, None] + jnp.arange(c)[None, :]  # [S, C]
+    valid = jnp.arange(p * page)[None, None, :] <= q_pos[:, :, None]  # [S, C, T]
+    scores = jnp.where(valid[:, :, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("scht,sthd->schd", weights, v.astype(jnp.float32))
+
+
 def attention_core(
     q: jax.Array,  # [B, T, H, D]
     k: jax.Array,  # [B, T, Kh, D]

@@ -22,12 +22,16 @@ from dstack_tpu.workloads.kernels.flash import (
     flash_attention_sharded,
     pick_flash_block,
 )
-from dstack_tpu.workloads.kernels.paged import paged_decode_attention_pallas
+from dstack_tpu.workloads.kernels.paged import (
+    paged_chunk_attention_pallas,
+    paged_decode_attention_pallas,
+)
 
 __all__ = [
     "collective_matmul",
     "flash_attention",
     "flash_attention_sharded",
+    "paged_chunk_attention_pallas",
     "paged_decode_attention_pallas",
     "pick_flash_block",
 ]

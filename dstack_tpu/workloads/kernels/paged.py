@@ -128,3 +128,114 @@ def paged_decode_attention_pallas(
         interpret=_use_interpret(interpret),
     )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), q,
       k_pages, v_pages)
+
+
+def _paged_chunk_kernel(page_table_ref, kv_lens_ref, starts_ref, q_ref,
+                        k_pages_ref, v_pages_ref, o_ref, k_scratch, v_scratch,
+                        sems, *, page: int, n_rep: int, chunk: int):
+    """One program per slot, C chunk queries at positions starts[s]..+C-1.
+    q [1, C, H, D]; pages stay in HBM, DMA'd per page; out [1, C, H, D] fp32.
+    Query i attends causally through its own position (its K/V already
+    scattered into the pages), so the decode kernel above is the C == 1
+    special case of this accumulation."""
+    slot = pl.program_id(0)
+    kh, d = k_pages_ref.shape[2], k_pages_ref.shape[3]
+    kv_len = kv_lens_ref[slot]
+    start = starts_ref[slot]
+    n_pages = pl.cdiv(kv_len, page)
+
+    # [C, H, D] -> [kh, C*n_rep, d]: group rows by KV head so one dot_general
+    # batches over kh (row r of the folded axis is chunk index r // n_rep).
+    q = q_ref[0].astype(jnp.float32).reshape(chunk, kh, n_rep, d)
+    q = q.transpose(1, 0, 2, 3).reshape(kh, chunk * n_rep, d)
+    scale = 1.0 / (d ** 0.5)
+    q_idx = jax.lax.broadcasted_iota(
+        jnp.int32, (kh, chunk * n_rep, page), 1
+    ) // n_rep
+
+    def body(p_idx, carry):
+        o, l, m = carry
+        page_id = page_table_ref[slot, p_idx]
+        k_dma = pltpu.make_async_copy(
+            k_pages_ref.at[page_id], k_scratch, sems.at[0]
+        )
+        v_dma = pltpu.make_async_copy(
+            v_pages_ref.at[page_id], v_scratch, sems.at[1]
+        )
+        k_dma.start()
+        v_dma.start()
+        k_dma.wait()
+        v_dma.wait()
+        k_blk = k_scratch[...].astype(jnp.float32)  # [page, Kh, D]
+        v_blk = v_scratch[...].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [kh, C*n_rep, page]
+        pos = p_idx * page + jax.lax.broadcasted_iota(
+            jnp.int32, (kh, chunk * n_rep, page), 2
+        )
+        valid = (pos <= start + q_idx) & (pos < kv_len)
+        s = jnp.where(valid, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
+        prob = jnp.where(valid, jnp.exp(s - safe_m), 0.0)
+        l_new = l * corr + jnp.sum(prob, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            prob, v_blk, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )  # [kh, C*n_rep, D]
+        return o * corr + pv, l_new, m_new
+
+    o0 = jnp.zeros((kh, chunk * n_rep, d), jnp.float32)
+    l0 = jnp.zeros((kh, chunk * n_rep, 1), jnp.float32)
+    m0 = jnp.full((kh, chunk * n_rep, 1), NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(0, n_pages, body, (o0, l0, m0))
+    o = o / jnp.maximum(l, 1e-20)
+    o = o.reshape(kh, chunk, n_rep, d).transpose(1, 0, 2, 3)
+    o_ref[0] = o.reshape(chunk, kh * n_rep, d)
+
+
+def paged_chunk_attention_pallas(
+    q: jax.Array,           # [S, C, H, D] — C chunk queries per slot
+    k_pages: jax.Array,     # [N, page, Kh, D]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [S, P] int32 page ids
+    starts: jax.Array,      # [S] absolute position of each slot's first query
+    kv_lens: jax.Array,     # [S] total valid KV length (starts + chunk tokens)
+    *,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for ``attention.paged_chunk_attention`` (fp32 [S, C, H, D]) —
+    the chunked-prefill / speculative-verify counterpart of the decode kernel:
+    same page-at-a-time DMA walk, C queries sharing each page's single copy."""
+    s, c, h, d = q.shape
+    n, page, kh, _ = k_pages.shape
+    n_rep = h // kh
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, c, h, d), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, c, h, d), lambda i, *_: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((page, kh, d), k_pages.dtype),
+            pltpu.VMEM((page, kh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_chunk_kernel, page=page, n_rep=n_rep, chunk=c
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, c, h, d), jnp.float32),
+        interpret=_use_interpret(interpret),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      starts.astype(jnp.int32), q, k_pages, v_pages)

@@ -21,10 +21,41 @@ stack —
 - **Streaming**: tokens are emitted per step; the aiohttp app turns them into
   SSE events that ride the proxy's unbuffered pass-through (PR 2) to clients.
 
+Tier 2 (all three off by default, each proven token-identical to the tier-1
+engine by tests/test_serve_tier2.py):
+
+- **Chunked prefill** (``prefill_chunk > 0``): prompts run at most
+  ``prefill_chunk`` tokens per engine step, interleaved with decode — one
+  giant prompt raises its own TTFT instead of everyone's inter-token latency.
+  Chunks attend over the paged prefix via ``attention.paged_chunk_attention``
+  (or the Pallas twin), the multi-query generalization of the decode path.
+- **Prefix caching** (``prefix_cache=True``): full KV pages of prompt blocks
+  are registered in a refcounted hash-chain (``PrefixCache``); a new request
+  sharing the same prompt prefix reuses those pages and prefills only its
+  suffix. Cached pages are sealed — never written again — so copy-on-write
+  degenerates to allocate-on-divergence, and LRU leaf eviction returns idle
+  blocks to the allocator before preemption ever triggers.
+- **Speculative decode** (``spec_tokens=k``): a host-side n-gram proposer
+  drafts k tokens per slot and one batched verify forward scores all of them;
+  the greedy accept/reject rule emits between 1 and k+1 tokens per step and
+  is token-identical to non-speculative greedy decode by construction.
+
 Everything runs under ``JAX_PLATFORMS=cpu`` (tests/bench: 1 device, tiny
 config); on TPU the same jitted prefill/decode functions land on the chip.
 Decoding is greedy (argmax) — deterministic, which is what makes the
 continuous-vs-sequential token-equivalence test meaningful.
+
+A numerics caveat on "token-identical": the guarantee is exact at the
+scheduling level (what gets proposed/accepted/emitted given the logits) and
+bit-exact end to end when activations are fp32 — which is how the tier-2
+tests and ``bench_serve`` run. With bf16 activations, chunked prefill and
+the C > 1 verify forward reduce the same attention sums in a different
+order than the whole-prompt / C == 1 paths; the fp32 accumulators still
+round to bf16 between layers, so a one-ulp difference can flip a greedy
+argmax at a near-tie and the streams can diverge from that token on. That
+is inherent to reordering floating-point reductions (flash attention has
+the same property), not a scheduling bug — validate strict identity in
+fp32, and treat bf16 divergence-at-near-ties as expected noise.
 """
 
 from __future__ import annotations
@@ -44,9 +75,16 @@ import numpy as np
 
 from dstack_tpu.workloads import model as model_lib
 from dstack_tpu.workloads import quantize as quant_lib
-from dstack_tpu.workloads.attention import blockwise_attention, paged_decode_attention
+from dstack_tpu.workloads.attention import (
+    blockwise_attention,
+    paged_chunk_attention,
+    paged_decode_attention,
+)
 from dstack_tpu.workloads.config import LlamaConfig, get_config
-from dstack_tpu.workloads.kernels.paged import paged_decode_attention_pallas
+from dstack_tpu.workloads.kernels.paged import (
+    paged_chunk_attention_pallas,
+    paged_decode_attention_pallas,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -138,6 +176,17 @@ class EngineConfig:
     # "int8" = weight-only quantization (quantize_serve_params): projection
     # weights stored int8 + per-channel scales, dequantized on use.
     quant: str = "none"
+    # Max prompt tokens prefilled per request per engine step (0 = whole
+    # prompt in one batched prefill, the tier-1 behavior). With chunking, a
+    # long prompt interleaves with decode steps instead of stalling them.
+    prefill_chunk: int = 0
+    # Cross-request prefix caching: full KV pages of prompt blocks are kept in
+    # a refcounted registry after prefill; later requests sharing the prefix
+    # skip recomputing it. Evicted LRU when the allocator runs dry.
+    prefix_cache: bool = False
+    # Speculative decode: k draft tokens per slot from an n-gram proposer,
+    # verified in one batched forward (0 = one token per step, tier-1).
+    spec_tokens: int = 0
 
 
 class TokenEvent(NamedTuple):
@@ -161,6 +210,18 @@ class GenRequest:
     # the resume prompt must append only tokens[absorbed:], or a second
     # preemption would duplicate the first one's tokens into the context.
     absorbed: int = 0
+    # Tier-2 prefill progress for the CURRENT admission: prompt tokens whose
+    # KV is already in pages (cache hits + chunks done). Reset on admission;
+    # < len(prompt) means the slot is mid-prefill and not yet decoding.
+    pos: int = 0
+    # Prompt tokens served from the prefix cache at last admission (stats).
+    cached_tokens: int = 0
+    # Speculative-decode proposer state, built lazily on the first draft:
+    # the full emitted stream (prompt + generated — invariant under
+    # preemption refolds, which only move tokens between the two lists) and
+    # its trailing-n-gram continuation index. _emit keeps both current.
+    spec_ctx: Optional[List[int]] = None
+    spec_index: Optional[dict] = None
 
 
 def _rope_single(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -295,6 +356,286 @@ def make_decode_fn(cfg: LlamaConfig, quant: str = "none",
     return jax.jit(decode, donate_argnums=(3, 4))
 
 
+def _rope_chunk(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding with per-row positions: x [S,C,H,D], positions [S,C]
+    (each slot's chunk starts at its own absolute offset)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [S, C, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def make_chunk_fn(cfg: LlamaConfig, quant: str = "none",
+                  decode_impl: str = "xla", emit: str = "last"):
+    """jit'd multi-token step over the paged cache — the shared program behind
+    chunked prefill, prefix-cache suffix prefill, AND speculative verify:
+    (params, tokens, starts, valid, k_pages, v_pages, page_tables,
+     write_page, write_off) -> (next_tokens, k_pages, v_pages).
+
+    tokens [S, C]: C consecutive tokens per slot, the first sitting at
+    absolute position starts[s]; valid [S] counts real (non-pad) tokens.
+    Each token's K/V is scattered into the slot's pages (write_page/write_off
+    [S, C]; pool-sized index = dropped write for padding), then all C queries
+    attend causally over the slot's paged prefix including the chunk itself
+    (attention.paged_chunk_attention, or the Pallas twin when
+    decode_impl="pallas") — decode is exactly the C == 1 special case.
+
+    emit="last" returns [S] greedy tokens from each slot's LAST valid
+    position (prefill: only the final chunk's emission is meaningful, and the
+    lm_head runs on one position per slot, not the whole chunk);
+    emit="all" returns [S, C] greedy tokens at EVERY position (speculative
+    verify: position i's argmax is the model's true next token after
+    consuming tokens[:, :i+1], which the host's accept/reject rule compares
+    against the drafts).
+    """
+
+    def chunk_step(params, tokens, starts, valid, k_pages, v_pages,
+                   page_tables, write_page, write_off):
+        adt = jnp.dtype(cfg.dtype)
+        s, c = tokens.shape
+        hd, h, kh = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        x = params["embed"].astype(adt)[tokens]  # [S, C, D]
+        positions = starts[:, None] + jnp.arange(c)[None, :]  # [S, C]
+        kv_lens = starts + valid
+
+        def block(x, xs):
+            layer, kp, vp = xs
+            h_in = model_lib._rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+            q = _proj(h_in, layer, "wq", adt, quant).reshape(s, c, h, hd)
+            k = _proj(h_in, layer, "wk", adt, quant).reshape(s, c, kh, hd)
+            v = _proj(h_in, layer, "wv", adt, quant).reshape(s, c, kh, hd)
+            q = _rope_chunk(q, positions, cfg.rope_theta)
+            k = _rope_chunk(k, positions, cfg.rope_theta)
+            kp = kp.at[write_page, write_off].set(k.astype(kp.dtype), mode="drop")
+            vp = vp.at[write_page, write_off].set(v.astype(vp.dtype), mode="drop")
+            if decode_impl == "pallas":
+                o = paged_chunk_attention_pallas(
+                    q, kp, vp, page_tables, starts, kv_lens
+                )
+            else:
+                o = paged_chunk_attention(q, kp, vp, page_tables, starts)
+            o = o.astype(adt).reshape(s, c, h * hd)
+            x = x + _proj(o, layer, "wo", adt, quant)
+            h2 = model_lib._rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+            gate = _proj(h2, layer, "w_gate", adt, quant)
+            up = _proj(h2, layer, "w_up", adt, quant)
+            hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
+            return x + _proj(hidden, layer, "w_down", adt, quant), (kp, vp)
+
+        layer_params = {key: params[key] for key in _serve_layer_keys(quant)}
+        x, (k_pages, v_pages) = jax.lax.scan(
+            block, x, (layer_params, k_pages, v_pages)
+        )
+        x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
+        if emit == "last":
+            last_idx = jnp.clip(valid - 1, 0, c - 1)
+            last = x[jnp.arange(s), last_idx]  # [S, D]
+            logits = _logits(last, params, adt, quant)
+        else:
+            logits = _logits(x, params, adt, quant)  # [S, C, V]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+
+    return jax.jit(chunk_step, donate_argnums=(4, 5))
+
+
+def propose_ngram_drafts(context: List[int], k: int, max_n: int = 3) -> List[int]:
+    """Self-drafting n-gram proposer (prompt-lookup decoding): find the most
+    recent earlier occurrence of the context's trailing n-gram (longest n
+    first) and propose the k tokens that followed it. A miss proposes the
+    last token repeated — a draft is only ever a THROUGHPUT bet; the verify
+    step keeps the output token-identical to greedy no matter what is
+    proposed."""
+    if k <= 0 or not context:
+        return []
+    for n in range(min(max_n, len(context) - 1), 0, -1):
+        pattern = context[-n:]
+        # Most recent occurrence strictly before the trailing one.
+        for i in range(len(context) - n - 1, -1, -1):
+            if context[i:i + n] == pattern:
+                drafts = context[i + n:i + n + k]
+                if drafts:
+                    return drafts + [context[-1]] * (k - len(drafts))
+    return [context[-1]] * k
+
+
+def _ngram_record(context: List[int], i: int, index: dict, max_n: int = 3):
+    """Token context[i] just arrived: every n-gram ENDING at i-1 now has a
+    continuation starting at i — record it (latest occurrence wins). Grams
+    without a continuation are deliberately never recorded, which is what
+    keeps lookups from matching the trailing gram against itself."""
+    for n in range(1, max_n + 1):
+        if n > i:
+            break
+        index[tuple(context[i - n:i])] = i
+
+
+def _ngram_index(context: List[int], max_n: int = 3) -> dict:
+    """Continuation index over a whole context (admission-time build; after
+    that ``_ngram_record`` maintains it in O(max_n) per emitted token)."""
+    index: dict = {}
+    for i in range(1, len(context)):
+        _ngram_record(context, i, index, max_n)
+    return index
+
+
+def propose_from_index(
+    context: List[int], index: dict, k: int, max_n: int = 3
+) -> List[int]:
+    """O(max_n) drop-in for ``propose_ngram_drafts`` given its context's
+    ``_ngram_index``: identical proposals (tested), without the O(context)
+    backward scan per decoding slot per engine step — host work that would
+    otherwise sit serialized against the device on the decode hot path."""
+    if k <= 0 or not context:
+        return []
+    for n in range(min(max_n, len(context) - 1), 0, -1):
+        pos = index.get(tuple(context[-n:]))
+        if pos is not None:
+            drafts = context[pos:pos + k]
+            return drafts + [context[-1]] * (k - len(drafts))
+    return [context[-1]] * k
+
+
+class _CacheBlock:
+    """One cached full page of KV: the block's hash-chain key, the page id it
+    seals, how many live requests reference it, and an LRU stamp."""
+
+    __slots__ = ("key", "page", "refs", "last_used")
+
+    def __init__(self, key, page: int, refs: int, last_used: int) -> None:
+        self.key = key
+        self.page = page
+        self.refs = refs
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Refcounted registry of sealed full-page prompt blocks, keyed by a hash
+    chain: block i's key is (parent_key, tuple(block_tokens)) — exact-match
+    (no collision risk), and a prefix match is a walk down the chain.
+
+    Invariants the tests pin:
+    - a cached page is NEVER written again (registration happens only after
+      the owning prefill fully filled it with prompt tokens, and generation
+      always writes at positions past the prompt) — so sharing needs no
+      copy-on-write: divergence just stops the match and the request
+      allocates private pages from there;
+    - a block with refs > 0 is never evicted (``evict`` only frees LRU blocks
+      with refs == 0 and no cached children — a child's referents hold refs
+      on every ancestor, so parents can't be freed under live children);
+    - match() caps at len(prompt) - 1 tokens so prefill always has at least
+      one position left to compute the first output token from.
+    """
+
+    def __init__(self, page_size: int) -> None:
+        self.page_size = page_size
+        self.blocks: Dict[tuple, _CacheBlock] = {}
+        self._page_block: Dict[int, _CacheBlock] = {}
+        self._children: Dict[tuple, int] = {}  # key -> cached child count
+        self._clock = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, prompt: List[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `prompt` in whole blocks: returns
+        (page_ids, matched_token_count) and takes a reference on every
+        matched block (caller must ``release`` them on slot teardown, or
+        immediately if admission fails)."""
+        p = self.page_size
+        max_blocks = (len(prompt) - 1) // p
+        key: Optional[tuple] = None
+        matched: List[_CacheBlock] = []
+        for b in range(max_blocks):
+            key = (key, tuple(prompt[b * p:(b + 1) * p]))
+            blk = self.blocks.get(key)
+            if blk is None:
+                break
+            matched.append(blk)
+        stamp = self._tick()
+        for blk in matched:
+            blk.refs += 1
+            blk.last_used = stamp
+        return [blk.page for blk in matched], len(matched) * p
+
+    def register(self, prompt: List[int], slot_pages: List[int]) -> None:
+        """Seal the full prompt blocks of a just-completed prefill into the
+        cache. slot_pages[i] is the page holding tokens [i*p, (i+1)*p). The
+        owning request keeps using the page, so each new block starts at
+        refs = 1; already-present keys are skipped (a concurrent duplicate
+        prefill keeps its copy private — freed at release like any private
+        page)."""
+        p = self.page_size
+        key: Optional[tuple] = None
+        stamp = self._tick()
+        for b in range(len(prompt) // p):
+            key = (key, tuple(prompt[b * p:(b + 1) * p]))
+            existing = self.blocks.get(key)
+            if existing is not None:
+                continue
+            page = slot_pages[b]
+            if page in self._page_block:
+                # This position is served BY a cached page (a matched block):
+                # nothing to register.
+                continue
+            self.blocks[key] = _CacheBlock(key, page, refs=1, last_used=stamp)
+            self._page_block[page] = self.blocks[key]
+            if key[0] is not None:
+                self._children[key[0]] = self._children.get(key[0], 0) + 1
+
+    def release(self, pages: List[int]) -> List[int]:
+        """Drop one reference per cached page in `pages`; returns the subset
+        that is NOT cached (truly private — the caller frees those). Cached
+        pages stay resident at refs == 0 until evicted."""
+        private: List[int] = []
+        stamp = self._tick()
+        for page in pages:
+            blk = self._page_block.get(page)
+            if blk is None:
+                private.append(page)
+            else:
+                blk.refs -= 1
+                blk.last_used = stamp
+        return private
+
+    def evictable_count(self) -> int:
+        return sum(1 for blk in self.blocks.values() if blk.refs == 0)
+
+    def evict(self, n: int) -> List[int]:
+        """Free up to n pages from refs == 0 blocks, LRU first, leaves before
+        parents (evicting a parent under a cached child would orphan the
+        child's chain — and every ref-0 subtree always has a ref-0 leaf, so
+        leaf-first eviction can always drain it)."""
+        freed: List[int] = []
+        while len(freed) < n:
+            candidates = [
+                blk for blk in self.blocks.values()
+                if blk.refs == 0 and self._children.get(blk.key, 0) == 0
+            ]
+            if not candidates:
+                break
+            victim = min(candidates, key=lambda blk: blk.last_used)
+            del self.blocks[victim.key]
+            del self._page_block[victim.page]
+            parent = victim.key[0]
+            if parent is not None:
+                self._children[parent] -= 1
+                if not self._children[parent]:
+                    del self._children[parent]
+            freed.append(victim.page)
+            self.evictions += 1
+        return freed
+
+
 def _bucket(n: int, lo: int = 8) -> int:
     """Smallest power of two >= n (min lo): bounds the number of distinct
     prefill shapes XLA ever compiles."""
@@ -330,6 +671,21 @@ class ServeEngine:
                 f"unknown decode_impl {self.ecfg.decode_impl!r}; expected one"
                 f" of {DECODE_IMPLS}"
             )
+        if self.ecfg.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0 (0 = whole-prompt prefill), got"
+                f" {self.ecfg.prefill_chunk}"
+            )
+        if self.ecfg.spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0 (0 = one token per step), got"
+                f" {self.ecfg.spec_tokens}"
+            )
+        if self.ecfg.prefix_cache and self.ecfg.num_pages < 2:
+            raise ValueError(
+                "prefix_cache needs a page pool of at least 2 (one cacheable"
+                " block plus the active tail)"
+            )
         quant_lib.check_quant(self.ecfg.quant)
         self.params = params if params is not None else model_lib.init_params(
             cfg, jax.random.PRNGKey(seed)
@@ -347,6 +703,19 @@ class ServeEngine:
         self.decode_impl = resolve_decode_impl(self.ecfg.decode_impl)
         self._prefill_fn = make_prefill_fn(cfg, quant)
         self._decode_fn = make_decode_fn(cfg, quant, self.decode_impl)
+        # Tier-2 prefill (chunked and/or cache-hit suffix) replaces the
+        # whole-prompt prefill path; with both features off the tier-1 path
+        # runs unchanged.
+        self._tier2_prefill = (
+            self.ecfg.prefill_chunk > 0 or self.ecfg.prefix_cache
+        )
+        if self._tier2_prefill:
+            self._chunk_fn = make_chunk_fn(cfg, quant, self.decode_impl, "last")
+        if self.ecfg.spec_tokens > 0:
+            self._verify_fn = make_chunk_fn(cfg, quant, self.decode_impl, "all")
+        self._cache = (
+            PrefixCache(self.ecfg.page_size) if self.ecfg.prefix_cache else None
+        )
 
         page, pool = self.ecfg.page_size, self.ecfg.num_pages
         max_seq = self.ecfg.max_seq or cfg.max_seq_len
@@ -373,6 +742,10 @@ class ServeEngine:
         self.total_tokens = 0
         self.total_finished = 0
         self.total_preemptions = 0
+        self.total_prefix_lookup_tokens = 0  # prompt tokens through admission
+        self.total_prefix_hit_tokens = 0     # of those, served from the cache
+        self.total_spec_proposed = 0         # draft tokens sent to verify
+        self.total_spec_accepted = 0         # of those, accepted
 
     # -- submission (thread-safe) -----------------------------------------
 
@@ -425,11 +798,33 @@ class ServeEngine:
     def has_work(self) -> bool:
         return bool(self.pending) or self.active_count > 0
 
+    @property
+    def available_pages(self) -> int:
+        """Pages the allocator can produce right now: the free list plus
+        refs == 0 cache blocks it may evict."""
+        n = len(self._free)
+        if self._cache is not None:
+            n += self._cache.evictable_count()
+        return n
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from the prefix cache."""
+        return self.total_prefix_hit_tokens / max(
+            self.total_prefix_lookup_tokens, 1
+        )
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the verify step accepted."""
+        return self.total_spec_accepted / max(self.total_spec_proposed, 1)
+
     def stats(self) -> Dict[str, float]:
         return {
             "queue_depth": self.queue_depth,
             "active": self.active_count,
             "free_pages": self.free_pages,
+            "available_pages": self.available_pages,
             "total_pages": self.ecfg.num_pages,
             "max_batch": self.ecfg.max_batch,
             "steps": self.total_steps,
@@ -439,29 +834,68 @@ class ServeEngine:
             "policy": self.ecfg.policy,
             "decode_impl": self.decode_impl,
             "quant": self.ecfg.quant,
+            "prefill_chunk": self.ecfg.prefill_chunk,
+            "prefix_cache": int(self.ecfg.prefix_cache),
+            "spec_tokens": self.ecfg.spec_tokens,
+            "cached_pages": len(self._cache) if self._cache else 0,
+            "prefix_evictions": self._cache.evictions if self._cache else 0,
+            "prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "spec_accept_rate": round(self.spec_accept_rate, 4),
         }
 
     # -- the step loop -----------------------------------------------------
 
     def step(self) -> List[TokenEvent]:
-        """One engine iteration: admit -> batched prefill -> one decode step.
-        Returns the tokens emitted this step, in emission order."""
+        """One engine iteration: admit -> prefill (whole-prompt, or one chunk
+        per mid-prefill slot in tier 2) -> one decode step (single-token, or
+        draft+verify with spec_tokens). Returns the tokens emitted this step,
+        in emission order."""
         events: List[TokenEvent] = []
         admitted = self._admit()
-        if admitted:
-            self._run_prefill(admitted, events)
-        if self.active_count:
-            self._run_decode(events)
+        if not self._tier2_prefill:
+            if admitted:
+                self._run_prefill(admitted, events)
+        elif any(self._prefilling(s) for s in range(self.ecfg.max_batch)):
+            self._run_chunk_prefill(events)
+        decoding = [
+            s for s, r in enumerate(self.slots)
+            if r is not None and not self._prefilling(s)
+        ]
+        if decoding:
+            if self.ecfg.spec_tokens > 0:
+                self._run_spec_decode(decoding, events)
+            else:
+                self._run_decode(decoding, events)
         self.total_steps += 1
         return events
+
+    def _prefilling(self, slot: int) -> bool:
+        req = self.slots[slot]
+        return req is not None and req.pos < len(req.prompt)
 
     def _pages_for(self, tokens: int) -> int:
         return -(-tokens // self.ecfg.page_size)
 
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        """Pop n pages from the free list, evicting LRU refs == 0 cache
+        blocks to refill it if needed; None (nothing taken, nothing evicted)
+        when the pool genuinely can't produce n pages. Eviction only runs
+        when it can actually satisfy the request: a failed allocation leaves
+        its caller blocked either way, so destroying cached prefixes for it
+        would cost every future sharer a re-prefill and buy nothing."""
+        if len(self._free) < n and self._cache is not None:
+            if len(self._free) + self._cache.evictable_count() >= n:
+                self._free.extend(self._cache.evict(n - len(self._free)))
+        if len(self._free) < n:
+            return None
+        return [self._free.pop() for _ in range(n)]
+
     def _admit(self) -> List[Tuple[int, GenRequest]]:
         """Move queued requests into free slots (FIFO, head-of-line blocking
         when pages are short — admission order is completion-signal order).
-        Static policy: only admit into an EMPTY batch."""
+        Static policy: only admit into an EMPTY batch. With the prefix cache
+        on, the prompt's longest cached block-prefix arrives as shared pages
+        and only the suffix needs fresh ones (and a prefill pass)."""
         if self.ecfg.policy == "static" and self.active_count:
             return []
         admitted: List[Tuple[int, GenRequest]] = []
@@ -471,19 +905,35 @@ class ServeEngine:
                 if not self.pending:
                     break
                 req = self.pending[0]
+                shared_pages: List[int] = []
+                matched = 0
+                if self._cache is not None:
+                    shared_pages, matched = self._cache.match(req.prompt)
                 # Reserve the prompt plus one decode page of headroom; growth
                 # beyond that allocates on demand (preempting if dry).
-                need = self._pages_for(len(req.prompt) + 1)
-                if need > len(self._free):
+                need = self._pages_for(len(req.prompt) + 1) - len(shared_pages)
+                new_pages = self._try_alloc(need)
+                if new_pages is None:
+                    if shared_pages:  # roll back the match refs
+                        self._free.extend(self._cache.release(shared_pages))
                     break
                 self.pending.popleft()
             slot = free_slots.pop(0)
-            pages = [self._free.pop() for _ in range(need)]
+            pages = shared_pages + new_pages
             self.slot_pages[slot] = pages
             row = self.page_tables[slot]
             row[:] = 0
             row[: len(pages)] = pages
-            self.seq_lens[slot] = 0
+            self.seq_lens[slot] = matched
+            req.pos = matched
+            req.cached_tokens = matched
+            if req.preemptions == 0:
+                # A preemption resume re-matches its OWN sealed blocks —
+                # counting that as a hit (and the resume prompt as fresh
+                # lookups) would inflate the exported hit ratio exactly when
+                # the pool is under pressure and the gauge matters most.
+                self.total_prefix_lookup_tokens += len(req.prompt)
+                self.total_prefix_hit_tokens += matched
             self.slots[slot] = req
             admitted.append((slot, req))
         return admitted
@@ -517,18 +967,71 @@ class ServeEngine:
         next_tokens = np.asarray(next_tokens)
         for i, (slot, req) in enumerate(admitted):
             self.seq_lens[slot] = len(req.prompt)
+            req.pos = len(req.prompt)
             self._emit(slot, req, int(next_tokens[i]), events)
 
-    def _run_decode(self, events: List[TokenEvent]) -> None:
+    def _run_chunk_prefill(self, events: List[TokenEvent]) -> None:
+        """Advance every mid-prefill slot by one chunk (tier-2 prefill). The
+        chunk's K/V is scattered into the slot's pages and its queries attend
+        over the paged prefix — so a cache-hit suffix resumes mid-prompt and
+        a long prompt spreads over many steps, at most prefill_chunk tokens
+        each. The final chunk's last-position argmax is the request's first
+        generated token."""
+        page = self.ecfg.page_size
+        pool = self.ecfg.num_pages
+        slots = [s for s in range(self.ecfg.max_batch) if self._prefilling(s)]
+        if not slots:
+            return
+        remaining = {
+            s: len(self.slots[s].prompt) - self.slots[s].pos for s in slots
+        }
+        chunk = self.ecfg.prefill_chunk or _bucket(max(remaining.values()), lo=8)
+        s_pad = _bucket(len(slots), lo=1)
+        tokens = np.zeros((s_pad, chunk), np.int32)
+        starts = np.zeros(s_pad, np.int32)
+        valid = np.zeros(s_pad, np.int32)
+        write_page = np.full((s_pad, chunk), pool, np.int32)
+        write_off = np.zeros((s_pad, chunk), np.int32)
+        tables = np.zeros((s_pad, self.table_width), np.int32)
+        for i, slot in enumerate(slots):
+            req = self.slots[slot]
+            n = min(chunk, remaining[slot])
+            tokens[i, :n] = req.prompt[req.pos:req.pos + n]
+            starts[i] = req.pos
+            valid[i] = n
+            pos = req.pos + np.arange(n)
+            pages = np.asarray(self.slot_pages[slot], np.int32)
+            write_page[i, :n] = pages[pos // page]
+            write_off[i, :n] = pos % page
+            tables[i] = self.page_tables[slot]
+
+        next_tokens, self.k_pages, self.v_pages = self._chunk_fn(
+            self._serve_params, jnp.asarray(tokens), jnp.asarray(starts),
+            jnp.asarray(valid), self.k_pages, self.v_pages,
+            jnp.asarray(tables), jnp.asarray(write_page),
+            jnp.asarray(write_off),
+        )
+        next_tokens = np.asarray(next_tokens)
+        for i, slot in enumerate(slots):
+            req = self.slots[slot]
+            req.pos += int(valid[i])
+            self.seq_lens[slot] = req.pos
+            if req.pos < len(req.prompt):
+                continue  # more chunks to go; nothing emitted yet
+            if self._cache is not None:
+                self._cache.register(req.prompt, self.slot_pages[slot])
+            self._emit(slot, req, int(next_tokens[i]), events)
+
+    def _run_decode(self, decoding: List[int], events: List[TokenEvent]) -> None:
         page = self.ecfg.page_size
         pool = self.ecfg.num_pages
         mb = self.ecfg.max_batch
-        self._ensure_decode_pages()
+        self._ensure_decode_pages(decoding)
         write_page = np.full(mb, pool, np.int32)
         write_off = np.zeros(mb, np.int32)
         active = []
-        for slot, req in enumerate(self.slots):
-            if req is None:
+        for slot in decoding:
+            if self.slots[slot] is None:  # preempted by _ensure_decode_pages
                 continue
             pos = int(self.seq_lens[slot])
             write_page[slot] = self.page_tables[slot, pos // page]
@@ -553,19 +1056,115 @@ class ServeEngine:
             self.seq_lens[slot] += 1  # the last token's KV just landed
             self._emit(slot, req, int(next_tokens[slot]), events)
 
-    def _ensure_decode_pages(self) -> None:
-        """Every active slot about to write position seq_len needs page
-        seq_len // page_size allocated; a dry pool preempts the youngest
-        request (fewest generated tokens) back to the queue — its pages fund
-        the older requests, and it re-prefills later from prompt + generated
-        so no emitted token is ever lost."""
+    def _run_spec_decode(
+        self, decoding: List[int], events: List[TokenEvent]
+    ) -> None:
+        """Draft + verify decode step: each slot's row is [last_token,
+        d1..dk] at positions seq_len..seq_len+k; one chunk forward scores all
+        of them, and position i's argmax is the model's true next token after
+        consuming the row's first i+1 tokens. Greedy accept runs left to
+        right: draft d_{i+1} is accepted iff it equals argmax_i; the first
+        mismatch emits the correction instead. Every emitted token is exactly
+        what single-token greedy decode would have produced — speculation
+        only changes how many land per step. Rejected positions' K/V stays in
+        the pages but is never read: seq_len advances only past accepted
+        tokens, and the next step re-writes those positions before attending."""
         page = self.ecfg.page_size
-        for slot, req in enumerate(self.slots):
-            if req is None:
+        pool = self.ecfg.num_pages
+        mb = self.ecfg.max_batch
+        c = self.ecfg.spec_tokens + 1
+        # Clip each slot's row to the tokens it can still emit: emitted per
+        # step <= valid, and submit() guarantees prompt + max_new <= max_seq,
+        # so seq_len + valid never crosses the page-table width either.
+        valid = np.zeros(mb, np.int32)
+        for slot in decoding:
+            req = self.slots[slot]
+            valid[slot] = min(c, req.max_new_tokens - len(req.tokens))
+        self._ensure_decode_pages(decoding, extra=valid)
+        tokens = np.zeros((mb, c), np.int32)
+        starts = np.zeros(mb, np.int32)
+        write_page = np.full((mb, c), pool, np.int32)
+        write_off = np.zeros((mb, c), np.int32)
+        active = []
+        drafts: Dict[int, List[int]] = {}
+        for slot in decoding:
+            req = self.slots[slot]
+            if req is None:  # preempted by _ensure_decode_pages
                 continue
-            need_idx = int(self.seq_lens[slot]) // page
+            n = int(valid[slot])
+            row = [int(self.last_tokens[slot])]
+            if n > 1:
+                if req.spec_ctx is None:
+                    # prompt + tokens[absorbed:] is the emitted stream with
+                    # each token exactly once (plain prompt + tokens would
+                    # duplicate the pre-preemption segment a refold already
+                    # folded into the prompt).
+                    req.spec_ctx = (
+                        list(req.prompt) + list(req.tokens[req.absorbed:])
+                    )
+                    req.spec_index = _ngram_index(req.spec_ctx)
+                row += propose_from_index(
+                    req.spec_ctx, req.spec_index, n - 1
+                )
+            drafts[slot] = row[1:]
+            tokens[slot, :n] = row
+            starts[slot] = self.seq_lens[slot]
+            pos = int(self.seq_lens[slot]) + np.arange(n)
+            pages = np.asarray(self.slot_pages[slot], np.int32)
+            write_page[slot, :n] = pages[pos // page]
+            write_off[slot, :n] = pos % page
+            active.append(slot)
+        if not active:
+            return
+
+        out_tokens, self.k_pages, self.v_pages = self._verify_fn(
+            self._serve_params, jnp.asarray(tokens),
+            jnp.asarray(starts), jnp.asarray(valid, dtype=jnp.int32),
+            self.k_pages, self.v_pages, jnp.asarray(self.page_tables),
+            jnp.asarray(write_page), jnp.asarray(write_off),
+        )
+        out_tokens = np.asarray(out_tokens)  # [mb, c]
+        for slot in active:
+            req = self.slots[slot]
+            n = int(valid[slot])
+            row_drafts = drafts[slot]
+            accepted = 0
+            while (
+                accepted < n - 1
+                and row_drafts[accepted] == int(out_tokens[slot, accepted])
+            ):
+                accepted += 1
+            emitted = row_drafts[:accepted] + [int(out_tokens[slot, accepted])]
+            self.total_spec_proposed += n - 1
+            self.total_spec_accepted += accepted
+            # The accepted context tokens' K/V (row positions 0..accepted)
+            # just landed; the new emitted tail token is not yet written.
+            self.seq_lens[slot] += accepted + 1
+            for token in emitted:
+                self._emit(slot, req, token, events)
+                if req.done:
+                    break
+
+    def _ensure_decode_pages(
+        self, decoding: List[int], extra: Optional[np.ndarray] = None
+    ) -> None:
+        """Every decoding slot about to write position seq_len (through
+        seq_len + extra - 1 under speculation) needs those positions' pages
+        allocated; a dry pool — free list AND evictable cache blocks —
+        preempts the youngest request (fewest generated tokens) back to the
+        queue: its pages fund the older requests, and it re-prefills later
+        from prompt + generated so no emitted token is ever lost."""
+        page = self.ecfg.page_size
+        for slot in decoding:
+            if self.slots[slot] is None:
+                continue
+            last_pos = int(self.seq_lens[slot])
+            if extra is not None:
+                last_pos += max(int(extra[slot]) - 1, 0)
+            need_idx = last_pos // page
             while need_idx >= len(self.slot_pages[slot]):
-                if not self._free:
+                got = self._try_alloc(1)
+                if got is None:
                     victim = self._pick_victim(exclude=slot)
                     if victim is None:
                         # Nothing to steal from: this slot itself is the
@@ -574,9 +1173,8 @@ class ServeEngine:
                         break
                     self._preempt(victim)
                     continue
-                new_page = self._free.pop()
-                self.slot_pages[slot].append(new_page)
-                self.page_tables[slot, len(self.slot_pages[slot]) - 1] = new_page
+                self.slot_pages[slot].extend(got)
+                self.page_tables[slot, len(self.slot_pages[slot]) - 1] = got[0]
             # If this slot was itself preempted, move on.
 
     def _pick_victim(self, exclude: int) -> Optional[int]:
@@ -610,6 +1208,9 @@ class ServeEngine:
         self, slot: int, req: GenRequest, token: int, events: List[TokenEvent]
     ) -> None:
         req.tokens.append(token)
+        if req.spec_ctx is not None:
+            req.spec_ctx.append(token)
+            _ngram_record(req.spec_ctx, len(req.spec_ctx) - 1, req.spec_index)
         self.total_tokens += 1
         done = (
             len(req.tokens) >= req.max_new_tokens
@@ -624,7 +1225,12 @@ class ServeEngine:
             self.last_tokens[slot] = token
 
     def _release_slot(self, slot: int) -> None:
-        self._free.extend(self.slot_pages[slot])
+        if self._cache is not None:
+            # Cached pages stay resident at refs == 0 (LRU-evictable); only
+            # truly private pages return to the free list.
+            self._free.extend(self._cache.release(self.slot_pages[slot]))
+        else:
+            self._free.extend(self.slot_pages[slot])
         self.slot_pages[slot] = []
         self.page_tables[slot, :] = 0
         self.seq_lens[slot] = 0
@@ -757,7 +1363,19 @@ def create_serve_app(runner: EngineRunner):
     engine = runner.engine
 
     def qd_headers() -> dict:
-        return {"X-Dstack-Queue-Depth": str(engine.queue_depth)}
+        headers = {"X-Dstack-Queue-Depth": str(engine.queue_depth)}
+        # Tier-2 gauges ride the same channel as the queue depth: the proxy
+        # records them in-memory and /metrics renders them per service, with
+        # zero extra hops (services/proxy.py ENGINE_GAUGE_HEADERS).
+        if engine.ecfg.prefix_cache:
+            headers["X-Dstack-Prefix-Hit-Rate"] = (
+                f"{engine.prefix_hit_rate:.4f}"
+            )
+        if engine.ecfg.spec_tokens > 0:
+            headers["X-Dstack-Spec-Accept-Rate"] = (
+                f"{engine.spec_accept_rate:.4f}"
+            )
+        return headers
 
     async def generate(request: web.Request) -> web.StreamResponse:
         try:
@@ -873,6 +1491,20 @@ def main() -> None:
                         help="int8 = weight-only quantization (projection"
                              " weights stored int8 + per-channel scales —"
                              " half the weight HBM)")
+    parser.add_argument("--prefill-chunk", type=int, default=0,
+                        dest="prefill_chunk",
+                        help="max prompt tokens prefilled per engine step"
+                             " (0 = whole prompt at once); chunking keeps one"
+                             " long prompt from stalling the decode batch")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        dest="prefix_cache",
+                        help="reuse KV pages across requests sharing a prompt"
+                             " prefix (refcounted, LRU-evicted full blocks)")
+    parser.add_argument("--spec-tokens", type=int, default=0,
+                        dest="spec_tokens",
+                        help="speculative decode: n-gram draft tokens"
+                             " verified per step (0 = off); output stays"
+                             " token-identical to greedy")
     args = parser.parse_args()
 
     cfg = get_config(args.config)
@@ -886,6 +1518,9 @@ def main() -> None:
             policy=args.policy,
             decode_impl=args.decode_impl,
             quant=args.quant,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache=args.prefix_cache,
+            spec_tokens=args.spec_tokens,
         ),
     )
     runner = EngineRunner(engine)
@@ -894,7 +1529,8 @@ def main() -> None:
         f"serving config={args.config} on :{args.port} "
         f"(pages={args.pages}x{args.page_size}, slots={args.max_batch}, "
         f"policy={args.policy}, decode={engine.decode_impl}, "
-        f"quant={args.quant})",
+        f"quant={args.quant}, prefill_chunk={args.prefill_chunk}, "
+        f"prefix_cache={args.prefix_cache}, spec_tokens={args.spec_tokens})",
         flush=True,
     )
     try:

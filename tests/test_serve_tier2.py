@@ -1,0 +1,555 @@
+"""Tier-2 serving engine: chunked prefill, cross-request prefix caching, and
+speculative decode (PR 9).
+
+Every feature here is a THROUGHPUT/LATENCY optimization, never a semantic
+one — the invariant all three share is that the emitted token stream must be
+bit-identical to the tier-1 engine's. test_serve_engine.py pins the tier-1
+engine against a full-context reference decode, so most tests here compare
+against a plain tier-1 engine (jitted + batched = fast) and one anchor test
+compares chunked prefill directly against ``greedy_reference_decode``.
+Equivalence runs in fp32 on CPU so argmax ties can't blur the comparison.
+
+The page-accounting tests additionally pin the allocator invariant: free
+list, cached blocks, and private slot pages PARTITION the pool at every
+step — eviction can never free a live page.
+
+Engine geometries are deliberately reused across tests (and shared with
+test_serve_engine.py): every distinct (page_size, num_pages, max_batch,
+max_seq) is a fresh set of XLA compilations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import serve as serve_lib
+from dstack_tpu.workloads.attention import paged_chunk_attention
+from dstack_tpu.workloads.config import get_config
+from dstack_tpu.workloads.kernels.paged import paged_chunk_attention_pallas
+
+TINY = get_config(
+    "test", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+    vocab_size=251, max_seq_len=128, dtype="float32", param_dtype="float32",
+    remat=False,
+)
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16]]
+
+# 18 tokens = 2 full pages of 8 + a 2-token tail: long enough that prefix
+# matching covers whole blocks, short enough to stay fast.
+SHARED_PREFIX = [5, 9, 13, 2, 44, 17, 81, 3, 7, 7, 101, 55, 13, 24, 9, 16,
+                 31, 8]
+
+# The preemption geometry test_serve_engine.py uses: pool sized so decode
+# growth forces preemption of the youngest request.
+PREEMPT_POOL = dict(page_size=4, num_pages=7, max_batch=3, max_seq=96)
+PREEMPT_PROMPTS = [[i + 1, i + 2, i + 3, i + 4, i + 5] for i in (0, 10, 20)]
+
+# One tight-pool geometry shared by every eviction/rollback test.
+EVICT_POOL = dict(page_size=4, num_pages=12, max_batch=2, max_seq=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model_lib.init_params(TINY, jax.random.PRNGKey(0))
+
+
+def make_engine(params, **overrides) -> serve_lib.ServeEngine:
+    kwargs = dict(page_size=8, num_pages=32, max_batch=4, max_seq=128)
+    kwargs.update(overrides)
+    return serve_lib.ServeEngine(
+        TINY, serve_lib.EngineConfig(**kwargs), params=params
+    )
+
+
+def drain(engine, limit=3000, per_step=None):
+    steps = 0
+    while engine.has_work():
+        engine.step()
+        if per_step is not None:
+            per_step(engine)
+        steps += 1
+        assert steps < limit, "engine never drained"
+    return steps
+
+
+_REF_MEMO = {}
+
+
+def tier1_decode(params, prompts, max_new) -> list:
+    """Expected token streams from a plain tier-1 engine at the default
+    roomy geometry (no preemption, no tier-2 features) — itself proven
+    token-identical to the full-context reference by test_serve_engine.py.
+    Memoized: preemption/eviction tests reuse the same prompt sets."""
+    key = (id(params), tuple(tuple(p) for p in prompts), max_new)
+    if key not in _REF_MEMO:
+        engine = make_engine(params)
+        out = []
+        for batch_start in range(0, len(prompts), engine.ecfg.max_batch):
+            batch = prompts[batch_start:batch_start + engine.ecfg.max_batch]
+            reqs = [engine.submit(p, max_new_tokens=max_new) for p in batch]
+            drain(engine)
+            out.extend(r.tokens for r in reqs)
+        _REF_MEMO[key] = out
+    return _REF_MEMO[key]
+
+
+def check_page_partition(engine) -> None:
+    """free list + cached blocks + private slot pages partition the pool:
+    no page is ever in two of them, and none is lost."""
+    free = set(engine._free)
+    assert len(free) == len(engine._free), "free list duplicate"
+    cached = (
+        {blk.page for blk in engine._cache.blocks.values()}
+        if engine._cache is not None else set()
+    )
+    in_slots = set()
+    for pages in engine.slot_pages:
+        in_slots.update(pages)
+    private = in_slots - cached
+    assert not free & cached, "cached page on the free list"
+    assert not free & private, "page both free and owned by a slot"
+    assert len(free) + len(cached) + len(private) == engine.ecfg.num_pages
+
+
+class TestChunkedPrefill:
+    def test_token_identical_to_full_reference(self, params):
+        """The anchor: chunked prefill against the O(T^2) full-context
+        reference directly (not via the tier-1 engine). Chunk 4 over 3/5/7
+        token prompts exercises unaligned chunk boundaries."""
+        engine = make_engine(params, prefill_chunk=4)
+        reqs = [engine.submit(p, max_new_tokens=6) for p in PROMPTS]
+        drain(engine)
+        for prompt, req in zip(PROMPTS, reqs):
+            assert req.tokens == serve_lib.greedy_reference_decode(
+                params, TINY, prompt, 6
+            ), f"chunked prefill diverged for {prompt}"
+
+    def test_token_identical_under_preemption(self, params):
+        ref = tier1_decode(params, PREEMPT_PROMPTS, 20)
+        engine = make_engine(params, prefill_chunk=4, **PREEMPT_POOL)
+        reqs = [engine.submit(p, max_new_tokens=20) for p in PREEMPT_PROMPTS]
+        drain(engine)
+        assert max(r.preemptions for r in reqs) >= 1, (
+            "pool was sized to force preemption"
+        )
+        assert [r.tokens for r in reqs] == ref
+
+    def test_long_prompt_does_not_stall_running_decode(self, params):
+        """THE chunking guarantee: while a long prompt prefills chunk by
+        chunk, an already-decoding request keeps emitting one token EVERY
+        step — with whole-prompt prefill those steps would all be one
+        monolithic stall."""
+        engine = make_engine(params, prefill_chunk=4)
+        a = engine.submit(PROMPTS[0], max_new_tokens=16)
+        for _ in range(3):
+            engine.step()
+        long_prompt = list(range(1, 33))  # 8 chunks of 4
+        b = engine.submit(long_prompt, max_new_tokens=4)
+        chunk_steps = 0
+        while not b.tokens and not b.done:
+            before = len(a.tokens)
+            engine.step()
+            chunk_steps += 1
+            assert len(a.tokens) == before + 1, (
+                "decode stalled during a prefill chunk"
+            )
+            assert chunk_steps < 32
+        assert chunk_steps >= 32 // 4, "prompt was not actually chunked"
+        drain(engine)
+        assert [a.tokens] == tier1_decode(params, [PROMPTS[0]], 16)
+        assert [b.tokens] == tier1_decode(params, [long_prompt], 4)
+
+
+class TestPrefixCache:
+    def test_hit_path_equals_cold_path(self, params):
+        """The second identical-prefix request reuses cached pages and still
+        emits exactly the cold path's tokens."""
+        engine = make_engine(params, prefix_cache=True)
+        prompts = [SHARED_PREFIX + [50 + i, 60 + i] for i in range(3)]
+        outs = []
+        for p in prompts:
+            r = engine.submit(p, max_new_tokens=6)
+            drain(engine)
+            outs.append(r.tokens)
+        assert engine.total_prefix_hit_tokens > 0, engine.stats()
+        assert engine.stats()["prefix_hit_rate"] > 0.3
+        assert outs == tier1_decode(params, prompts, 6), (
+            "cache-hit path diverged from cold path"
+        )
+
+    def test_concurrent_requests_share_pages_with_refcounts(self, params):
+        engine = make_engine(params, prefix_cache=True)
+        warm = engine.submit(SHARED_PREFIX + [99], max_new_tokens=2)
+        drain(engine)
+        assert warm.done
+        n_shared = len(SHARED_PREFIX) // engine.ecfg.page_size  # 2 blocks
+        a = engine.submit(SHARED_PREFIX + [70, 71], max_new_tokens=8)
+        b = engine.submit(SHARED_PREFIX + [80, 81], max_new_tokens=8)
+        engine.step()
+        # Both slots' tables open with the SAME cached pages...
+        slot_a = engine.slots.index(a)
+        slot_b = engine.slots.index(b)
+        pages_a = engine.page_tables[slot_a][:n_shared].tolist()
+        pages_b = engine.page_tables[slot_b][:n_shared].tolist()
+        assert pages_a == pages_b
+        # ...each holding one reference per user.
+        for page in pages_a:
+            assert engine._cache._page_block[page].refs == 2
+        check_page_partition(engine)
+        drain(engine)
+        for page in pages_a:
+            assert engine._cache._page_block[page].refs == 0  # released
+        assert [a.tokens, b.tokens] == tier1_decode(
+            params, [a.prompt, b.prompt], 8
+        )
+
+    def test_fully_cached_prompt_still_prefills_last_block(self, params):
+        """A prompt that is exactly its cached blocks must keep >= 1 token
+        to prefill — the first output token comes from the last position's
+        logits, which a pure cache hit would never compute."""
+        engine = make_engine(params, prefix_cache=True)
+        prompt = SHARED_PREFIX[:16]  # exactly 2 full pages
+        first = engine.submit(prompt, max_new_tokens=4)
+        drain(engine)
+        again = engine.submit(prompt, max_new_tokens=4)
+        drain(engine)
+        assert again.cached_tokens == 8  # one block matched, one recomputed
+        assert [first.tokens] == tier1_decode(params, [prompt], 4)
+        assert again.tokens == first.tokens
+
+    def test_eviction_never_frees_a_live_page(self, params):
+        """Churn through more distinct prefixes than the pool holds: blocks
+        must evict (the counter moves), the partition invariant must hold at
+        every step, and every output must still match the tier-1 engine."""
+        import random
+
+        rng = random.Random(3)
+        engine = make_engine(params, prefix_cache=True, **EVICT_POOL)
+        prompts = [
+            [rng.randrange(1, 250) for _ in range(rng.randint(6, 14))]
+            for _ in range(8)
+        ]
+        reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        drain(engine, per_step=check_page_partition)
+        assert engine._cache.evictions > 0, "pool was sized to force eviction"
+        assert [r.tokens for r in reqs] == tier1_decode(params, prompts, 8), (
+            "eviction corrupted a stream"
+        )
+
+    def test_admission_rollback_when_pages_short(self, params):
+        """A cache-hit request that still can't fit its suffix stays queued
+        — and the match's references are rolled back, so the blocks remain
+        evictable rather than pinned by a request that never ran."""
+        engine = make_engine(params, prefix_cache=True, **EVICT_POOL)
+        prefix = SHARED_PREFIX[:8]  # 2 blocks of 4
+        warm = engine.submit(prefix + [60], max_new_tokens=2)
+        drain(engine)
+        assert warm.done
+        prefix_pages = [
+            blk.page for blk in engine._cache.blocks.values()
+        ]
+        assert len(prefix_pages) == 2
+        # Hog the rest of the pool so the next request's suffix can't fit.
+        hog = engine.submit([100 + (i % 90) for i in range(37)],
+                            max_new_tokens=8)
+        engine.step()
+        queued = engine.submit(prefix + [71, 72, 73, 74, 75], max_new_tokens=4)
+        engine.step()
+        assert engine.queue_depth == 1 and not queued.tokens
+        # The failed admission rolled its matched references back (the hog's
+        # own registered blocks legitimately keep refs while it decodes).
+        for page in prefix_pages:
+            blk = engine._cache._page_block.get(page)
+            assert blk is None or blk.refs == 0, (
+                "failed admission left refs behind"
+            )
+        drain(engine, per_step=check_page_partition)
+        assert hog.done and queued.done
+        assert [queued.tokens] == tier1_decode(params, [queued.prompt], 4)
+
+    def test_failed_allocation_does_not_evict_cache(self, params):
+        """An allocation the pool can't satisfy even by evicting everything
+        must evict NOTHING: the requester stays blocked either way, and
+        destroying cached prefixes for it would cost every later sharer a
+        re-prefill for zero gain."""
+        engine = make_engine(params, prefix_cache=True, **EVICT_POOL)
+        prefix = SHARED_PREFIX[:8]  # 2 blocks of 4
+        warm = engine.submit(prefix + [60], max_new_tokens=2)
+        drain(engine)
+        assert warm.done and len(engine._cache) == 2
+        warm_keys = set(engine._cache.blocks)
+        # Hog 9 of the 10 remaining pages (33 + 1 headroom) for several
+        # steps (prefill + decode emit 2 tokens the first step, then one
+        # per step; 33 + 6 = 39 tokens never outgrows 10 pages), so the
+        # pool is free<=1 / evictable=2 while the hog runs (the hog's own
+        # prompt blocks get registered too, but at refs=1 — not evictable).
+        hog = engine.submit([100 + (i % 90) for i in range(33)],
+                            max_new_tokens=6)
+        engine.step()
+        # 8 pages needed, at most 3 obtainable: must fail WITHOUT touching
+        # the cache.
+        big = engine.submit([200 + (i % 50) for i in range(30)],
+                            max_new_tokens=2)
+        engine.step()
+        assert engine.queue_depth == 1 and not big.tokens
+        assert engine._cache.evictions == 0, (
+            "failed allocation destroyed cached prefixes"
+        )
+        assert warm_keys <= set(engine._cache.blocks)
+        drain(engine, per_step=check_page_partition)
+        assert hog.done and big.done
+
+    def test_resume_after_preemption_not_counted_as_hit(self, params):
+        """Preemption resumes re-match their OWN sealed blocks — correct for
+        page reuse, but not cross-request sharing: the exported hit ratio
+        must stay 0 on a no-sharing workload however much preemption churn
+        the pool forces."""
+        ref = tier1_decode(params, PREEMPT_PROMPTS, 20)
+        engine = make_engine(params, prefix_cache=True, **PREEMPT_POOL)
+        reqs = [engine.submit(p, max_new_tokens=20) for p in PREEMPT_PROMPTS]
+        drain(engine, per_step=check_page_partition)
+        assert max(r.preemptions for r in reqs) >= 1
+        assert [r.tokens for r in reqs] == ref
+        assert engine.total_prefix_hit_tokens == 0, (
+            "self-matches on resume inflated the hit counter"
+        )
+        # Lookups: each prompt counted once, resumes excluded.
+        assert engine.total_prefix_lookup_tokens == sum(
+            len(p) for p in PREEMPT_PROMPTS
+        )
+
+
+class TestSpeculativeDecode:
+    def test_token_identical_to_plain_engine(self, params):
+        # Repetitive prompts feed the n-gram proposer, so acceptance > 0 and
+        # the equivalence is exercised on real accepted drafts.
+        base = [3, 17, 9, 3, 17, 9, 3, 17]
+        prompts = [base + [40 + i] for i in range(3)]
+        plain = make_engine(params)
+        p_reqs = [plain.submit(p, max_new_tokens=16) for p in prompts]
+        drain(plain)
+        spec = make_engine(params, spec_tokens=3)
+        s_reqs = [spec.submit(p, max_new_tokens=16) for p in prompts]
+        drain(spec)
+        for pr, sr in zip(p_reqs, s_reqs):
+            assert sr.tokens == pr.tokens, "speculation changed the output"
+        assert spec.total_spec_proposed > 0
+        assert spec.total_steps <= plain.total_steps
+
+    def test_token_identical_under_preemption(self, params):
+        ref = tier1_decode(params, PREEMPT_PROMPTS, 20)
+        engine = make_engine(params, spec_tokens=3, **PREEMPT_POOL)
+        reqs = [engine.submit(p, max_new_tokens=20) for p in PREEMPT_PROMPTS]
+        drain(engine)
+        assert max(r.preemptions for r in reqs) >= 1
+        assert [r.tokens for r in reqs] == ref
+
+    def test_max_new_exact_and_eos_stop(self, params):
+        """A spec burst can propose past the request's budget or its EOS:
+        emission must clip to exactly max_new, and stop AT the eos token."""
+        [ref] = tier1_decode(params, [PROMPTS[0]], 6)
+        engine = make_engine(params, spec_tokens=3)
+        exact = engine.submit(PROMPTS[0], max_new_tokens=6)
+        drain(engine)
+        assert exact.tokens == ref and len(exact.tokens) == 6
+
+        eos = ref[2]
+        stopped = engine.submit(PROMPTS[0], max_new_tokens=6, eos_id=eos)
+        drain(engine)
+        assert stopped.tokens == ref[:3]  # eos included, nothing after
+        assert stopped.done
+
+    def test_ngram_proposer(self):
+        # The trailing bigram (5, 6) occurred earlier; drafts replay what
+        # followed it.
+        ctx = [1, 5, 6, 9, 4, 2, 5, 6]
+        assert serve_lib.propose_ngram_drafts(ctx, 3) == [9, 4, 2]
+        # Shorter continuation than k: pad with the last token.
+        assert serve_lib.propose_ngram_drafts([1, 5, 6, 9, 5, 6], 3) == [9, 5, 6]
+        # No recurrence at all: fall back to repeating the last token.
+        assert serve_lib.propose_ngram_drafts([1, 2, 3], 2) == [3, 3]
+        assert serve_lib.propose_ngram_drafts([], 2) == []
+        assert serve_lib.propose_ngram_drafts([1, 2], 0) == []
+
+    def test_index_proposer_matches_scan(self):
+        """The engine's O(1) continuation-index proposer is a drop-in for
+        the reference backward scan: identical drafts on random (and highly
+        repetitive, so n-grams actually recur) sequences, both when the
+        index is built whole and when it is grown token by token the way
+        ``_emit`` maintains it."""
+        import random
+
+        rng = random.Random(11)
+        for trial in range(200):
+            n = rng.randint(1, 40)
+            ctx = [rng.randrange(1, 5) for _ in range(n)]
+            k = rng.randint(1, 5)
+            index = serve_lib._ngram_index(ctx)
+            assert serve_lib.propose_from_index(ctx, index, k) == (
+                serve_lib.propose_ngram_drafts(ctx, k)
+            ), (ctx, k)
+            # Incremental maintenance reaches the same index state.
+            grown: dict = {}
+            for i in range(1, len(ctx)):
+                serve_lib._ngram_record(ctx, i, grown)
+            assert grown == index, ctx
+
+
+class TestCombined:
+    def test_all_three_with_pallas_decode(self, params):
+        """Chunked prefill + prefix cache + speculation, decode_impl=pallas:
+        the in-repo chunk kernel (interpret mode on CPU) runs both the
+        prefill chunks and the verify step, token-identically."""
+        engine = make_engine(params, prefix_cache=True, prefill_chunk=4,
+                             spec_tokens=3, decode_impl="pallas")
+        warm = engine.submit(SHARED_PREFIX + [50], max_new_tokens=2)
+        drain(engine)
+        assert warm.done
+        prompts = [SHARED_PREFIX + [60], SHARED_PREFIX + [61]]
+        reqs = [engine.submit(p, max_new_tokens=4) for p in prompts]
+        drain(engine, per_step=check_page_partition)
+        assert engine.total_prefix_hit_tokens > 0
+        assert [r.tokens for r in reqs] == tier1_decode(params, prompts, 4)
+
+    def test_tier2_with_int8_matches_plain_int8(self, params):
+        """quant changes numerics (so no fp reference) — but tier-2 must
+        still be a pure scheduling change WITHIN the int8 world."""
+        plain = make_engine(params, quant="int8")
+        p_reqs = [plain.submit(p, max_new_tokens=6) for p in PROMPTS]
+        drain(plain)
+        tier2 = make_engine(params, quant="int8", prefix_cache=True,
+                            prefill_chunk=4, spec_tokens=3)
+        t_reqs = [tier2.submit(p, max_new_tokens=6) for p in PROMPTS]
+        drain(tier2)
+        for pr, tr in zip(p_reqs, t_reqs):
+            assert tr.tokens == pr.tokens
+
+
+class TestChunkKernelParity:
+    def test_pallas_matches_xla_on_valid_queries(self):
+        ks = jax.random.split(jax.random.PRNGKey(2), 5)
+        q = jax.random.normal(ks[0], (4, 4, 4, 16))
+        kp = jax.random.normal(ks[1], (12, 8, 2, 16))
+        vp = jax.random.normal(ks[2], (12, 8, 2, 16))
+        pt = jax.random.randint(ks[3], (4, 6), 0, 12)
+        starts = jnp.array([0, 5, 17, 40], jnp.int32)
+        valid = jnp.array([4, 4, 2, 4], jnp.int32)
+        got = paged_chunk_attention_pallas(q, kp, vp, pt, starts,
+                                           starts + valid)
+        ref = paged_chunk_attention(q, kp, vp, pt, starts)
+        for s in range(4):
+            np.testing.assert_allclose(
+                np.asarray(got[s, :int(valid[s])]),
+                np.asarray(ref[s, :int(valid[s])]),
+                atol=1e-4,
+            )
+        assert bool(jnp.isfinite(got).all())
+        # kv_len == 0 slots (inactive) emit finite zeros, never NaN.
+        out0 = paged_chunk_attention_pallas(
+            q, kp, vp, pt, jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32)
+        )
+        assert bool(jnp.isfinite(out0).all())
+
+    def test_decode_is_the_c1_special_case(self):
+        """chunk attention with C=1 and starts = kv_lens - 1 must equal the
+        single-query decode path — the relationship the engine relies on."""
+        from dstack_tpu.workloads.attention import paged_decode_attention
+
+        ks = jax.random.split(jax.random.PRNGKey(4), 4)
+        q = jax.random.normal(ks[0], (3, 4, 16))
+        kp = jax.random.normal(ks[1], (8, 8, 2, 16))
+        vp = jax.random.normal(ks[2], (8, 8, 2, 16))
+        pt = jax.random.randint(ks[3], (3, 4), 0, 8)
+        kv_lens = jnp.array([1, 9, 30], jnp.int32)
+        dec = paged_decode_attention(q, kp, vp, pt, kv_lens)
+        chunk = paged_chunk_attention(q[:, None], kp, vp, pt, kv_lens - 1)
+        np.testing.assert_allclose(
+            np.asarray(dec), np.asarray(chunk[:, 0]), atol=1e-5
+        )
+
+
+class TestConfigValidation:
+    def test_negative_knobs_rejected(self, params):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            make_engine(params, prefill_chunk=-1)
+        with pytest.raises(ValueError, match="spec_tokens"):
+            make_engine(params, spec_tokens=-2)
+        with pytest.raises(ValueError, match="prefix_cache"):
+            make_engine(params, prefix_cache=True, num_pages=1, max_seq=8)
+
+    def test_stats_surface(self, params):
+        engine = make_engine(params, prefix_cache=True, prefill_chunk=8,
+                             spec_tokens=2)
+        stats = engine.stats()
+        for key in ("prefill_chunk", "prefix_cache", "spec_tokens",
+                    "prefix_hit_rate", "spec_accept_rate", "cached_pages",
+                    "prefix_evictions"):
+            assert key in stats, key
+        assert stats["prefill_chunk"] == 8
+        assert stats["spec_tokens"] == 2
+        assert stats["prefix_cache"] == 1
+
+
+class TestEngineGaugesThroughProxy:
+    async def test_headers_emitted_and_recorded(self, params):
+        """The engine app reports tier-2 gauges on every response; the proxy
+        records them for /metrics exactly like the queue depth."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from dstack_tpu.server.services import proxy as proxy_service
+
+        runner = serve_lib.EngineRunner(
+            make_engine(params, prefix_cache=True, spec_tokens=3)
+        )
+        runner.start()
+        try:
+            client = TestClient(TestServer(serve_lib.create_serve_app(runner)))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/generate",
+                    json={"prompt_tokens": SHARED_PREFIX + [61],
+                          "max_tokens": 3, "stream": False},
+                )
+                assert resp.status == 200
+                assert "X-Dstack-Prefix-Hit-Rate" in resp.headers
+                assert "X-Dstack-Spec-Accept-Rate" in resp.headers
+                # The proxy-side recording path (unit: feed the headers in).
+                stats = proxy_service.ServiceStats()
+                saved, proxy_service.stats = proxy_service.stats, stats
+                try:
+                    proxy_service._record_queue_depth("r1", resp.headers)
+                finally:
+                    proxy_service.stats = saved
+                gauges = stats.engine_gauges("r1")
+                assert set(gauges) == {
+                    "prefix_cache_hit_ratio", "spec_accept_ratio"
+                }
+                assert stats.queue_depth("r1") is not None
+            finally:
+                await client.close()
+        finally:
+            runner.shutdown()
+
+    async def test_gauges_absent_when_features_off(self, params):
+        """A tier-1 engine must not advertise ratios it doesn't compute."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        runner = serve_lib.EngineRunner(make_engine(params))
+        runner.start()
+        try:
+            client = TestClient(TestServer(serve_lib.create_serve_app(runner)))
+            await client.start_server()
+            try:
+                resp = await client.get("/health")
+                assert resp.status == 200
+                assert "X-Dstack-Queue-Depth" in resp.headers
+                assert "X-Dstack-Prefix-Hit-Rate" not in resp.headers
+                assert "X-Dstack-Spec-Accept-Rate" not in resp.headers
+            finally:
+                await client.close()
+        finally:
+            runner.shutdown()
