@@ -74,6 +74,10 @@ bench-kernels:
 
 # Observability smoke: boots the server in-process, drives one run through the
 # full FSM, and asserts the events timeline + /metrics histograms are live.
+# Then drives a REAL train workload through the native runner agent and
+# asserts its telemetry lands end to end: step/MFU/goodput on /metrics (per-run
+# gauges scraped while the run is live), workload columns in `dstack-tpu
+# metrics`, and a goodput ledger that debits the compile stall.
 # Prints one JSON line; a missing surface is a non-zero exit.
 smoke-observability:
 	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_observability()"

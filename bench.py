@@ -19,17 +19,11 @@ import time
 
 
 def _tpu_peak_tflops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    # Public per-chip bf16 peaks (workloads/config cites them too).
-    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    if "v4" in kind:
-        return 275e12
-    return 197e12
+    # ONE chip-peak table for bench MFU and telemetry MFU (train.py owns it);
+    # two copies would let the numbers silently disagree for the same run.
+    from dstack_tpu.workloads.train import _device_peak_flops
+
+    return _device_peak_flops(device)
 
 
 def _run_train_variant(
@@ -130,10 +124,45 @@ def _run_train_variant(
         "grad_accum": grad_accum,
         "prefetch": prefetch,
         "batch": batch,
+        # Goodput % for this bounded run, through the SAME ledger the server
+        # derives from workload telemetry (services/metrics.py): productive
+        # step time over wall clock with the compile stall debited. This is
+        # the baseline ROADMAP item 3's preemption benches regress against.
+        "goodput_pct": _variant_goodput_pct(compile_s, times),
     }
     if cfg_overrides:
         out.update({k: v for k, v in cfg_overrides.items()})
     return out
+
+
+def _variant_goodput_pct(compile_s: float, step_times: list) -> float:
+    """Feed a variant's measured timings through the server's goodput ledger
+    (synthesized telemetry points with real offsets), so the bench number and
+    the /metrics number can never drift apart in definition."""
+    import datetime
+
+    from dstack_tpu.server.services.metrics import compute_goodput
+    from dstack_tpu.utils.common import now_utc, to_iso
+
+    base = now_utc()
+
+    def iso(off: float) -> str:
+        return to_iso(base + datetime.timedelta(seconds=off))
+
+    points = [
+        {"ts": iso(0.0), "kind": "mark", "event": "run_start"},
+        {"ts": iso(0.0), "kind": "mark", "event": "compile_start"},
+        {"ts": iso(compile_s), "kind": "mark", "event": "compile_end",
+         "compile_s": compile_s},
+    ]
+    off = compile_s
+    for i, dt in enumerate(step_times):
+        off += dt
+        points.append(
+            {"ts": iso(off), "kind": "step", "step": i + 2, "step_time_s": dt}
+        )
+    ledger = compute_goodput(points)
+    return round((ledger["ratio"] or 0.0) * 100, 2)
 
 
 def _variant_plan(batch: int) -> list:
@@ -251,6 +280,7 @@ def bench_tpu_train() -> dict:
             "batch": best["batch"],
             "seq": seq,
             "best_variant": best_name,
+            "goodput_pct": best.get("goodput_pct"),
             # Per-variant compile time + step-time distribution: the MFU
             # trajectory now attributes WHERE a win came from.
             "variants": variants,
@@ -308,6 +338,7 @@ def bench_train_pipeline() -> dict:
         "extra": {
             "steps": steps,
             "best_variant": best,
+            "goodput_pct": variants[best].get("goodput_pct"),
             "tok_per_sec": {k: round(v, 1) for k, v in rate.items()},
             "variants": variants,
         },
@@ -583,7 +614,11 @@ def bench_proxy() -> dict:
 def smoke_observability() -> dict:
     """`make smoke-observability`: boot the server in-process, drive one run
     through the full FSM, and assert the events timeline + /metrics histogram
-    families are live. Raises (non-zero exit) on any missing piece."""
+    families are live. Then drive a REAL train workload through the native
+    runner agent (local backend) and assert its telemetry lands: workload
+    points in the DB, run families on /metrics, workload columns in
+    `dstack-tpu metrics` output, and a goodput ledger that accounts for the
+    compile stall. Raises (non-zero exit) on any missing piece."""
     import asyncio
 
     from dstack_tpu.core import tracing
@@ -594,6 +629,7 @@ def smoke_observability() -> dict:
 
     async def run() -> dict:
         FakeRunnerClient.reset()
+        real_runner_client = tasks.get_runner_client
         tasks.get_runner_client = FakeRunnerClient.for_jpd
         async with api_server() as api:
             await setup_mock_backend(api)
@@ -625,6 +661,8 @@ def smoke_observability() -> dict:
             ):
                 assert f"{family}_bucket{{" in text, f"{family} has no samples"
                 assert f"{family}_count" in text, family
+            tasks.get_runner_client = real_runner_client
+            workload = await _smoke_workload_telemetry(api)
             return {
                 "metric": "smoke_observability",
                 "value": len(data["events"]),
@@ -632,11 +670,138 @@ def smoke_observability() -> dict:
                 "phases_ms": {
                     k: round(v * 1000, 1) for k, v in phases.items() if v is not None
                 },
+                "workload": workload,
             }
 
     result = asyncio.run(run())
     print(json.dumps(result))
     return result
+
+
+async def _smoke_workload_telemetry(api) -> dict:
+    """The workload-telemetry leg of smoke_observability: a real train run on
+    the native C++ agent (local backend), sampled live by the metrics loop."""
+    import asyncio
+    import os
+
+    import dstack_tpu
+    from dstack_tpu.server.background import tasks
+    from dstack_tpu.server.services import metrics as metrics_service
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(dstack_tpu.__file__)))
+    spec = {
+        "run_spec": {
+            "run_name": "smoke-train",
+            "configuration": {
+                "type": "task",
+                "commands": [
+                    # Enough steps that live collection passes observe the
+                    # stepping phase (the run gauges render for RUNNING jobs).
+                    "python3 -m dstack_tpu.workloads.train"
+                    " --config test --steps 400 --batch 2 --seq 64"
+                ],
+                "env": {
+                    "PYTHONPATH": repo_root,
+                    "JAX_PLATFORMS": "cpu",
+                    "DSTACK_TPU_OVERLAP_FLAGS": "0",
+                },
+            },
+        }
+    }
+    await api.post("/api/project/main/runs/submit", spec)
+    # Collect BEFORE the scheduler passes each round: on the round where the
+    # job process exits, the job row still says running, so the tail picks up
+    # the emitter's final flush before pull flips the status.
+    deadline = asyncio.get_event_loop().time() + 180
+    status = None
+    live_metrics_text = ""
+    while asyncio.get_event_loop().time() < deadline:
+        await metrics_service.collect_job_metrics(api.db)
+        await tasks.process_submitted_jobs(api.db)
+        await tasks.process_running_jobs(api.db)
+        await tasks.process_terminating_jobs(api.db)
+        await tasks.process_runs(api.db)
+        await tasks.process_instances(api.db)
+        run = await api.post("/api/project/main/runs/get", {"run_name": "smoke-train"})
+        status = run["status"]
+        if status == "running" and not live_metrics_text:
+            got = await api.db.fetchone(
+                "SELECT COUNT(*) AS n FROM workload_metrics_points WHERE kind = 'step'"
+            )
+            if got["n"] > 0:
+                resp = await api.client.get("/metrics")
+                live_metrics_text = await resp.text()
+        if status in ("done", "failed", "terminated"):
+            break
+        await asyncio.sleep(0.3)
+    assert status == "done", f"real train run ended {status}"
+
+    n = await api.db.fetchone(
+        "SELECT COUNT(*) AS n FROM workload_metrics_points"
+    )
+    assert n["n"] > 0, "no workload telemetry reached the server"
+    wl = await api.post(
+        "/api/project/main/runs/get_metrics", {"run_name": "smoke-train"}
+    )
+    assert wl["latest"] is not None, f"no step points: {wl}"
+    assert wl["latest"]["tokens_per_sec"] > 0, wl["latest"]
+    ledger = wl["goodput"]
+    assert ledger["ratio"] is not None and ledger["compile_s"] > 0, ledger
+
+    # The per-run gauges render while the job RUNS (the hardware-gauge
+    # contract) — asserted against the exposition scraped mid-run; the step
+    # histogram is fed at ingestion and survives the run's completion.
+    assert live_metrics_text, "no /metrics scrape landed while the run was live"
+    for family in ("dstack_tpu_run_tokens_per_sec", "dstack_tpu_run_goodput_ratio"):
+        assert f'{family}{{run="smoke-train"}}' in live_metrics_text, (
+            f"{family} missing from the live /metrics scrape"
+        )
+    resp = await api.client.get("/metrics")
+    text = await resp.text()
+    assert 'dstack_tpu_run_step_seconds_bucket{le="0.005",run="smoke-train"}' in text
+
+    # The CLI surface: `dstack-tpu metrics smoke-train` (sync requests client
+    # against the in-process server — run it off the event loop).
+    cli_out = await _render_cli_metrics(api, "smoke-train")
+    for column in ("STEP", "TOK/S", "MFU", "goodput:"):
+        assert column in cli_out, f"CLI workload column {column!r} missing:\n{cli_out}"
+    return {
+        "steps_reported": ledger["steps"],
+        "goodput_pct": round(ledger["ratio"] * 100, 2),
+        "compile_s": ledger["compile_s"],
+        "tokens_per_sec": wl["latest"]["tokens_per_sec"],
+    }
+
+
+async def _render_cli_metrics(api, run_name: str) -> str:
+    """Run `dstack-tpu metrics <run>` against the in-process test server and
+    return its stdout (executor thread: the requests client is synchronous)."""
+    import argparse
+    import asyncio
+    import contextlib
+    import io
+
+    from dstack_tpu.api.client import Client
+    from dstack_tpu.cli import main as cli_main
+
+    url = str(api.client.make_url("")).rstrip("/")
+    client = Client(url, api.token, project="main")
+    args = argparse.Namespace(
+        run_name=run_name, replica=0, job=0, limit=20, watch=False, interval=5.0
+    )
+
+    def _run() -> str:
+        old_client = cli_main._client
+        cli_main._client = lambda: client
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                cli_main.cmd_metrics(args)
+            return buf.getvalue()
+        finally:
+            cli_main._client = old_client
+
+    return await asyncio.get_event_loop().run_in_executor(None, _run)
 
 
 def _serve_bench_config():

@@ -137,6 +137,22 @@ class RunsApi:
         {"run_name", "status", "events": [...], "phases": {...}}."""
         return self._c.post(self._c._p("/runs/get_events"), {"run_name": run_name})
 
+    def get_metrics(self, run_name: str, limit: int = 50) -> dict:
+        """Workload telemetry: {"run_name", "status", "goodput": {...ledger},
+        "latest": step point | None, "engine": gauges | None,
+        "profile": latest profile mark | None, "points": [step points]}."""
+        return self._c.post(
+            self._c._p("/runs/get_metrics"), {"run_name": run_name, "limit": limit}
+        )
+
+    def profile(self, run_name: str, seconds: float = 5.0) -> dict:
+        """Trigger an on-demand profiler capture in the run's live workload;
+        returns the agent ack ({"id", "artifact_dir", ...}). Completion shows
+        up as a profile_end mark in get_metrics()["profile"]."""
+        return self._c.post(
+            self._c._p("/runs/profile"), {"run_name": run_name, "seconds": seconds}
+        )
+
     def stop(self, run_names: List[str], abort: bool = False) -> None:
         self._c.post(self._c._p("/runs/stop"), {"runs_names": run_names, "abort": abort})
 

@@ -387,15 +387,58 @@ def cmd_logs(args) -> None:
     sys.stdout.flush()
 
 
+def _fmt_goodput(ledger: dict) -> str:
+    """One-line goodput attribution: `93.1% (compile 12s input 3s restart 0s)`."""
+    if not ledger or ledger.get("ratio") is None:
+        return "-"
+    parts = []
+    for key, label in (("compile_s", "compile"), ("input_wait_s", "input"),
+                       ("restart_s", "restart"), ("other_s", "other")):
+        v = ledger.get(key) or 0.0
+        if v >= 0.05:
+            parts.append(f"{label} {_fmt_secs(v)}")
+    detail = f" ({', '.join(parts)})" if parts else ""
+    return f"{ledger['ratio'] * 100:.1f}%{detail}"
+
+
+def _workload_rows(points: list) -> list:
+    from dstack_tpu.utils.common import from_iso
+
+    rows = []
+    for p in points:
+        try:
+            t = from_iso(p["ts"]).strftime("%H:%M:%S")
+        except (KeyError, ValueError):
+            t = "-"
+        mfu = p.get("mfu")
+        rows.append(
+            [
+                t,
+                str(p.get("step", "-")),
+                _fmt_secs(p.get("step_time_s")),
+                f"{p['tokens_per_sec']:,.0f}" if p.get("tokens_per_sec") is not None else "-",
+                f"{mfu * 100:.1f}%" if mfu is not None else "-",
+                f"{p['loss']:.4f}" if p.get("loss") is not None else "-",
+                _fmt_secs(p.get("input_wait_s")) if p.get("input_wait_s") else "-",
+            ]
+        )
+    return rows
+
+
 def cmd_metrics(args) -> None:
     client = _client()
     def render() -> None:
         m = client.metrics.get_job(
             args.run_name, replica_num=args.replica, job_num=args.job, limit=args.limit
         )
-        if not m.points and not args.watch:
-            print("no metrics collected yet (the job may have just started)")
-            return
+        try:
+            wl = client.runs.get_metrics(args.run_name, limit=args.limit)
+        except Exception:
+            wl = None  # an old server without the workload channel
+        if not m.points and not (wl and (wl.get("points") or wl.get("engine"))):
+            if not args.watch:
+                print("no metrics collected yet (the job may have just started)")
+                return
         rows = []
         for p in m.points:
             rows.append(
@@ -412,8 +455,87 @@ def cmd_metrics(args) -> None:
         if args.watch:
             _clear_screen()
         print(_table(["TIME", "CPU", "MEM", "TPU DUTY", "HBM"], rows), flush=True)
+        if wl is None:
+            return
+        # Workload telemetry (emitted by the job itself): per-step series,
+        # engine gauges for services, and the goodput ledger.
+        points = wl.get("points") or []
+        if points:
+            print()
+            print(
+                _table(
+                    ["TIME", "STEP", "STEP TIME", "TOK/S", "MFU", "LOSS", "INPUT WAIT"],
+                    _workload_rows(points[-args.limit:]),
+                ),
+                flush=True,
+            )
+        engine = wl.get("engine")
+        if engine:
+            print()
+            print(
+                _table(
+                    ["QUEUE", "ACTIVE", "TOKENS", "PREEMPT", "PREFIX HIT", "SPEC ACCEPT"],
+                    [[
+                        str(engine.get("queue_depth", "-")),
+                        str(engine.get("active", "-")),
+                        str(engine.get("generated_tokens", "-")),
+                        str(engine.get("preemptions", "-")),
+                        f"{engine['prefix_hit_rate']:.2f}" if engine.get("prefix_hit_rate") is not None else "-",
+                        f"{engine['spec_accept_rate']:.2f}" if engine.get("spec_accept_rate") is not None else "-",
+                    ]],
+                ),
+                flush=True,
+            )
+        if points or engine:
+            print(f"\ngoodput: {_fmt_goodput(wl.get('goodput'))}", flush=True)
+            if wl.get("dropped"):
+                print(f"(emitter dropped {wl['dropped']} points)", flush=True)
 
     _watch_loop(render, args.watch, args.interval)
+
+
+def cmd_profile(args) -> None:
+    """Trigger jax.profiler trace capture inside a run's live workload and
+    wait for the artifact (`dstack-tpu profile RUN --seconds N`)."""
+    import time as time_lib
+
+    client = _client()
+    # Snapshot the latest profile mark BEFORE requesting: agent profile ids
+    # restart with the agent process, so an id match alone could hit a STALE
+    # profile_end from a capture that predates this request.
+    try:
+        before = (client.runs.get_metrics(args.run_name) or {}).get("profile")
+    except Exception:
+        before = None
+    ack = client.runs.profile(args.run_name, seconds=args.seconds)
+    print(
+        f"profile requested (id {ack.get('id')}): capturing {args.seconds:g}s"
+        f" on job {ack.get('job_num')}/{ack.get('replica_num')}"
+    )
+    print(f"artifact dir (on the runner host): {ack.get('artifact_dir')}")
+    if args.no_wait:
+        return
+    # The capture completes asynchronously: the workload's profile_end mark
+    # flows back through the agent's next metrics samples.
+    deadline = time_lib.monotonic() + args.seconds + args.timeout
+    want_id = ack.get("id")
+    while time_lib.monotonic() < deadline:
+        time_lib.sleep(2.0)
+        mark = (client.runs.get_metrics(args.run_name) or {}).get("profile")
+        if not mark or mark == before:
+            continue  # nothing new since the request
+        if want_id is not None and mark.get("profile_id") != want_id:
+            continue
+        if mark.get("event") == "profile_end":
+            print(f"trace captured: {mark.get('artifact')}")
+            return
+        if mark.get("event") == "profile_error":
+            raise DstackTpuError(f"profiler failed in the workload: {mark.get('error')}")
+    raise DstackTpuError(
+        "timed out waiting for the profile_end mark (the capture may still"
+        f" finish; re-check `dstack-tpu metrics {args.run_name}` later —"
+        f" the artifact would land in {ack.get('artifact_dir')})"
+    )
 
 
 def _fmt_secs(seconds) -> str:
@@ -496,7 +618,7 @@ def cmd_offer(args) -> None:
 
 _SUBCOMMANDS = (
     "server config init apply attach metrics events ps stop delete logs offer fleet"
-    " gateway volume secret backend instance project stats completion"
+    " gateway volume secret backend instance project profile stats completion"
 )
 
 
@@ -661,7 +783,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(func=cmd_attach)
 
     for alias in ("metrics", "stats"):
-        s = sub.add_parser(alias, help="show a run's resource metrics")
+        s = sub.add_parser(
+            alias,
+            help="show a run's resource + workload metrics (step time, tok/s,"
+                 " MFU, loss, engine gauges, goodput)",
+        )
         s.add_argument("run_name")
         s.add_argument("--replica", type=int, default=0)
         s.add_argument("--job", type=int, default=0)
@@ -669,6 +795,21 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("-w", "--watch", action="store_true", help="refresh continuously")
         s.add_argument("--interval", type=float, default=5.0)
         s.set_defaults(func=cmd_metrics)
+
+    s = sub.add_parser(
+        "profile",
+        help="capture a jax.profiler trace inside a run's live workload",
+    )
+    s.add_argument("run_name")
+    s.add_argument("--seconds", type=float, default=5.0,
+                   help="trace capture duration")
+    s.add_argument("--no-wait", action="store_true", dest="no_wait",
+                   help="request the capture and return immediately")
+    s.add_argument("--timeout", type=float, default=180.0,
+                   help="extra seconds to wait for the artifact after the"
+                        " capture window closes (trace start/stop can lag"
+                        " tens of seconds on a loaded host)")
+    s.set_defaults(func=cmd_profile)
 
     s = sub.add_parser("ps", help="list runs")
     s.add_argument("-a", "--all", action="store_true")

@@ -243,6 +243,27 @@ MIGRATIONS: List[Tuple[int, str]] = [
         CREATE INDEX ix_run_events_job ON run_events(job_id);
         """,
     ),
+    (
+        4,
+        # Workload telemetry (train/serve emitters -> agent sidecar tail ->
+        # collect_job_metrics). `kind` is the point discriminator
+        # (step/engine/mark/emitter); the full point stays as JSON in `data` —
+        # the schema evolves workload-side without migrations. The jobs cursor
+        # column fixes collection starvation: ordering by a metrics-OWNED
+        # timestamp (advanced every pass) rotates through >MAX_JOBS_PER_PASS
+        # running jobs instead of resampling the same 100 forever.
+        """
+        CREATE TABLE workload_metrics_points (
+            job_id TEXT NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+            timestamp TEXT NOT NULL,
+            kind TEXT NOT NULL,
+            data TEXT NOT NULL
+        );
+        CREATE INDEX ix_workload_metrics_points_job
+            ON workload_metrics_points(job_id, timestamp);
+        ALTER TABLE jobs ADD COLUMN metrics_sampled_at TEXT;
+        """,
+    ),
 ]
 
 

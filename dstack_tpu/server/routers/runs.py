@@ -161,6 +161,49 @@ async def get_run_events(request: web.Request) -> web.Response:
     )
 
 
+@routes.post("/api/project/{project_name}/runs/get_metrics")
+async def get_run_metrics(request: web.Request) -> web.Response:
+    """Workload telemetry for a run: latest step point (step time / tok/s /
+    MFU / loss), serving-engine gauges, recent step series, and the goodput
+    ledger — the API behind `dstack-tpu metrics <run>`'s workload columns."""
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    db = request.app["db"]
+    from dstack_tpu.core.errors import ResourceNotExistsError
+    from dstack_tpu.server.services import metrics as metrics_service
+
+    run_name = body.get("run_name")
+    row = await db.fetchone(
+        "SELECT id, run_name, status FROM runs WHERE project_id = ? AND run_name = ?"
+        " AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"run {run_name} not found")
+    result = await metrics_service.get_run_workload_metrics(
+        db, row["id"], limit=int(body.get("limit") or 50)
+    )
+    return web.json_response(
+        {"run_name": row["run_name"], "status": row["status"], **result}
+    )
+
+
+@routes.post("/api/project/{project_name}/runs/profile")
+async def profile_run(request: web.Request) -> web.Response:
+    """Trigger an on-demand profiler capture in a run's live workload
+    (server -> agent control file -> jax.profiler in-process). Returns the
+    agent's ack; the `profile_end` mark in get_metrics carries the artifact."""
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    from dstack_tpu.server.services import metrics as metrics_service
+
+    seconds = float(body.get("seconds") or 5.0)
+    result = await metrics_service.request_profile(
+        request.app["db"], project_row, body.get("run_name"), seconds
+    )
+    return web.json_response(result)
+
+
 @routes.post("/api/project/{project_name}/runs/stop")
 async def stop_runs(request: web.Request) -> web.Response:
     _, project_row = await auth_project(request)

@@ -1,4 +1,5 @@
-"""Job metrics: collection from runner agents, query API, TTL sweep.
+"""Job + workload metrics: collection from runner agents, goodput accounting,
+query API, on-demand profiler fan-out, TTL sweep.
 
 Parity: reference server/services/metrics.py (get_job_metrics derives
 cpu_usage_percent from consecutive cpu_usage_micro samples) +
@@ -6,6 +7,14 @@ background/tasks/process_metrics.py (collect/delete loops). TPU re-design: the
 ``tpu`` column stores the agent's TPU sample (duty-cycle %, HBM bytes — scraped
 from the runtime metrics endpoint by the C++ agent, runner/src/executor.cpp) in
 place of the reference's per-GPU DCGM rows.
+
+Beyond the reference: the agent's sample also carries ``workload`` — telemetry
+points the job's own emitter (workloads/telemetry.py) appended to a sidecar
+file the agent tails. Those land in ``workload_metrics_points`` and power the
+run-level surfaces: per-step throughput/MFU/loss, serving-engine gauges, and
+the **goodput ledger** — productive step time over wall clock, with the
+non-productive remainder attributed to compile, input wait, and restarts
+(the headline metric for ROADMAP item 3's preemption work).
 """
 
 from __future__ import annotations
@@ -14,30 +23,50 @@ import asyncio
 import datetime
 import json
 import logging
-from typing import Optional
+from typing import Dict, List, Optional
 
+from dstack_tpu.core import tracing
+from dstack_tpu.core.errors import ResourceNotExistsError, ServerClientError
 from dstack_tpu.core.models.metrics import JobMetrics, MetricPoint
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database
 from dstack_tpu.server.services.jobs import job_jpd, job_jrd
-from dstack_tpu.server.services.runner.client import get_runner_client
+from dstack_tpu.server.services.runner.client import RunnerError, get_runner_client
 from dstack_tpu.utils.common import from_iso, now_utc, to_iso
 
 logger = logging.getLogger(__name__)
 
 MAX_JOBS_PER_PASS = 100
 COLLECT_CONCURRENCY = 10
+# Histogram family fed at ingestion time from workload step points (rendered
+# by services/prometheus.py; per-run series dropped on run delete).
+STEP_HISTOGRAM = "dstack_tpu_run_step_seconds"
 
 
 async def collect_job_metrics(db: Database) -> int:
-    """One collection pass: sample every running job's agent. Returns #points."""
+    """One collection pass: sample running jobs' agents. Returns #jobs sampled.
+
+    Rotation: jobs are picked oldest-``metrics_sampled_at`` first and the
+    cursor advances for every job PICKED (reachable or not) before sampling.
+    Ordering by the scheduler's ``last_processed_at`` — which this loop never
+    advanced — meant that with more than MAX_JOBS_PER_PASS running jobs the
+    same subset was sampled every pass and the rest starved forever; a
+    metrics-owned cursor makes each pass sample the least-recently-sampled
+    slice of the fleet."""
     rows = await db.fetchall(
         "SELECT * FROM jobs WHERE status = 'running'"
-        " ORDER BY last_processed_at ASC LIMIT ?",
+        " ORDER BY COALESCE(metrics_sampled_at, '') ASC LIMIT ?",
         (MAX_JOBS_PER_PASS,),
     )
     if not rows:
         return 0
+    # Advance the cursor up front: an unreachable agent must rotate to the
+    # back of the line like everyone else, not wedge its position.
+    now_iso = to_iso(now_utc())
+    await db.executemany(
+        "UPDATE jobs SET metrics_sampled_at = ? WHERE id = ?",
+        [(now_iso, r["id"]) for r in rows],
+    )
     sem = asyncio.Semaphore(COLLECT_CONCURRENCY)
 
     async def _one(row) -> int:
@@ -68,10 +97,227 @@ async def collect_job_metrics(db: Database) -> int:
                     json.dumps(tpu) if tpu else None,
                 ),
             )
+            await store_workload_points(db, row, sample.get("workload"))
             return 1
 
     results = await asyncio.gather(*(_one(r) for r in rows))
     return sum(results)
+
+
+async def store_workload_points(db: Database, job_row, points) -> int:
+    """Persist one agent sample's workload telemetry batch; step points also
+    feed the run step-time histogram at write time (the run_events idiom —
+    /metrics renders distributions without a query per scrape)."""
+    if not points:
+        return 0
+    now_iso = to_iso(now_utc())
+    rows = []
+    for p in points:
+        if not isinstance(p, dict):
+            continue
+        kind = p.get("kind")
+        if not isinstance(kind, str) or not kind:
+            continue
+        ts = p.get("ts")
+        if not isinstance(ts, str) or not ts:
+            ts = now_iso
+        rows.append((job_row["id"], ts, kind, json.dumps(p)))
+        # Lead lineage only: a gang's N hosts ship N identical step streams,
+        # and observing all of them would N-fold the run's histogram counts.
+        if kind == "step" and job_row["job_num"] == 0 and job_row["replica_num"] == 0:
+            try:
+                tracing.observe(
+                    STEP_HISTOGRAM,
+                    float(p.get("step_time_s") or 0.0),
+                    {"run": job_row["run_name"]},
+                )
+            except (TypeError, ValueError):
+                pass
+    if rows:
+        await db.executemany(
+            "INSERT INTO workload_metrics_points (job_id, timestamp, kind, data)"
+            " VALUES (?, ?, ?, ?)",
+            rows,
+        )
+    return len(rows)
+
+
+# ---------------------------------------------------------------------------
+# Goodput ledger
+
+
+def compute_goodput(points: List[dict]) -> Dict[str, Optional[float]]:
+    """The goodput ledger over one job-lineage's telemetry points.
+
+    ``ratio`` = productive step time / wall clock, where wall is the span from
+    the first to the last point. The non-productive remainder is attributed:
+
+    * ``compile_s``    — time inside compile_start→compile_end marks (the
+      compile_end's own measured ``compile_s`` wins when present, because the
+      bracketing marks include the first step's execution).
+    * ``input_wait_s`` — the step points' reported time blocked on the input
+      pipeline (counted OUT of productive: a step stalled on data is not
+      productive hardware time).
+    * ``restart_s``    — downtime between the last point of one process and
+      the next process's ``run_start``/``restart`` mark (preemption →
+      reschedule → re-init shows up exactly here).
+    * ``other_s``      — whatever remains (checkpoint stalls, eval pauses,
+      emitter gaps).
+
+    Returns ratio=None when there is no wall clock to divide by (fewer than
+    two points) or no step points at all (e.g. a serving engine)."""
+    zeros = {
+        "ratio": None, "wall_s": 0.0, "productive_s": 0.0, "compile_s": 0.0,
+        "input_wait_s": 0.0, "restart_s": 0.0, "other_s": 0.0, "steps": 0,
+    }
+    parsed = []
+    for p in points:
+        try:
+            parsed.append((from_iso(p["ts"]), p))
+        except (KeyError, TypeError, ValueError):
+            continue
+    if not parsed:
+        return zeros
+    parsed.sort(key=lambda tp: tp[0])
+    first_ts, last_ts = parsed[0][0], parsed[-1][0]
+    wall = (last_ts - first_ts).total_seconds()
+
+    productive = input_wait = compile_s = restart = 0.0
+    steps = 0
+    compile_open: Optional[datetime.datetime] = None
+    prev_ts: Optional[datetime.datetime] = None
+    for t, p in parsed:
+        kind = p.get("kind")
+        if kind == "step":
+            try:
+                productive += float(p.get("step_time_s") or 0.0)
+                input_wait += float(p.get("input_wait_s") or 0.0)
+            except (TypeError, ValueError):
+                continue
+            steps += 1
+        elif kind == "mark":
+            event = p.get("event")
+            if event == "compile_start":
+                compile_open = t
+            elif event == "compile_end":
+                try:
+                    measured = float(p.get("compile_s"))
+                except (TypeError, ValueError):
+                    measured = None
+                if measured is not None:
+                    compile_s += measured
+                elif compile_open is not None:
+                    compile_s += (t - compile_open).total_seconds()
+                compile_open = None
+            elif event in ("run_start", "restart") and prev_ts is not None:
+                restart += max(0.0, (t - prev_ts).total_seconds())
+        prev_ts = t
+    if compile_open is not None:  # still compiling at the window's edge
+        compile_s += (last_ts - compile_open).total_seconds()
+
+    productive = max(0.0, productive - input_wait)
+    attributed = productive + compile_s + input_wait + restart
+    out = {
+        "wall_s": round(wall, 4),
+        "productive_s": round(productive, 4),
+        "compile_s": round(compile_s, 4),
+        "input_wait_s": round(input_wait, 4),
+        "restart_s": round(restart, 4),
+        "other_s": round(max(0.0, wall - attributed), 4),
+        "steps": steps,
+        "ratio": None,
+    }
+    if wall > 0 and steps > 0:
+        out["ratio"] = round(min(1.0, productive / wall), 4)
+    return out
+
+
+async def get_run_workload_metrics(
+    db: Database, run_id: str, limit: int = 50
+) -> Dict:
+    """Run-level workload telemetry: latest step/engine points, recent step
+    series, and the goodput ledger. The ledger and step series come from the
+    run's LEAD lineage (job_num 0, replica 0, every submission — so restarts
+    show up as restart_s) to avoid summing a gang's N identical hosts; the
+    engine point is the freshest across all replicas."""
+    rows = await db.fetchall(
+        "SELECT w.timestamp, w.kind, w.data, j.job_num, j.replica_num"
+        " FROM workload_metrics_points w JOIN jobs j ON j.id = w.job_id"
+        " WHERE j.run_id = ? ORDER BY w.timestamp ASC",
+        (run_id,),
+    )
+    lead_points: List[dict] = []
+    latest_engine: Optional[dict] = None
+    latest_profile: Optional[dict] = None
+    dropped = 0
+    for r in rows:
+        try:
+            point = json.loads(r["data"])
+        except ValueError:
+            continue
+        kind = r["kind"]
+        if kind == "engine":
+            latest_engine = point
+        if kind == "mark" and str(point.get("event", "")).startswith("profile"):
+            latest_profile = point
+        if kind == "emitter":
+            try:
+                dropped = max(dropped, int(point.get("dropped") or 0))
+            except (TypeError, ValueError):
+                pass
+        if r["job_num"] == 0 and r["replica_num"] == 0:
+            lead_points.append(point)
+    step_points = [p for p in lead_points if p.get("kind") == "step"]
+    return {
+        "goodput": compute_goodput(lead_points),
+        "latest": step_points[-1] if step_points else None,
+        "engine": latest_engine,
+        "profile": latest_profile,
+        "dropped": dropped,
+        "points": step_points[-max(0, min(limit, 1000)):],
+    }
+
+
+async def request_profile(
+    db: Database, project_row, run_name: str, seconds: float
+) -> Dict:
+    """`dstack-tpu profile <run>`: fan the capture request out to the run's
+    lead running job's agent, which publishes it to the live workload via the
+    telemetry control file. Returns the agent's ack (artifact dir + request
+    id); completion is observable as a ``profile_end`` mark in the run's
+    workload metrics."""
+    run_row = await db.fetchone(
+        "SELECT id, run_name FROM runs WHERE project_id = ? AND run_name = ?"
+        " AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise ResourceNotExistsError(f"run {run_name} not found")
+    job_row = await db.fetchone(
+        "SELECT * FROM jobs WHERE run_id = ? AND status = 'running'"
+        " ORDER BY replica_num ASC, job_num ASC, submission_num DESC LIMIT 1",
+        (run_row["id"],),
+    )
+    if job_row is None:
+        raise ServerClientError(f"run {run_name} has no running job to profile")
+    jpd = job_jpd(job_row)
+    if jpd is None or jpd.hostname is None:
+        raise ServerClientError(f"run {run_name}'s job is not reachable yet")
+    client = get_runner_client(jpd, job_jrd(job_row))
+    try:
+        ack = await client.profile(seconds)
+    except RunnerError as e:
+        raise ServerClientError(f"profiler request failed: {e}") from e
+    return {
+        "run_name": run_row["run_name"],
+        "job_num": job_row["job_num"],
+        "replica_num": job_row["replica_num"],
+        **(ack or {}),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Utilization policy enforcement
 
 
 async def enforce_utilization_policies(db: Database) -> None:
@@ -79,7 +325,11 @@ async def enforce_utilization_policies(db: Database) -> None:
     the whole window (reference process_running_jobs.py:764 _check_gpu_utilization —
     GPU util there, TPU duty-cycle here). A gang dies whole, so enforcement is
     run-level: any breaching job marks the run terminating; process_runs tears it
-    down. Decided from job_metrics_points so it composes with the collection loop."""
+    down. Decided from job_metrics_points so it composes with the collection loop.
+
+    One grouped window query covers every candidate job (the PR 1/PR 3 IN-clause
+    idiom) — the per-job fetch this replaces issued N queries per pass and
+    scaled linearly with fleet size."""
     from dstack_tpu.core.models.runs import RunTerminationReason
     from dstack_tpu.server.services.jobs import job_spec as load_job_spec
 
@@ -88,26 +338,44 @@ async def enforce_utilization_policies(db: Database) -> None:
         " WHERE j.status = 'running' AND r.status NOT IN"
         " ('terminating', 'terminated', 'failed', 'done')"
     )
-    breached_runs = {}
+    candidates = []  # every policy-bearing running job (any breaching job kills its run)
+    max_window = 0
     for row in rows:
         spec = load_job_spec(row)
         policy = spec.utilization_policy
-        if policy is None or row["run_id"] in breached_runs:
+        if policy is None:
             continue
-        window_start = to_iso(
-            now_utc() - datetime.timedelta(seconds=policy.time_window)
-        )
-        points = await db.fetchall(
-            "SELECT * FROM job_metrics_points WHERE job_id = ? AND timestamp >= ?"
-            " ORDER BY timestamp",
-            (row["id"], window_start),
-        )
+        candidates.append((row, policy))
+        max_window = max(max_window, policy.time_window)
+    if not candidates:
+        return
+    now = now_utc()
+    window_start = to_iso(now - datetime.timedelta(seconds=max_window))
+    point_rows = await db.fetch_in(
+        "SELECT job_id, timestamp, tpu FROM job_metrics_points"
+        " WHERE timestamp >= ? AND job_id IN ({in})"
+        " ORDER BY timestamp",
+        [row["id"] for row, _ in candidates],
+        (window_start,),
+    )
+    by_job: Dict[str, List] = {}
+    for p in point_rows:
+        by_job.setdefault(p["job_id"], []).append(p)
+
+    breached_runs = {}
+    for row, policy in candidates:
+        if row["run_id"] in breached_runs:
+            continue
+        job_window_start = to_iso(now - datetime.timedelta(seconds=policy.time_window))
+        points = [
+            p for p in by_job.get(row["id"], []) if p["timestamp"] >= job_window_start
+        ]
         if not points:
             continue
         # The whole window must be covered by samples AND below threshold; a job
         # that just started is not killable yet.
         first_ts = from_iso(points[0]["timestamp"])
-        if (now_utc() - first_ts).total_seconds() < policy.time_window * 0.9:
+        if (now - first_ts).total_seconds() < policy.time_window * 0.9:
             continue
         duties = []
         for p in points:
@@ -133,9 +401,11 @@ async def enforce_utilization_policies(db: Database) -> None:
 
 async def sweep_metrics(db: Database) -> None:
     """TTL delete (reference keeps separate running/finished TTLs; one TTL here —
-    finished jobs' points age out the same way)."""
+    finished jobs' points age out the same way). Workload telemetry shares the
+    TTL: the goodput window IS the retention window."""
     cutoff = to_iso(now_utc() - datetime.timedelta(seconds=settings.METRICS_TTL_SECONDS))
     await db.execute("DELETE FROM job_metrics_points WHERE timestamp < ?", (cutoff,))
+    await db.execute("DELETE FROM workload_metrics_points WHERE timestamp < ?", (cutoff,))
 
 
 async def get_job_metrics(

@@ -18,10 +18,21 @@ in.
 from __future__ import annotations
 
 import json
+import os
+import time
+import weakref
 from typing import Dict, List, Tuple
 
 from dstack_tpu.core import tracing
 from dstack_tpu.server.db import Database
+
+# The workload gauges (mfu / tokens_per_sec / goodput ledger) re-derive from
+# the full TTL window of step+mark points; a short per-Database cache keeps a
+# tight scrape interval from recomputing N runs' ledgers on the event loop
+# every 15 s. Collection itself runs every PROCESS_METRICS_INTERVAL (10 s),
+# so a 5 s cache loses no freshness that exists to lose.
+_WORKLOAD_GAUGE_CACHE_TTL = float(os.getenv("DSTACK_TPU_WORKLOAD_GAUGE_CACHE_TTL", "5"))
+_workload_gauge_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _esc(v: str) -> str:
@@ -59,6 +70,7 @@ _HISTOGRAM_HELP = {
     "dstack_tpu_offer_query_seconds": "Offer fan-in query time across project backends",
     "dstack_tpu_backend_create_slice_seconds": "Cloud slice provisioning call time",
     "dstack_tpu_ssh_tunnel_open_seconds": "SSH tunnel establishment time",
+    "dstack_tpu_run_step_seconds": "Workload-reported training step wall time by run",
 }
 
 
@@ -161,6 +173,75 @@ async def render_metrics(db: Database) -> str:
     )
     sections.append(
         _fmt("dstack_tpu_job_tpu_hbm_usage_bytes", "TPU HBM in use", "gauge", hbm)
+    )
+
+    # Workload telemetry (workload_metrics_points via the agents' sidecar
+    # tails): per-running-run latest step gauges + the goodput ledger ratio.
+    # The lead lineage (job 0 / replica 0) represents the run — a gang's N
+    # hosts emit N copies of the same step stream (see services/metrics.py
+    # get_run_workload_metrics). The run must be live (some job running) but
+    # the points span EVERY lead submission: a preemption's prior lineage and
+    # the restart gap are exactly what the goodput gauge exists to show.
+    # Only step/mark kinds feed these families — engine/emitter rows are
+    # skipped at the SQL layer (they can dominate a serving run's window).
+    cached = _workload_gauge_cache.get(db)
+    if cached is not None and time.monotonic() - cached[0] < _WORKLOAD_GAUGE_CACHE_TTL:
+        mfu, tok_s, goodput = cached[1]
+    else:
+        rows = await db.fetchall(
+            "SELECT j.run_name AS run, w.kind, w.data"
+            " FROM workload_metrics_points w JOIN jobs j ON j.id = w.job_id"
+            " WHERE j.job_num = 0 AND j.replica_num = 0"
+            "   AND w.kind IN ('step', 'mark')"
+            "   AND j.run_id IN (SELECT DISTINCT run_id FROM jobs WHERE status = 'running')"
+            " ORDER BY w.timestamp ASC"
+        )
+        run_points: Dict[str, List[dict]] = {}
+        for r in rows:
+            try:
+                run_points.setdefault(r["run"], []).append(json.loads(r["data"]))
+            except ValueError:
+                continue
+        mfu, tok_s, goodput = [], [], []
+        from dstack_tpu.server.services.metrics import compute_goodput
+
+        for run_name in sorted(run_points):
+            points = run_points[run_name]
+            labels = {"run": run_name}
+            steps = [p for p in points if p.get("kind") == "step"]
+            if steps:
+                latest = steps[-1]
+                if latest.get("mfu") is not None:
+                    mfu.append((labels, float(latest["mfu"])))
+                if latest.get("tokens_per_sec") is not None:
+                    tok_s.append((labels, float(latest["tokens_per_sec"])))
+            ledger = compute_goodput(points)
+            if ledger["ratio"] is not None:
+                goodput.append((labels, float(ledger["ratio"])))
+        _workload_gauge_cache[db] = (time.monotonic(), (mfu, tok_s, goodput))
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_mfu",
+            "Latest workload-reported model FLOPs utilization (0-1) by run",
+            "gauge",
+            mfu,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_tokens_per_sec",
+            "Latest workload-reported training throughput by run",
+            "gauge",
+            tok_s,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_goodput_ratio",
+            "Productive step time over wall clock (goodput ledger) by run",
+            "gauge",
+            goodput,
+        )
     )
 
     # HTTP request metrics from the middleware (services/request_metrics.py).

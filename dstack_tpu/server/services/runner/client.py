@@ -121,6 +121,12 @@ class RunnerClient:
         except RunnerError:
             return None
 
+    async def profile(self, seconds: float = 5.0) -> dict:
+        """Request an on-demand profiler capture from the live workload.
+        Unlike metrics(), errors PROPAGATE: the caller is an interactive
+        `dstack-tpu profile` request that must hear "no running job"."""
+        return await self._request("POST", "/api/profile", payload={"seconds": seconds})
+
 
 def get_runner_client(jpd, jrd: Optional[JobRuntimeData]) -> RunnerClient:
     """Resolve how to reach a job's runner.

@@ -470,6 +470,18 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
         # The timeline goes with the run: events for deleted runs are
         # unreachable (get_events 404s) and would otherwise accumulate forever.
         await db.execute("DELETE FROM run_events WHERE run_id = ?", (row["id"],))
+        # Workload telemetry too — both the DB points and the in-memory
+        # per-run step-time histogram series (the proxy-latency precedent:
+        # per-run label sets must die with the run or /metrics leaks).
+        await db.execute(
+            "DELETE FROM workload_metrics_points WHERE job_id IN"
+            " (SELECT id FROM jobs WHERE run_id = ?)",
+            (row["id"],),
+        )
+        from dstack_tpu.core import tracing
+        from dstack_tpu.server.services.metrics import STEP_HISTOGRAM
+
+        tracing.drop_series(STEP_HISTOGRAM, {"run": row["run_name"]})
         # Sweep ALL the proxy's per-run state (route entry, rr cursor, stats
         # window, rate-limit buckets): deleted runs must not leak memory.
         from dstack_tpu.server.services import proxy as proxy_service

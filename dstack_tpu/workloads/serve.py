@@ -1295,6 +1295,20 @@ class EngineRunner(threading.Thread):
         self._subs: Dict[str, Callable[[TokenEvent], None]] = {}
         self._subs_lock = threading.Lock()
         self._sub_counter = 0
+        # Workload telemetry (no-op unless the runner agent exported
+        # DSTACK_TPU_TELEMETRY_PATH): one `engine` point per second-ish while
+        # stepping, so queue depth / hit rates reach the control plane even
+        # when no request ever touches the proxy headers.
+        from dstack_tpu.workloads import telemetry as telemetry_lib
+
+        self._telemetry = telemetry_lib.get_emitter()
+        self._telemetry_interval = 1.0
+        self._last_telemetry = 0.0
+        if self._telemetry.enabled:
+            self._telemetry.mark(
+                "run_start", workload="serve",
+                max_batch=engine.ecfg.max_batch, policy=engine.ecfg.policy,
+            )
 
     def submit(
         self,
@@ -1327,6 +1341,22 @@ class EngineRunner(threading.Thread):
         except Exception:
             logger.exception("engine step failed")
             return
+        if self._telemetry.enabled:
+            now = time.monotonic()
+            if now - self._last_telemetry >= self._telemetry_interval:
+                self._last_telemetry = now
+                s = self.engine.stats()
+                self._telemetry.emit(
+                    "engine",
+                    queue_depth=s["queue_depth"],
+                    active=s["active"],
+                    free_pages=s["free_pages"],
+                    generated_tokens=s["generated_tokens"],
+                    finished_requests=s["finished_requests"],
+                    preemptions=s["preemptions"],
+                    prefix_hit_rate=s["prefix_hit_rate"],
+                    spec_accept_rate=s["spec_accept_rate"],
+                )
         for ev in events:
             with self._subs_lock:
                 callback = self._subs.get(ev.req_id)

@@ -1,0 +1,366 @@
+"""Workload telemetry emitter: the workload end of the workload->runner->server
+metrics channel.
+
+Design contract (the whole point of this module):
+
+* **Zero dependencies.** stdlib only — importable before (or without) jax, so
+  a crashed backend init can still emit lifecycle marks. jax is imported
+  lazily and only when the profiler control hook actually fires.
+* **Never blocks, never throws into the caller.** ``emit()`` appends to a
+  bounded in-memory buffer under a lock held for microseconds; a full buffer
+  DROPS the point and increments ``dropped`` (a counter the flusher reports
+  downstream) instead of back-pressuring the train step. Sidecar write
+  errors are swallowed and counted — a full disk degrades observability,
+  never the workload.
+* **Sidecar file protocol.** A background thread flushes buffered points as
+  JSON lines appended to the path in ``DSTACK_TPU_TELEMETRY_PATH`` (set by
+  the runner agent, which tails the file and ships new lines inside its
+  ``/api/metrics`` sample — runner/src/executor.cpp). No emitter->agent RPC:
+  the file IS the queue, and it survives the workload process.
+* **Control hook.** The agent requests on-demand profiling by atomically
+  writing ``<path>.ctl`` (``{"id": N, "cmd": "profile", "seconds": S}``).
+  The flusher polls the file each tick; a new id starts
+  ``jax.profiler.start_trace`` into ``<dir(path)>/profile/<id>`` and stops it
+  ``S`` seconds later, emitting ``profile_start``/``profile_end`` marks (the
+  end mark carries the artifact path the operator retrieves).
+
+Point schema (one JSON object per line, all optional but ``ts``/``kind``):
+
+* ``kind="step"``  — per-train-step: ``step``, ``step_time_s``,
+  ``tokens_per_sec``, ``mfu``, ``tf_per_sec``, ``loss``, ``input_wait_s``.
+* ``kind="engine"`` — serving engine gauges: ``queue_depth``, ``active``,
+  ``generated_tokens``, ``prefix_hit_rate``, ``spec_accept_rate``, ...
+* ``kind="mark"``  — lifecycle: ``event`` in {``run_start``, ``compile_start``,
+  ``compile_end``, ``checkpoint``, ``restart``, ``run_end``,
+  ``profile_start``, ``profile_end``, ``profile_error``} plus free fields.
+* ``kind="emitter"`` — the emitter's own health: ``dropped``,
+  ``write_errors`` (emitted only when the counters advance).
+
+The server's goodput ledger (server/services/metrics.py compute_goodput)
+derives productive/compile/input/restart attribution from exactly these
+kinds, so emit marks honestly: ``compile_start`` before the first traced
+step, ``compile_end`` when it returns.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_PATH = "DSTACK_TPU_TELEMETRY_PATH"
+
+# Buffer/flush defaults: at one point per second-scale train step a 4096-point
+# buffer holds over an hour of backlog; the 0.25 s flush keeps the agent's
+# tail near-real-time without measurable file-IO pressure.
+DEFAULT_CAPACITY = 4096
+DEFAULT_FLUSH_INTERVAL = 0.25
+
+
+def _iso_now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+class _JaxProfiler:
+    """Default control-hook profiler: jax.profiler trace capture. Imported
+    lazily so the emitter stays importable (and the flusher harmless) in
+    processes that never load jax."""
+
+    def start(self, logdir: str) -> None:
+        import jax.profiler
+
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+
+    def stop(self) -> None:
+        import jax.profiler
+
+        jax.profiler.stop_trace()
+
+
+class TelemetryEmitter:
+    """Bounded, never-blocking telemetry channel to the runner agent.
+
+    ``profiler`` is injectable for tests (needs ``start(logdir)``/``stop()``);
+    ``None`` selects the lazy jax profiler."""
+
+    def __init__(
+        self,
+        path: str,
+        capacity: int = DEFAULT_CAPACITY,
+        flush_interval: float = DEFAULT_FLUSH_INTERVAL,
+        profiler: Optional[Any] = None,
+    ) -> None:
+        self.path = path
+        self.capacity = max(1, int(capacity))
+        self.flush_interval = flush_interval
+        self.enabled = True
+        self.dropped = 0
+        self.write_errors = 0
+        self.profile_errors = 0
+        self._buf: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._closed = threading.Event()
+        self._profiler = profiler if profiler is not None else _JaxProfiler()
+        self._profile_id = 0  # last handled control-command id
+        self._profile_stop_at: Optional[float] = None
+        self._profile_artifact: Optional[str] = None
+        self._ctl_sig: Optional[tuple] = None  # (mtime_ns, size) of last read ctl
+        self._reported = (0, 0)  # (dropped, write_errors) already shipped
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="telemetry-flush", daemon=True
+        )
+        self._thread.start()
+
+    # -- the hot path ------------------------------------------------------
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        """Queue one point. Safe to call from any thread, including the train
+        step's — a full buffer drops (and counts), nothing here raises."""
+        try:
+            point = {"ts": _iso_now(), "kind": kind}
+            point.update(fields)
+            with self._lock:
+                if len(self._buf) >= self.capacity:
+                    self.dropped += 1
+                    return
+                self._buf.append(point)
+        except Exception:
+            # The emitter must never take the workload down, full stop.
+            self.dropped += 1
+
+    def step(self, step: int, step_time_s: float, **fields: Any) -> None:
+        self.emit("step", step=step, step_time_s=step_time_s, **fields)
+
+    def mark(self, event: str, **fields: Any) -> None:
+        self.emit("mark", event=event, **fields)
+
+    # -- flushing ----------------------------------------------------------
+
+    def flush(self, timeout: float = 2.0) -> None:
+        """Best-effort synchronous drain (used at run end so the final points
+        are durable before the process exits). Never raises."""
+        try:
+            self._flush_once()
+        except Exception:
+            self.write_errors += 1
+        # The background thread may be mid-write; give it a beat.
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._buf:
+                    return
+            time.sleep(0.01)
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Flush and stop the background thread. Idempotent, never raises."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._wake.set()
+        try:
+            self._thread.join(timeout)
+        except Exception:
+            pass
+        try:
+            self._stop_profile_if_due(force=True)
+        except Exception:
+            pass
+        self.flush(timeout=0)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "buffered": len(self._buf),
+                "dropped": self.dropped,
+                "write_errors": self.write_errors,
+                "profile_errors": self.profile_errors,
+            }
+
+    def _flush_loop(self) -> None:
+        while not self._closed.wait(self.flush_interval):
+            try:
+                self._poll_control()
+            except Exception:
+                self.profile_errors += 1
+            try:
+                self._stop_profile_if_due()
+            except Exception:
+                self.profile_errors += 1
+            try:
+                self._flush_once()
+            except Exception:
+                self.write_errors += 1
+        # Final drain on close.
+        try:
+            self._flush_once()
+        except Exception:
+            self.write_errors += 1
+
+    def _flush_once(self) -> None:
+        with self._lock:
+            if not self._buf:
+                batch: List[dict] = []
+            else:
+                batch = list(self._buf)
+                self._buf.clear()
+            # Report counter advances as their own point so drops are visible
+            # downstream even though the dropped points themselves are gone.
+            counters = (self.dropped, self.write_errors)
+            if counters != self._reported:
+                batch.append(
+                    {
+                        "ts": _iso_now(),
+                        "kind": "emitter",
+                        "dropped": counters[0],
+                        "write_errors": counters[1],
+                    }
+                )
+                self._reported = counters
+        if not batch:
+            return
+        lines = []
+        for point in batch:
+            try:
+                lines.append(json.dumps(point, default=str))
+            except Exception:
+                self.dropped += 1
+        if not lines:
+            return
+        try:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+        except Exception:
+            # Count the batch as dropped: it is gone, and the write error
+            # alone would undercount the loss.
+            self.write_errors += 1
+            self.dropped += len(lines)
+
+    # -- the profiler control hook ----------------------------------------
+
+    @property
+    def _ctl_path(self) -> str:
+        return self.path + ".ctl"
+
+    def _poll_control(self) -> None:
+        try:
+            st = os.stat(self._ctl_path)
+        except OSError:
+            return
+        sig = (st.st_mtime_ns, st.st_size)
+        if sig == self._ctl_sig:
+            return
+        with open(self._ctl_path, "r", encoding="utf-8") as f:
+            cmd = json.loads(f.read())
+        if not isinstance(cmd, dict) or cmd.get("cmd") != "profile":
+            self._ctl_sig = sig
+            return
+        cmd_id = int(cmd.get("id") or 0)
+        if cmd_id <= self._profile_id:
+            self._ctl_sig = sig
+            return  # already handled (mtime jitter, agent rewrite)
+        if self._profile_stop_at is not None:
+            # One capture at a time — but do NOT consume the command: leaving
+            # the signature unrecorded makes the next tick retry it, so a
+            # request that arrived mid-capture starts when this one stops
+            # instead of silently vanishing.
+            return
+        self._ctl_sig = sig
+        self._profile_id = cmd_id
+        seconds = min(max(float(cmd.get("seconds") or 5.0), 0.1), 600.0)
+        artifact = os.path.join(os.path.dirname(self.path), "profile", str(cmd_id))
+        # Mark (and flush) BEFORE starting: on a loaded host start_trace can
+        # block for tens of seconds against the training thread, and the
+        # operator polling the metrics channel should see the request was
+        # picked up rather than silence.
+        self.mark("profile_start", profile_id=cmd_id, seconds=seconds, artifact=artifact)
+        try:
+            self._flush_once()
+        except Exception:
+            self.write_errors += 1
+        try:
+            self._profiler.start(artifact)
+        except Exception as e:
+            self.profile_errors += 1
+            self.mark("profile_error", profile_id=cmd_id, error=str(e)[:200])
+            return
+        self._profile_artifact = artifact
+        # The capture window counts from when tracing actually began (start
+        # may block under load); `seconds` is a minimum, stop lands on the
+        # next flush tick after it elapses.
+        self._profile_stop_at = time.monotonic() + seconds
+
+    def _stop_profile_if_due(self, force: bool = False) -> None:
+        if self._profile_stop_at is None:
+            return
+        if not force and time.monotonic() < self._profile_stop_at:
+            return
+        artifact, self._profile_artifact = self._profile_artifact, None
+        self._profile_stop_at = None
+        try:
+            self._profiler.stop()
+        except Exception as e:
+            self.profile_errors += 1
+            self.mark("profile_error", profile_id=self._profile_id, error=str(e)[:200])
+            return
+        self.mark("profile_end", profile_id=self._profile_id, artifact=artifact)
+
+
+class NullEmitter:
+    """The disabled emitter (no DSTACK_TPU_TELEMETRY_PATH): same surface, no
+    buffer, no thread — workloads call it unconditionally and pay nothing."""
+
+    enabled = False
+    path = None
+    dropped = 0
+    write_errors = 0
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        pass
+
+    def step(self, step: int, step_time_s: float, **fields: Any) -> None:
+        pass
+
+    def mark(self, event: str, **fields: Any) -> None:
+        pass
+
+    def flush(self, timeout: float = 0.0) -> None:
+        pass
+
+    def close(self, timeout: float = 0.0) -> None:
+        pass
+
+    def stats(self) -> Dict[str, int]:
+        return {"buffered": 0, "dropped": 0, "write_errors": 0, "profile_errors": 0}
+
+
+_emitter: Optional[Any] = None
+_emitter_lock = threading.Lock()
+
+
+def get_emitter() -> Any:
+    """Process-wide emitter, created on first use from DSTACK_TPU_TELEMETRY_PATH
+    (the runner agent sets it; unset = NullEmitter, telemetry off)."""
+    global _emitter
+    with _emitter_lock:
+        if _emitter is None:
+            path = os.environ.get(ENV_PATH, "")
+            _emitter = TelemetryEmitter(path) if path else NullEmitter()
+        return _emitter
+
+
+def configure(emitter: Optional[Any]) -> Any:
+    """Swap the process-wide emitter (tests; None resets to re-read the env).
+    Returns the previous emitter WITHOUT closing it — the caller owns both."""
+    global _emitter
+    with _emitter_lock:
+        prev, _emitter = _emitter, emitter
+        return prev

@@ -7,6 +7,7 @@ state — XLA inserts the all-gathers/reduce-scatters/psums implied by the shard
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -15,6 +16,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import telemetry as telemetry_lib
 from dstack_tpu.workloads.config import LlamaConfig
 from dstack_tpu.workloads.sharding import batch_sharding, param_sharding
 
@@ -183,8 +185,26 @@ def _step_time_stats(times) -> Dict[str, float]:
     }
 
 
+def _device_peak_flops(device=None) -> float:
+    """Public per-chip bf16 peak for MFU (same table bench.py cites); the
+    fallback makes CPU-emitted "MFU" a tiny-but-honest fraction of a v5e."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
 def _timed_loop(steps: int, batch: int, seq: int, do_step,
-                flops_per_step: float = 0.0) -> Dict[str, float]:
+                flops_per_step: float = 0.0, telemetry=None,
+                step_extras=None) -> Dict[str, float]:
     """Shared throughput loop: `do_step()` advances state and returns loss.
 
     The first call is compile + first step and is reported (and returned) as
@@ -193,17 +213,29 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     Steady state reports the p50/p90 step-time distribution; throughput/MFU
     derive from p50 (the honest steady-state rate). The per-step sync this
     takes costs one host round trip (~10 ms) against multi-second training
-    steps — <1%, and the prefetcher keeps transfers staged regardless."""
-    import time
+    steps — <1%, and the prefetcher keeps transfers staged regardless.
 
+    Every step also lands on the telemetry channel (workloads/telemetry.py,
+    a no-op unless the runner agent exported DSTACK_TPU_TELEMETRY_PATH):
+    compile_start/compile_end marks around the first call, then one `step`
+    point per iteration — step_time, tok/s, TF/s, MFU against the chip's
+    public peak, loss, plus whatever `step_extras()` returns (the entrypoints
+    pass input-wait). This is what the server's goodput ledger is computed
+    from, so the marks bracket exactly the non-productive time."""
+    if telemetry is None:
+        telemetry = telemetry_lib.get_emitter()
     if steps <= 0:
         print("training done (0 steps)", flush=True)
         return {}
+    n_dev = jax.device_count()
+    peak_flops = _device_peak_flops() * n_dev if flops_per_step else 0.0
 
+    telemetry.mark("compile_start", steps=steps, batch=batch, seq=seq)
     t0 = time.perf_counter()
     loss = do_step()
     jax.block_until_ready(loss)
     compile_s = time.perf_counter() - t0
+    telemetry.mark("compile_end", compile_s=compile_s)
     print(f"step 1/{steps} loss={float(loss):.4f} "
           f"compile+first-step {compile_s:.2f}s", flush=True)
 
@@ -212,7 +244,22 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
         t0 = time.perf_counter()
         loss = do_step()
         jax.block_until_ready(loss)
-        times.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        point = {
+            "loss": round(float(loss), 6),
+            "tokens_per_sec": round(batch * seq / max(dt, 1e-9), 2),
+        }
+        if flops_per_step:
+            fps = flops_per_step / max(dt, 1e-9)
+            point["tf_per_sec"] = round(fps / 1e12, 3)
+            point["mfu"] = round(fps / peak_flops, 5)
+        if step_extras is not None:
+            try:
+                point.update(step_extras())
+            except Exception:
+                pass  # extras are advisory; never let them kill the loop
+        telemetry.step(i + 1, round(dt, 6), **point)
         if (i + 1) % 10 == 0 or i == steps - 1:
             window = times[-10:]
             dt = sum(window) / len(window)
@@ -235,6 +282,14 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
         print(summary, flush=True)
     else:
         print("training done", flush=True)
+    telemetry.mark(
+        "run_end",
+        steps=steps,
+        compile_s=round(compile_s, 4),
+        tokens_per_sec=round(stats.get("tokens_per_sec", 0.0), 2),
+        **{k: v for k, v in telemetry.stats().items() if k != "buffered"},
+    )
+    telemetry.flush()
     return stats
 
 
@@ -295,6 +350,9 @@ def _moe_main(args, moe_lib, data_lib) -> None:
           f"experts={cfg.n_experts} top_k={cfg.top_k} batch={batch} seq={seq} "
           f"grad_accum={args.grad_accum} prefetch={args.prefetch}",
           flush=True)
+    telemetry = telemetry_lib.get_emitter()
+    telemetry.mark("run_start", workload="train", config=args.config,
+                   devices=n, batch=batch, seq=seq)
     optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
     with mesh:
         params = moe_lib.shard_moe_params(
@@ -309,18 +367,23 @@ def _moe_main(args, moe_lib, data_lib) -> None:
             data_path=args.data or None, prefetch=args.prefetch,
         )
         state = {"params": params, "opt": opt_state}
+        feed_wait = {"s": 0.0}
 
         def do_step():
+            t0 = time.perf_counter()
             tokens, targets = next(feed)
+            feed_wait["s"] = time.perf_counter() - t0
             state["params"], state["opt"], loss = step_fn(
                 state["params"], state["opt"], tokens, targets
             )
             return loss
 
         try:
-            _timed_loop(args.steps, batch, seq, do_step)
+            _timed_loop(args.steps, batch, seq, do_step, telemetry=telemetry,
+                        step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)})
         finally:
             feed.close()
+            telemetry.close()
 
 
 def main() -> None:
@@ -435,6 +498,10 @@ def main() -> None:
     print(f"config={args.config} devices={len(devices)} mesh={dict(mesh.shape)} "
           f"batch={batch} seq={seq} grad_accum={args.grad_accum} "
           f"prefetch={args.prefetch}", flush=True)
+    telemetry = telemetry_lib.get_emitter()
+    telemetry.mark("run_start", workload="train", config=args.config,
+                   devices=len(devices), mesh=dict(mesh.shape), batch=batch,
+                   seq=seq, grad_accum=args.grad_accum)
     optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
     with mesh:
         state = init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
@@ -445,16 +512,22 @@ def main() -> None:
         )
         flops_per_step = cfg.flops_per_token(seq) * batch * seq
         box = {"state": state}
+        feed_wait = {"s": 0.0}
 
         def do_step():
+            t0 = time.perf_counter()
             tokens, targets = next(feed)
+            feed_wait["s"] = time.perf_counter() - t0
             box["state"], metrics = step_fn(box["state"], tokens, targets)
             return metrics["loss"]
 
         try:
-            _timed_loop(args.steps, batch, seq, do_step, flops_per_step)
+            _timed_loop(args.steps, batch, seq, do_step, flops_per_step,
+                        telemetry=telemetry,
+                        step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)})
         finally:
             feed.close()
+            telemetry.close()
 
 
 if __name__ == "__main__":

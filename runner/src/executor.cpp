@@ -15,6 +15,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <ctime>
@@ -81,6 +82,10 @@ Executor::Executor(std::string base_dir, std::string docker_mode, std::string do
       docker_socket_(docker_socket.empty() ? ddocker::DockerClient::default_socket()
                                            : std::move(docker_socket)) {
   mkdir(base_dir_.c_str(), 0755);
+  // World-writable telemetry dir: container jobs may run as a non-root user
+  // but must still be able to append their sidecar (and profile artifacts).
+  mkdir(telemetry_dir().c_str(), 0777);
+  chmod(telemetry_dir().c_str(), 0777);
 }
 
 Executor::~Executor() {
@@ -125,6 +130,11 @@ dj::Json Executor::submit(const dj::Json& body) {
   abort_requested_ = false;
   code_path_.clear();
   current_state_ = "submitted";
+  // Fresh job, fresh telemetry stream: the previous job's sidecar (and any
+  // stale profile request) must not leak into the new job's samples.
+  telemetry_offset_ = 0;
+  unlink(telemetry_file().c_str());
+  unlink((telemetry_file() + ".ctl").c_str());
   return dj::Json::object();
 }
 
@@ -228,7 +238,7 @@ dj::Json Executor::stop(bool abort) {
   return dj::Json::object();
 }
 
-dj::Json Executor::metrics() const {
+dj::Json Executor::metrics() {
   pid_t pid = child_pid_.load();
   dj::Json out = dj::Json::object();
   int64_t cpu_micro = 0, rss_bytes = 0;
@@ -273,6 +283,103 @@ dj::Json Executor::metrics() const {
   // DSTACK_TPU_RUNTIME_METRICS_URL is set (the DCGM-exporter analog); null
   // otherwise (src/tpu_metrics.cpp).
   out.set("tpu", dtpu::sample_tpu_metrics());
+  // Workload telemetry points appended by the job's emitter since the last
+  // sample ride the same response (at-most-once: the offset advances on read).
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    dj::Json workload = tail_telemetry_locked();
+    if (!workload.as_array().empty()) out.set("workload", std::move(workload));
+  }
+  return out;
+}
+
+dj::Json Executor::tail_telemetry_locked() {
+  dj::Json points = dj::Json::array();
+  std::ifstream f(telemetry_file(), std::ios::binary);
+  if (!f) return points;
+  f.seekg(0, std::ios::end);
+  int64_t size = f.tellg();
+  if (size < telemetry_offset_) telemetry_offset_ = 0;  // truncated / replaced
+  if (size <= telemetry_offset_) return points;
+  // Bound the per-sample payload: a chatty emitter is drained over successive
+  // samples instead of blowing one response (the offset only advances past
+  // what was actually taken).
+  const int64_t kMaxBytes = 256 * 1024;
+  const size_t kMaxPoints = 1000;
+  int64_t want = std::min<int64_t>(size - telemetry_offset_, kMaxBytes);
+  std::string chunk(static_cast<size_t>(want), '\0');
+  f.seekg(telemetry_offset_);
+  f.read(&chunk[0], want);
+  chunk.resize(static_cast<size_t>(f.gcount()));
+  // Only complete lines: a line still being appended must wait for the next
+  // sample, or its tail would parse as garbage AND be skipped forever.
+  size_t last_nl = chunk.rfind('\n');
+  if (last_nl == std::string::npos) {
+    // A full window with no newline is a single line larger than kMaxBytes
+    // (a job writing junk to the sidecar path): it can never complete inside
+    // the window, so skip past it — leaving the offset parked would re-read
+    // the same window forever and silently drop ALL later telemetry.
+    if (static_cast<int64_t>(chunk.size()) >= kMaxBytes) {
+      telemetry_offset_ += static_cast<int64_t>(chunk.size());
+    }
+    return points;
+  }
+  chunk.resize(last_nl + 1);
+  size_t start = 0, consumed = 0, taken = 0;
+  while (start < chunk.size() && taken < kMaxPoints) {
+    size_t nl = chunk.find('\n', start);
+    if (nl == std::string::npos) break;
+    std::string line = chunk.substr(start, nl - start);
+    consumed = nl + 1;
+    start = nl + 1;
+    if (!line.empty() && line[0] != '\r') {
+      try {
+        points.push_back(dj::Json::parse(line));
+        ++taken;
+      } catch (const std::exception&) {
+        // Corrupt line (partial write across a crash): skip it, keep the rest.
+      }
+    }
+  }
+  telemetry_offset_ += static_cast<int64_t>(consumed);
+  return points;
+}
+
+dj::Json Executor::profile(const dj::Json& body) {
+  double seconds = body["seconds"].as_number(5.0);
+  if (seconds <= 0) seconds = 5.0;
+  if (seconds > 600) seconds = 600;
+  int64_t id;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (current_state_ != "running") {
+      throw std::runtime_error("no running job to profile");
+    }
+    id = ++profile_seq_;
+  }
+  // Atomic control-file write (tmp + rename): the emitter polls this path and
+  // must never read a half-written command.
+  std::string ctl = telemetry_file() + ".ctl";
+  std::string tmp = ctl + ".tmp";
+  {
+    dj::Json cmd = dj::Json::object();
+    cmd.set("id", id);
+    cmd.set("cmd", "profile");
+    cmd.set("seconds", seconds);
+    std::ofstream f(tmp, std::ios::trunc);
+    f << cmd.dump();
+    if (!f.good()) throw std::runtime_error("failed to write profiler control file");
+  }
+  if (rename(tmp.c_str(), ctl.c_str()) != 0) {
+    throw std::runtime_error("failed to publish profiler control file");
+  }
+  // The artifact dir as seen from THIS host (container jobs see it under the
+  // telemetry bind mount, but the path below is where the operator finds it).
+  dj::Json out = dj::Json::object();
+  out.set("id", id);
+  out.set("seconds", seconds);
+  out.set("status", "requested");
+  out.set("artifact_dir", telemetry_dir() + "/profile/" + std::to_string(id));
   return out;
 }
 
@@ -444,7 +551,8 @@ std::string Executor::build_script() const {
   return script;
 }
 
-std::vector<std::string> Executor::job_env(const std::string& repo_dir) const {
+std::vector<std::string> Executor::job_env(const std::string& repo_dir,
+                                           const std::string& telemetry_path) const {
   std::vector<std::string> env_strings;
   for (const auto& kv : job_spec_["env"].as_object()) {
     env_strings.push_back(kv.first + "=" + kv.second.as_string());
@@ -454,6 +562,11 @@ std::vector<std::string> Executor::job_env(const std::string& repo_dir) const {
   }
   for (auto& kv : cluster_env(cluster_info_)) env_strings.push_back(kv);
   env_strings.push_back("DSTACK_REPO_DIR=" + repo_dir);
+  // The workload->agent telemetry contract (workloads/telemetry.py): the
+  // emitter appends JSONL here, the agent tails it into /api/metrics samples.
+  if (!telemetry_path.empty()) {
+    env_strings.push_back("DSTACK_TPU_TELEMETRY_PATH=" + telemetry_path);
+  }
   return env_strings;
 }
 
@@ -542,7 +655,11 @@ void Executor::exec_container(uint64_t generation) {
         cfg.set("Cmd", std::move(cmd));
       }
       dj::Json env = dj::Json::array();
-      for (auto& kv : job_env("/workflow")) env.push_back(kv);
+      // Telemetry rides a dedicated bind (added below) so the sidecar lands
+      // in the agent's base dir no matter what the container image mounts.
+      for (auto& kv : job_env("/workflow", "/run/dstack-telemetry/workload.jsonl")) {
+        env.push_back(kv);
+      }
       env.push_back("PJRT_DEVICE=TPU");
       cfg.set("Env", std::move(env));
       std::string workdir = "/workflow";
@@ -566,6 +683,7 @@ void Executor::exec_container(uint64_t generation) {
       host.set("Privileged", job_spec_["privileged"].as_bool());
       dj::Json binds = dj::Json::array();
       binds.push_back(repo_dir + ":/workflow");
+      binds.push_back(telemetry_dir() + ":/run/dstack-telemetry");
       // Volume mounts: host dirs bind directly; block devices are readied on the
       // host first (mounted under base_dir), then bound (the shim pattern:
       // docker.go:505-575 prepareVolumes + getVolumeMounts).
@@ -730,7 +848,7 @@ void Executor::exec_host(uint64_t generation) {
       merged[kv.substr(0, eq)] = kv.substr(eq + 1);
     };
     for (char** e = environ; *e; ++e) put(*e);
-    for (auto& kv : job_env(repo_dir)) put(kv);
+    for (auto& kv : job_env(repo_dir, telemetry_file())) put(kv);
     for (auto& kv : merged) env_strings.push_back(kv.first + "=" + kv.second);
   }
 

@@ -51,8 +51,12 @@ class Executor {
   dj::Json run();
   dj::Json pull(int64_t offset);
   dj::Json stop(bool abort);
-  dj::Json metrics() const;
+  // Non-const: tailing the workload telemetry sidecar advances a read offset.
+  dj::Json metrics();
   dj::Json health() const;
+  // On-demand profiler capture: writes the telemetry control file the live
+  // workload's emitter polls (workloads/telemetry.py); {"seconds": N} in.
+  dj::Json profile(const dj::Json& body);
 
  private:
   void exec_thread();
@@ -64,7 +68,13 @@ class Executor {
   void trim_events_locked();
   std::string extract_code();
   std::string build_script() const;
-  std::vector<std::string> job_env(const std::string& repo_dir) const;
+  std::vector<std::string> job_env(const std::string& repo_dir,
+                                   const std::string& telemetry_path) const;
+  // Workload telemetry sidecar (written by workloads/telemetry.py inside the
+  // job, tailed here into the /api/metrics sample).
+  std::string telemetry_dir() const { return base_dir_ + "/telemetry"; }
+  std::string telemetry_file() const { return telemetry_dir() + "/workload.jsonl"; }
+  dj::Json tail_telemetry_locked();
 
   std::string base_dir_;
   std::string docker_mode_;
@@ -89,6 +99,15 @@ class Executor {
   std::atomic<bool> stop_requested_{false};
   std::atomic<bool> abort_requested_{false};
   std::atomic<uint64_t> job_generation_{0};
+
+  // Guarded by mu_. The offset is how far into the sidecar the control plane
+  // has already been shipped (reset by submit, rewound on truncation).
+  // Profile ids are monotonic within THIS agent process (enough for the
+  // emitter's per-job replay guard — a job's emitter starts at 0); a
+  // restarted agent restarts at 1, so consumers matching marks by id must
+  // also discount marks that predate their request (cli cmd_profile does).
+  int64_t telemetry_offset_ = 0;
+  int64_t profile_seq_ = 0;
 };
 
 }  // namespace drunner

@@ -74,6 +74,13 @@ int main(int argc, char** argv) {
   server.handle("GET", "/api/metrics", [&](const dhttp::Request&) {
     return dhttp::Response{200, "application/json", executor.metrics().dump()};
   });
+  // On-demand profiler capture: {"seconds": N} -> control file the live
+  // workload's telemetry emitter polls; the trace artifact path comes back in
+  // the response and in the workload's profile_end telemetry mark.
+  server.handle("POST", "/api/profile", [&](const dhttp::Request& req) {
+    dj::Json body = req.body.empty() ? dj::Json::object() : dj::Json::parse(req.body);
+    return dhttp::Response{200, "application/json", executor.profile(body).dump()};
+  });
 
   // Port 0 resolves to an ephemeral port; print it so the spawner can read it.
   printf("dstack-tpu-runner listening on %s:%d\n", host.c_str(), server.port());

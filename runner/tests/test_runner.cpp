@@ -13,6 +13,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <fstream>
 #include <string>
 #include <thread>
 #include <vector>
@@ -126,6 +127,105 @@ RunResult pump_until_terminal(drunner::Executor& ex, int timeout_ms = 90000,
   }
   r.state = "timeout";
   return r;
+}
+
+void test_telemetry_tail() {
+  // The workload->agent sidecar protocol: complete JSONL lines ride the
+  // metrics sample exactly once; partial lines wait; corrupt lines skip.
+  std::string dir = temp_dir();
+  drunner::Executor ex(dir);
+  std::string tfile = dir + "/telemetry/workload.jsonl";
+
+  dj::Json m = ex.metrics();
+  CHECK(m["workload"].is_null());  // no sidecar yet
+
+  {
+    std::ofstream f(tfile, std::ios::app);
+    f << "{\"kind\": \"step\", \"step\": 1, \"step_time_s\": 0.5}\n";
+    f << "{\"kind\": \"ma";  // a line mid-append — must NOT be consumed
+  }
+  m = ex.metrics();
+  CHECK_EQ(m["workload"].as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(m["workload"].as_array()[0]["kind"].as_string(), std::string("step"));
+
+  {
+    std::ofstream f(tfile, std::ios::app);
+    f << "rk\", \"event\": \"compile_end\"}\n";  // completes the partial line
+    f << "this is not json\n";                     // corrupt: skipped, not fatal
+    f << "{\"kind\": \"engine\", \"queue_depth\": 3}\n";
+  }
+  m = ex.metrics();
+  CHECK_EQ(m["workload"].as_array().size(), static_cast<size_t>(2));
+  CHECK_EQ(m["workload"].as_array()[0]["event"].as_string(), std::string("compile_end"));
+  CHECK_EQ(m["workload"].as_array()[1]["queue_depth"].as_int(), static_cast<int64_t>(3));
+
+  m = ex.metrics();  // nothing new -> no workload key
+  CHECK(m["workload"].is_null());
+
+  // A single line larger than the per-sample window (a job writing junk to
+  // the sidecar path) must be skipped, not wedge the tail forever.
+  {
+    std::ofstream f(tfile, std::ios::app);
+    f << std::string(300 * 1024, 'x');  // 300KiB, no newline yet
+  }
+  m = ex.metrics();
+  CHECK(m["workload"].is_null());  // window full, no newline -> skipped
+  {
+    std::ofstream f(tfile, std::ios::app);
+    f << "\n{\"kind\": \"step\", \"step\": 9}\n";
+  }
+  m = ex.metrics();  // remnant of the junk line parses as garbage and skips;
+  CHECK_EQ(m["workload"].as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(m["workload"].as_array()[0]["step"].as_int(), static_cast<int64_t>(9));
+}
+
+void test_profile_control_file() {
+  std::string dir = temp_dir();
+  drunner::Executor ex(dir);
+  // Not running yet: the request must be refused.
+  bool threw = false;
+  dj::Json req = dj::Json::object();
+  req.set("seconds", 1.0);
+  try {
+    ex.profile(req);
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+
+  ex.submit(make_submit("prof", {"echo telemetry=$DSTACK_TPU_TELEMETRY_PATH", "sleep 5"}));
+  ex.run();
+  // Wait until the job reports running.
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(30);
+  bool running = false;
+  std::string logs;
+  while (std::chrono::steady_clock::now() < deadline && !running) {
+    dj::Json page = ex.pull(0);
+    for (const auto& l : page["logs"].as_array()) logs += l["message"].as_string();
+    for (const auto& s : page["job_states"].as_array()) {
+      if (s["state"].as_string() == "running") running = true;
+    }
+    if (!running) usleep(50 * 1000);
+  }
+  CHECK(running);
+
+  dj::Json ack = ex.profile(req);
+  CHECK_EQ(ack["id"].as_int(), static_cast<int64_t>(1));
+  CHECK(ack["artifact_dir"].as_string().find("/telemetry/profile/1") != std::string::npos);
+  // The control file is published atomically with the command the emitter polls.
+  std::ifstream ctl(dir + "/telemetry/workload.jsonl.ctl");
+  CHECK(ctl.good());
+  std::string content((std::istreambuf_iterator<char>(ctl)), std::istreambuf_iterator<char>());
+  dj::Json cmd = dj::Json::parse(content);
+  CHECK_EQ(cmd["cmd"].as_string(), std::string("profile"));
+  CHECK_EQ(cmd["id"].as_int(), static_cast<int64_t>(1));
+
+  ex.stop(true);
+  RunResult r = pump_until_terminal(ex);
+  CHECK_EQ(r.state, std::string("aborted"));
+  // The env contract reached the job before it died.
+  CHECK((logs + r.logs).find("telemetry=" + dir + "/telemetry/workload.jsonl")
+        != std::string::npos);
 }
 
 void test_pty_exec_and_env() {
@@ -536,6 +636,8 @@ int main() {
   test_docker_helpers();
   test_chunked_adversarial();
   test_tpu_metrics_parse();
+  test_telemetry_tail();
+  test_profile_control_file();
   test_pty_exec_and_env();
   test_job_env_overrides_inherited_env();
   test_failure_exit_status();

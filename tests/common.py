@@ -131,6 +131,15 @@ class FakeRunnerClient:
     async def metrics(self):
         return None
 
+    async def profile(self, seconds: float = 5.0):
+        self.profiled_seconds = seconds
+        return {
+            "id": 1,
+            "seconds": seconds,
+            "status": "requested",
+            "artifact_dir": "/tmp/fake-profile/1",
+        }
+
 
 async def setup_mock_backend(api: ApiClient, project: str = "main") -> None:
     await api.post(f"/api/project/{project}/backends/create", {"type": "mock"})

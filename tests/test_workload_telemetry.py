@@ -1,0 +1,810 @@
+"""Workload telemetry pipeline: emitter contract (never blocks / never throws),
+goodput ledger, metrics-collection rotation, batched utilization enforcement,
+the workload->runner->server flow, and the on-demand profiler — through fakes
+at the service layer and through the REAL C++ agent end to end.
+
+The emitter contract tests are the load-bearing ones: telemetry sits inside
+the train step, so a full buffer, an unwritable sidecar, or an unserializable
+field must degrade to a counter bump, never an exception or a stall."""
+
+import asyncio
+import datetime
+import json
+import os
+import time
+
+import pytest
+
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import metrics as metrics_service
+from dstack_tpu.utils.common import now_utc, to_iso
+from dstack_tpu.utils.runner_binary import find_runner_binary
+from dstack_tpu.workloads.telemetry import NullEmitter, TelemetryEmitter
+from tests.common import api_server
+from tests.test_run_events import parse_exposition
+
+
+def _iso(base, off: float) -> str:
+    return to_iso(base + datetime.timedelta(seconds=off))
+
+
+class FakeProfiler:
+    def __init__(self):
+        self.started_dirs = []
+        self.stopped = 0
+
+    def start(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        with open(os.path.join(logdir, "trace.data"), "w") as f:
+            f.write("fake-trace")
+        self.started_dirs.append(logdir)
+
+    def stop(self):
+        self.stopped += 1
+
+
+# ---------------------------------------------------------------------------
+# Emitter contract
+
+
+class TestEmitter:
+    def test_full_buffer_drops_and_counts(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        # Flush interval far beyond the test: the buffer can only drain via
+        # explicit flush, so capacity overflow is deterministic.
+        e = TelemetryEmitter(path, capacity=4, flush_interval=3600)
+        try:
+            for i in range(20):
+                e.step(i, 0.01)  # must never raise
+            assert e.dropped == 16
+            e.flush()
+            lines = [json.loads(l) for l in open(path).read().splitlines()]
+            steps = [p for p in lines if p["kind"] == "step"]
+            assert len(steps) == 4
+            # The drop counter itself reached the sidecar as an emitter point.
+            emitter_points = [p for p in lines if p["kind"] == "emitter"]
+            assert emitter_points and emitter_points[-1]["dropped"] == 16
+        finally:
+            e.close()
+
+    def test_write_errors_swallowed_and_counted(self, tmp_path):
+        # The sidecar path IS a directory: every flush write fails.
+        bad = tmp_path / "isdir"
+        bad.mkdir()
+        e = TelemetryEmitter(str(bad), capacity=64, flush_interval=3600)
+        try:
+            e.mark("run_start")
+            e.step(1, 0.01)
+            e.flush()  # must not raise
+            assert e.write_errors >= 1
+            assert e.dropped >= 2  # the lost batch is counted as dropped
+            e.step(2, 0.01)  # emitter still alive after the failure
+        finally:
+            e.close()
+
+    def test_unserializable_field_never_raises(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        e = TelemetryEmitter(path, flush_interval=3600)
+        try:
+            circular = {}
+            circular["self"] = circular
+            e.emit("step", step=1, bad=circular)  # json.dumps raises ValueError
+            e.step(2, 0.01)
+            e.flush()
+            lines = [json.loads(l) for l in open(path).read().splitlines()]
+            assert any(p.get("step") == 2 for p in lines)
+            assert e.dropped == 1
+        finally:
+            e.close()
+
+    def test_background_flush_and_close(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        e = TelemetryEmitter(path, flush_interval=0.02)
+        e.mark("compile_start")
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline and not os.path.exists(path):
+            time.sleep(0.02)
+        assert os.path.exists(path), "background thread never flushed"
+        e.step(1, 0.5, loss=1.0)
+        e.close()
+        e.close()  # idempotent
+        points = [json.loads(l) for l in open(path).read().splitlines()]
+        assert [p["kind"] for p in points] == ["mark", "step"]
+        assert points[1]["step_time_s"] == 0.5
+
+    def test_null_emitter_when_env_unset(self, monkeypatch):
+        from dstack_tpu.workloads import telemetry as tl
+
+        monkeypatch.delenv(tl.ENV_PATH, raising=False)
+        prev = tl.configure(None)
+        try:
+            e = tl.get_emitter()
+            assert isinstance(e, NullEmitter) and not e.enabled
+            e.step(1, 0.1)
+            e.mark("run_start")
+            e.flush()
+            e.close()
+        finally:
+            tl.configure(prev)
+
+    def test_control_file_triggers_profiler(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        prof = FakeProfiler()
+        e = TelemetryEmitter(path, flush_interval=0.02, profiler=prof)
+        try:
+            # The agent's protocol: atomic write of <path>.ctl.
+            ctl = path + ".ctl"
+            with open(ctl + ".tmp", "w") as f:
+                f.write(json.dumps({"id": 1, "cmd": "profile", "seconds": 0.1}))
+            os.replace(ctl + ".tmp", ctl)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and prof.stopped == 0:
+                time.sleep(0.02)
+            assert prof.stopped == 1
+            e.flush()
+            points = [json.loads(l) for l in open(path).read().splitlines()]
+            events = [p.get("event") for p in points if p["kind"] == "mark"]
+            assert "profile_start" in events and "profile_end" in events
+            end = next(p for p in points if p.get("event") == "profile_end")
+            assert end["profile_id"] == 1
+            assert end["artifact"] == prof.started_dirs[0]
+            assert os.path.exists(os.path.join(end["artifact"], "trace.data"))
+            # Same command id again (mtime touch): no re-trigger.
+            os.utime(ctl)
+            time.sleep(0.2)
+            assert prof.stopped == 1
+        finally:
+            e.close()
+
+    def test_profile_request_mid_capture_queues_not_drops(self, tmp_path):
+        """A second request arriving during a capture must run AFTER it, not
+        be consumed into the id guard and vanish (the CLI would then wait for
+        a profile_end that never comes)."""
+        path = str(tmp_path / "t.jsonl")
+        prof = FakeProfiler()
+        e = TelemetryEmitter(path, flush_interval=0.02, profiler=prof)
+        try:
+            with open(path + ".ctl", "w") as f:
+                f.write(json.dumps({"id": 1, "cmd": "profile", "seconds": 0.3}))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not prof.started_dirs:
+                time.sleep(0.01)
+            # Capture 1 in flight: overwrite the ctl with request 2.
+            with open(path + ".ctl", "w") as f:
+                f.write(json.dumps({"id": 2, "cmd": "profile", "seconds": 0.1}))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and prof.stopped < 2:
+                time.sleep(0.02)
+            assert prof.stopped == 2, "queued capture never ran"
+            e.flush()
+            points = [json.loads(l) for l in open(path).read().splitlines()]
+            ends = [p for p in points if p.get("event") == "profile_end"]
+            assert [p["profile_id"] for p in ends] == [1, 2]
+        finally:
+            e.close()
+
+    def test_profiler_failure_is_counted_not_fatal(self, tmp_path):
+        class BrokenProfiler:
+            def start(self, logdir):
+                raise RuntimeError("no profiler here")
+
+            def stop(self):
+                pass
+
+        path = str(tmp_path / "t.jsonl")
+        e = TelemetryEmitter(path, flush_interval=0.02, profiler=BrokenProfiler())
+        try:
+            with open(path + ".ctl", "w") as f:
+                f.write(json.dumps({"id": 7, "cmd": "profile", "seconds": 1}))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and e.profile_errors == 0:
+                time.sleep(0.02)
+            assert e.profile_errors >= 1
+            e.step(1, 0.01)  # emitter still functional
+            e.flush()
+            points = [json.loads(l) for l in open(path).read().splitlines()]
+            assert any(p.get("event") == "profile_error" for p in points)
+        finally:
+            e.close()
+
+    def test_emit_cost_stays_microscopic(self, tmp_path):
+        """The <1%-overhead budget: emit() is a dict build + deque append.
+        Asserted loosely (50µs/point averaged over 5k) so CI noise can't flake
+        it, while a regression to file IO or locking on the hot path fails."""
+        e = TelemetryEmitter(str(tmp_path / "t.jsonl"), capacity=10000,
+                             flush_interval=3600)
+        try:
+            t0 = time.perf_counter()
+            for i in range(5000):
+                e.step(i, 0.001, tokens_per_sec=1.0, loss=0.5, input_wait_s=0.0)
+            per_point = (time.perf_counter() - t0) / 5000
+            assert per_point < 50e-6, f"emit() costs {per_point * 1e6:.1f}µs"
+        finally:
+            e.close()
+
+
+# ---------------------------------------------------------------------------
+# Goodput ledger
+
+
+class TestGoodput:
+    def test_compile_stall_debits_goodput(self):
+        base = now_utc()
+        points = [
+            {"ts": _iso(base, 0), "kind": "mark", "event": "run_start"},
+            {"ts": _iso(base, 0), "kind": "mark", "event": "compile_start"},
+            {"ts": _iso(base, 4), "kind": "mark", "event": "compile_end", "compile_s": 4.0},
+        ] + [
+            {"ts": _iso(base, 4 + i), "kind": "step", "step": i + 2,
+             "step_time_s": 1.0, "input_wait_s": 0.0}
+            for i in range(1, 7)
+        ]
+        ledger = metrics_service.compute_goodput(points)
+        assert ledger["compile_s"] == 4.0
+        assert ledger["productive_s"] == 6.0
+        assert ledger["wall_s"] == 10.0
+        assert ledger["ratio"] == 0.6
+        # Same steps without the stall: goodput jumps — the stall is debited.
+        no_stall = metrics_service.compute_goodput(points[3:])
+        assert no_stall["ratio"] > ledger["ratio"]
+
+    def test_input_wait_not_productive(self):
+        base = now_utc()
+        points = [
+            {"ts": _iso(base, i), "kind": "step", "step": i, "step_time_s": 1.0,
+             "input_wait_s": 0.4}
+            for i in range(1, 6)
+        ]
+        ledger = metrics_service.compute_goodput(points)
+        assert ledger["input_wait_s"] == pytest.approx(2.0)
+        assert ledger["productive_s"] == pytest.approx(3.0)
+
+    def test_restart_gap_attributed(self):
+        base = now_utc()
+        points = [
+            {"ts": _iso(base, 0), "kind": "mark", "event": "run_start"},
+            {"ts": _iso(base, 1), "kind": "step", "step": 2, "step_time_s": 1.0},
+            # 10s of downtime, then the restarted process comes up.
+            {"ts": _iso(base, 11), "kind": "mark", "event": "run_start"},
+            {"ts": _iso(base, 12), "kind": "step", "step": 2, "step_time_s": 1.0},
+        ]
+        ledger = metrics_service.compute_goodput(points)
+        assert ledger["restart_s"] == pytest.approx(10.0)
+        assert ledger["productive_s"] == pytest.approx(2.0)
+        assert ledger["ratio"] == pytest.approx(2.0 / 12.0, abs=1e-3)
+
+    def test_no_steps_or_no_points_means_no_ratio(self):
+        assert metrics_service.compute_goodput([])["ratio"] is None
+        base = now_utc()
+        marks_only = [
+            {"ts": _iso(base, 0), "kind": "mark", "event": "run_start"},
+            {"ts": _iso(base, 5), "kind": "engine", "queue_depth": 3},
+        ]
+        assert metrics_service.compute_goodput(marks_only)["ratio"] is None
+
+    def test_dangling_compile_counts_to_window_edge(self):
+        base = now_utc()
+        points = [
+            {"ts": _iso(base, 0), "kind": "step", "step": 1, "step_time_s": 0.5},
+            {"ts": _iso(base, 1), "kind": "mark", "event": "compile_start"},
+            {"ts": _iso(base, 9), "kind": "step", "step": 2, "step_time_s": 0.5},
+        ]
+        ledger = metrics_service.compute_goodput(points)
+        assert ledger["compile_s"] == pytest.approx(8.0)
+
+
+# ---------------------------------------------------------------------------
+# Collection rotation (sampling-starvation fix) + batched utilization
+
+
+async def _insert_running_job(db, proj, run_id, job_id, run_name=None,
+                              job_num=0, replica_num=0, spec=None, jpd=True):
+    run_name = run_name or run_id
+    await db.execute(
+        "INSERT OR IGNORE INTO runs (id, project_id, user_id, run_name, submitted_at,"
+        " status, run_spec) VALUES (?, ?, ?, ?, '2026-01-01', 'running', '{}')",
+        (run_id, proj["id"], proj["owner_id"], run_name),
+    )
+    jpd_json = None
+    if jpd:
+        jpd_json = json.dumps(
+            {
+                "backend": "local",
+                "instance_type": {
+                    "name": "local",
+                    "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1},
+                },
+                "instance_id": job_id,
+                "hostname": "127.0.0.1",
+                "region": "local",
+                "ssh_port": 0,
+                "backend_data": json.dumps({"runner_port": 1}),
+            }
+        )
+    await db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " job_spec, status, submitted_at, job_provisioning_data)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, 'running', '2026-01-01', ?)",
+        (job_id, proj["id"], run_id, run_name, job_num, replica_num,
+         json.dumps(spec or {"job_name": f"{run_name}-0-0"}), jpd_json),
+    )
+
+
+class TestCollectionRotation:
+    async def test_150_running_jobs_fully_rotate(self, monkeypatch):
+        """>MAX_JOBS_PER_PASS running jobs: two passes must cover ALL of them
+        (the old last_processed_at ordering resampled the same 100 forever)."""
+        sampled = []
+
+        class FakeAgent:
+            def __init__(self, job_key):
+                self.job_key = job_key
+
+            async def metrics(self):
+                sampled.append(self.job_key)
+                return {
+                    "timestamp": to_iso(now_utc()),
+                    "cpu_usage_micro": 1,
+                    "memory_usage_bytes": 1,
+                }
+
+        monkeypatch.setattr(
+            metrics_service, "get_runner_client",
+            lambda jpd, jrd: FakeAgent(jpd.instance_id),
+        )
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            for i in range(150):
+                await _insert_running_job(api.db, proj, f"r{i:03d}", f"j{i:03d}")
+
+            n1 = await metrics_service.collect_job_metrics(api.db)
+            first = set(sampled)
+            assert n1 == metrics_service.MAX_JOBS_PER_PASS == len(first)
+
+            sampled.clear()
+            n2 = await metrics_service.collect_job_metrics(api.db)
+            second = set(sampled)
+            assert n2 == metrics_service.MAX_JOBS_PER_PASS
+            # Pass 2 starts with the 50 never-sampled jobs, then wraps to the
+            # oldest-sampled — union covers the whole fleet.
+            assert first | second == {f"j{i:03d}" for i in range(150)}
+            assert len(second - first) == 50
+
+            # Pass 3 keeps rotating (never wedges on one subset).
+            sampled.clear()
+            await metrics_service.collect_job_metrics(api.db)
+            assert len(set(sampled) - second) == 50
+
+    async def test_unreachable_job_rotates_to_back(self, monkeypatch):
+        """A dead agent's job must not hold its place at the head of the
+        sampling order (cursor advances for picked-but-unreachable too)."""
+        calls = []
+
+        class DeadAgent:
+            def __init__(self, key):
+                self.key = key
+
+            async def metrics(self):
+                calls.append(self.key)
+                return None
+
+        monkeypatch.setattr(
+            metrics_service, "get_runner_client", lambda jpd, jrd: DeadAgent(jpd.instance_id)
+        )
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(api.db, proj, "ra", "ja")
+            await metrics_service.collect_job_metrics(api.db)
+            row = await api.db.fetchone("SELECT metrics_sampled_at FROM jobs WHERE id = 'ja'")
+            assert row["metrics_sampled_at"] is not None
+
+
+class TestBatchedUtilization:
+    async def test_single_window_query_for_many_jobs(self):
+        """The N+1 fix: one grouped query fetches every candidate's window, and
+        enforcement behavior is unchanged (breach kills, busy survives)."""
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            policy_spec = {
+                "job_name": "x-0-0",
+                "image_name": "x",
+                "requirements": {"resources": {}},
+                "utilization_policy": {"min_tpu_utilization": 40, "time_window": "1m"},
+            }
+            for i in range(20):
+                await _insert_running_job(
+                    api.db, proj, f"pr{i}", f"pj{i}", spec=dict(policy_spec), jpd=False
+                )
+                duty = 5.0 if i < 10 else 90.0  # first 10 runs breach
+                for age in (58, 30, 5):
+                    ts = to_iso(now_utc() - datetime.timedelta(seconds=age))
+                    await api.db.execute(
+                        "INSERT INTO job_metrics_points (job_id, timestamp,"
+                        " cpu_usage_micro, memory_usage_bytes, tpu)"
+                        " VALUES (?, ?, 0, 0, ?)",
+                        (f"pj{i}", ts, json.dumps({"duty_cycle_percent": duty})),
+                    )
+
+            point_queries = []
+            orig_fetchall = api.db.fetchall
+
+            async def counting_fetchall(sql, params=()):
+                if "job_metrics_points" in sql:
+                    point_queries.append(sql)
+                return await orig_fetchall(sql, params)
+
+            api.db.fetchall = counting_fetchall
+            try:
+                await metrics_service.enforce_utilization_policies(api.db)
+            finally:
+                api.db.fetchall = orig_fetchall
+            assert len(point_queries) == 1, point_queries
+
+            for i in range(20):
+                run = await api.db.fetchone("SELECT status FROM runs WHERE id = ?", (f"pr{i}",))
+                if i < 10:
+                    assert run["status"] == "terminating", f"pr{i} should breach"
+                else:
+                    assert run["status"] == "running", f"pr{i} should survive"
+
+
+# ---------------------------------------------------------------------------
+# Workload points flow: store -> API -> Prometheus -> sweep
+
+
+class TestWorkloadFlow:
+    async def test_store_query_prometheus_and_delete(self):
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(api.db, proj, "wf", "wfj", run_name="wf-run", jpd=False)
+            job = await api.db.fetchone("SELECT * FROM jobs WHERE id = 'wfj'")
+            base = now_utc() - datetime.timedelta(seconds=30)
+            points = [
+                {"ts": _iso(base, 0), "kind": "mark", "event": "run_start"},
+                {"ts": _iso(base, 0), "kind": "mark", "event": "compile_start"},
+                {"ts": _iso(base, 3), "kind": "mark", "event": "compile_end", "compile_s": 3.0},
+            ] + [
+                {"ts": _iso(base, 3 + i), "kind": "step", "step": i + 1,
+                 "step_time_s": 0.8, "tokens_per_sec": 512.0, "mfu": 0.31,
+                 "loss": 3.1 - i * 0.1, "input_wait_s": 0.1}
+                for i in range(1, 8)
+            ] + [
+                {"ts": _iso(base, 11), "kind": "engine", "queue_depth": 4,
+                 "prefix_hit_rate": 0.8, "spec_accept_rate": 0.5},
+                {"ts": _iso(base, 12), "kind": "emitter", "dropped": 2, "write_errors": 0},
+                "not-a-dict",  # malformed entries are skipped, not fatal
+                {"kind": 123},
+            ]
+            n = await metrics_service.store_workload_points(api.db, job, points)
+            assert n == 12
+
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "wf-run"}
+            )
+            assert res["run_name"] == "wf-run"
+            assert res["latest"]["step"] == 8
+            assert res["latest"]["mfu"] == 0.31
+            assert res["engine"]["queue_depth"] == 4
+            assert res["dropped"] == 2
+            assert len(res["points"]) == 7
+            ledger = res["goodput"]
+            assert ledger["compile_s"] == 3.0
+            assert ledger["ratio"] is not None
+            # compile debited: wall 12s, productive 7*0.8-0.7
+            assert ledger["ratio"] < 0.6
+
+            await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "ghost"}, expect=404
+            )
+
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            families = parse_exposition(text)  # strict: every family well-formed
+            for fam in (
+                "dstack_tpu_run_mfu",
+                "dstack_tpu_run_tokens_per_sec",
+                "dstack_tpu_run_goodput_ratio",
+            ):
+                samples = families[fam]["samples"]
+                assert any(l == {"run": "wf-run"} for _, l, _ in samples), fam
+            hist = families["dstack_tpu_run_step_seconds"]["samples"]
+            counts = [v for nm, l, v in hist if nm.endswith("_count") and l.get("run") == "wf-run"]
+            assert counts == [7.0]
+
+            # Delete sweeps the DB points AND the per-run histogram series.
+            await api.db.execute("UPDATE runs SET status = 'done' WHERE id = 'wf'")
+            await api.db.execute("UPDATE jobs SET status = 'done' WHERE id = 'wfj'")
+            await api.post("/api/project/main/runs/delete", {"runs_names": ["wf-run"]})
+            left = await api.db.fetchone("SELECT COUNT(*) AS n FROM workload_metrics_points")
+            assert left["n"] == 0
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            assert 'dstack_tpu_run_step_seconds_bucket{le="0.005",run="wf-run"}' not in text
+
+    async def test_ttl_sweep_covers_workload_points(self):
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(api.db, proj, "tt", "ttj", jpd=False)
+            job = await api.db.fetchone("SELECT * FROM jobs WHERE id = 'ttj'")
+            old = now_utc() - datetime.timedelta(hours=2)
+            await metrics_service.store_workload_points(
+                api.db, job, [{"ts": to_iso(old), "kind": "step", "step_time_s": 1.0}]
+            )
+            await metrics_service.sweep_metrics(api.db)
+            left = await api.db.fetchone("SELECT COUNT(*) AS n FROM workload_metrics_points")
+            assert left["n"] == 0
+
+    async def test_gang_lead_lineage_only(self):
+        """A 2-host gang emits 2 copies of the step stream; the ledger and
+        step series must come from job 0 only (no 2x productive time)."""
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(api.db, proj, "g", "gj0", run_name="gang", job_num=0, jpd=False)
+            await _insert_running_job(api.db, proj, "g", "gj1", run_name="gang", job_num=1, jpd=False)
+            base = now_utc()
+            stream = [
+                {"ts": _iso(base, i), "kind": "step", "step": i, "step_time_s": 1.0}
+                for i in range(1, 5)
+            ]
+            for jid in ("gj0", "gj1"):
+                job = await api.db.fetchone("SELECT * FROM jobs WHERE id = ?", (jid,))
+                await metrics_service.store_workload_points(api.db, job, stream)
+            res = await api.post("/api/project/main/runs/get_metrics", {"run_name": "gang"})
+            assert res["goodput"]["productive_s"] == pytest.approx(4.0)
+            assert len(res["points"]) == 4
+            # The step histogram follows the same rule: 4 observations, not 8.
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            hist = families["dstack_tpu_run_step_seconds"]["samples"]
+            counts = [v for nm, l, v in hist
+                      if nm.endswith("_count") and l.get("run") == "gang"]
+            assert counts == [4.0]
+
+    async def test_goodput_gauge_spans_prior_submissions(self):
+        """/metrics goodput must include a preempted submission's lineage —
+        restart downtime is exactly what the gauge exists to show."""
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(api.db, proj, "pre", "prej0", run_name="pre-run", jpd=False)
+            # The preempted submission's job row is terminal, but its points remain.
+            await api.db.execute(
+                "INSERT INTO jobs (id, project_id, run_id, run_name, job_num,"
+                " replica_num, submission_num, job_spec, status, submitted_at)"
+                " VALUES ('preold', ?, 'pre', 'pre-run', 0, 0, 0, '{}', 'failed',"
+                " '2026-01-01')",
+                (proj["id"],),
+            )
+            base = now_utc() - datetime.timedelta(seconds=60)
+            old_job = await api.db.fetchone("SELECT * FROM jobs WHERE id = 'preold'")
+            await metrics_service.store_workload_points(api.db, old_job, [
+                {"ts": _iso(base, 0), "kind": "mark", "event": "run_start"},
+                {"ts": _iso(base, 1), "kind": "step", "step": 2, "step_time_s": 1.0},
+            ])
+            new_job = await api.db.fetchone("SELECT * FROM jobs WHERE id = 'prej0'")
+            await metrics_service.store_workload_points(api.db, new_job, [
+                # 20s restart gap before the new process came up.
+                {"ts": _iso(base, 21), "kind": "mark", "event": "run_start"},
+                {"ts": _iso(base, 22), "kind": "step", "step": 2, "step_time_s": 1.0},
+            ])
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            gauges = families["dstack_tpu_run_goodput_ratio"]["samples"]
+            val = next(v for _, l, v in gauges if l.get("run") == "pre-run")
+            # 2s productive over a 22s wall: the restart gap debits the gauge.
+            assert val == pytest.approx(2.0 / 22.0, abs=1e-3)
+
+
+class TestProfileEndpoint:
+    async def test_profile_routes_to_running_jobs_agent(self, monkeypatch):
+        acks = []
+
+        class FakeAgent:
+            async def profile(self, seconds=5.0):
+                acks.append(seconds)
+                return {"id": 3, "seconds": seconds, "status": "requested",
+                        "artifact_dir": "/agent/telemetry/profile/3"}
+
+        monkeypatch.setattr(
+            metrics_service, "get_runner_client", lambda jpd, jrd: FakeAgent()
+        )
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(api.db, proj, "pf", "pfj", run_name="pf-run")
+            res = await api.post(
+                "/api/project/main/runs/profile", {"run_name": "pf-run", "seconds": 2.5}
+            )
+            assert acks == [2.5]
+            assert res["artifact_dir"] == "/agent/telemetry/profile/3"
+            assert res["job_num"] == 0
+
+    async def test_profile_without_running_job_is_client_error(self):
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await api.db.execute(
+                "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+                " status, run_spec) VALUES ('nr', ?, ?, 'idle-run', '2026-01-01', 'done', '{}')",
+                (proj["id"], proj["owner_id"]),
+            )
+            await api.post(
+                "/api/project/main/runs/profile", {"run_name": "idle-run"}, expect=400
+            )
+            await api.post(
+                "/api/project/main/runs/profile", {"run_name": "nope"}, expect=404
+            )
+
+
+# ---------------------------------------------------------------------------
+# End to end through the REAL C++ agent (local backend, host exec)
+
+
+pytestmark_e2e = pytest.mark.skipif(
+    find_runner_binary() is None, reason="native runner binary unavailable"
+)
+
+_PROFILE_JOB = """\
+import os, time
+from dstack_tpu.workloads.telemetry import TelemetryEmitter
+class P:
+    def __init__(self): self.stopped = False
+    def start(self, d):
+        os.makedirs(d, exist_ok=True)
+        open(os.path.join(d, "trace.data"), "w").write("job-trace")
+    def stop(self): self.stopped = True
+p = P()
+e = TelemetryEmitter(os.environ["DSTACK_TPU_TELEMETRY_PATH"], flush_interval=0.1, profiler=p)
+e.mark("run_start", workload="profile-e2e")
+t0 = time.time()
+i = 0
+while time.time() - t0 < 45:
+    i += 1
+    e.step(i, 0.05, tokens_per_sec=100.0)
+    time.sleep(0.05)
+    if p.stopped:
+        time.sleep(0.5)  # let the profile_end mark flush
+        break
+e.mark("run_end")
+e.close()
+"""
+
+
+async def _drive_collect(api, run_name, until, timeout=90.0):
+    """Collect + scheduler passes until `until(run_json)` or terminal state.
+    Collection runs FIRST each round so the final sidecar flush is tailed
+    while the job row still says running."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    run = None
+    while asyncio.get_event_loop().time() < deadline:
+        await metrics_service.collect_job_metrics(api.db)
+        await tasks.process_submitted_jobs(api.db)
+        await tasks.process_running_jobs(api.db)
+        await tasks.process_terminating_jobs(api.db)
+        await tasks.process_runs(api.db)
+        await tasks.process_instances(api.db)
+        run = await api.post("/api/project/main/runs/get", {"run_name": run_name})
+        if until(run):
+            return run
+        if run["status"] in ("failed", "terminated", "done"):
+            return run
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"timed out; run is {run and run['status']}")
+
+
+def _repo_root() -> str:
+    import dstack_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(dstack_tpu.__file__)))
+
+
+@pytestmark_e2e
+class TestE2EWorkloadTelemetry:
+    async def test_train_telemetry_reaches_server_through_agent(self):
+        """The acceptance path: a real train workload on the real agent; step
+        points, MFU, goodput (with the compile stall debited) all land."""
+        async with api_server() as api:
+            spec = {
+                "run_spec": {
+                    "run_name": "e2e-telemetry",
+                    "configuration": {
+                        "type": "task",
+                        "commands": [
+                            "python3 -m dstack_tpu.workloads.train"
+                            " --config test --steps 12 --batch 2 --seq 32"
+                        ],
+                        "env": {
+                            "PYTHONPATH": _repo_root(),
+                            "JAX_PLATFORMS": "cpu",
+                            "DSTACK_TPU_OVERLAP_FLAGS": "0",
+                        },
+                    },
+                }
+            }
+            await api.post("/api/project/main/runs/submit", spec)
+            run = await _drive_collect(
+                api, "e2e-telemetry", lambda r: r["status"] == "done", timeout=150
+            )
+            assert run["status"] == "done", run["status"]
+
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "e2e-telemetry"}
+            )
+            assert res["latest"] is not None, res
+            assert res["latest"]["step"] == 12
+            assert res["latest"]["tokens_per_sec"] > 0
+            assert res["latest"]["mfu"] is not None
+            ledger = res["goodput"]
+            assert ledger["steps"] == 11  # first step is the compile
+            assert ledger["compile_s"] > 0, "compile stall must be debited"
+            assert ledger["ratio"] is not None
+
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            parse_exposition(text)
+            assert 'dstack_tpu_run_step_seconds_count{run="e2e-telemetry"}' in text
+
+    async def test_profile_roundtrip_produces_artifact(self):
+        """dstack-tpu profile end to end: server -> agent control file -> the
+        live workload's emitter -> trace artifact on the runner host -> the
+        profile_end mark back through the metrics channel."""
+        async with api_server() as api:
+            spec = {
+                "run_spec": {
+                    "run_name": "e2e-profile",
+                    "configuration": {
+                        "type": "task",
+                        "commands": [f"python3 -c '{_PROFILE_JOB}'"],
+                        "env": {"PYTHONPATH": _repo_root()},
+                    },
+                }
+            }
+            await api.post("/api/project/main/runs/submit", spec)
+            # Wait for the workload to be alive and emitting.
+            await _drive_collect(
+                api, "e2e-profile",
+                lambda r: r["status"] == "running", timeout=60,
+            )
+
+            deadline = asyncio.get_event_loop().time() + 30
+            while asyncio.get_event_loop().time() < deadline:
+                await metrics_service.collect_job_metrics(api.db)
+                res = await api.post(
+                    "/api/project/main/runs/get_metrics", {"run_name": "e2e-profile"}
+                )
+                if res["latest"] is not None:  # the workload is alive and emitting
+                    break
+                await asyncio.sleep(0.2)
+
+            ack = await api.post(
+                "/api/project/main/runs/profile",
+                {"run_name": "e2e-profile", "seconds": 0.5},
+            )
+            assert ack["status"] == "requested"
+            artifact_dir = ack["artifact_dir"]
+
+            deadline = asyncio.get_event_loop().time() + 45
+            mark = None
+            while asyncio.get_event_loop().time() < deadline:
+                await metrics_service.collect_job_metrics(api.db)
+                await tasks.process_running_jobs(api.db)
+                res = await api.post(
+                    "/api/project/main/runs/get_metrics", {"run_name": "e2e-profile"}
+                )
+                mark = res.get("profile")
+                if mark and mark.get("event") == "profile_end":
+                    break
+                await asyncio.sleep(0.3)
+            assert mark and mark["event"] == "profile_end", f"no profile_end mark: {mark}"
+            # Host jobs: the workload's artifact path IS the host path the
+            # agent advertised, and the trace is retrievable there.
+            assert mark["artifact"] == artifact_dir
+            assert os.path.exists(os.path.join(artifact_dir, "trace.data"))
+
+            # Teardown: stop the run (it would otherwise loop for its full 45s).
+            await api.post(
+                "/api/project/main/runs/stop",
+                {"runs_names": ["e2e-profile"], "abort": True},
+            )
+            await _drive_collect(
+                api, "e2e-profile",
+                lambda r: r["status"] in ("terminated", "aborted", "failed", "done"),
+                timeout=30,
+            )
