@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels smoke-observability smoke-serve release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels bench-preemption smoke-observability smoke-serve smoke-preemption release publish clean
 
 all: runner wheel
 
@@ -71,6 +71,24 @@ bench-serve:
 bench-kernels:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  python -c "import json, bench; print(json.dumps(bench.bench_kernels()))"
+
+# Preemption/goodput bench: a live train loop is killed at fixed steps;
+# checkpoint+resume vs restart-from-step-0, both through the server's goodput
+# ledger. One JSON line — value is the goodput uplift (x); FAILS (non-zero
+# exit) if a resumed loss ever diverges from the uninterrupted reference or
+# the uplift lands under the 1.5x acceptance floor.
+bench-preemption:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -c "import json, bench; print(json.dumps(bench.bench_preemption()))"
+
+# Elastic-training smoke: boots the server, drives a REAL train run through
+# the native agent with async checkpointing, kills the workload mid-run, and
+# asserts the rescue end to end — gang_retry in run_events, the resumed
+# attempt continuing from the last checkpoint (not step 0), restart_s in the
+# goodput ledger, and the recovery histogram on /metrics. Non-zero exit on
+# any missing piece.
+smoke-preemption:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_preemption()"
 
 # Observability smoke: boots the server in-process, drives one run through the
 # full FSM, and asserts the events timeline + /metrics histograms are live.

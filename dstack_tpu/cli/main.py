@@ -388,12 +388,14 @@ def cmd_logs(args) -> None:
 
 
 def _fmt_goodput(ledger: dict) -> str:
-    """One-line goodput attribution: `93.1% (compile 12s input 3s restart 0s)`."""
+    """One-line goodput attribution:
+    `93.1% (compile 12s, checkpoint 2s, restart 40s, rework 31s)`."""
     if not ledger or ledger.get("ratio") is None:
         return "-"
     parts = []
     for key, label in (("compile_s", "compile"), ("input_wait_s", "input"),
-                       ("restart_s", "restart"), ("other_s", "other")):
+                       ("checkpoint_s", "checkpoint"), ("restart_s", "restart"),
+                       ("rework_s", "rework"), ("other_s", "other")):
         v = ledger.get(key) or 0.0
         if v >= 0.05:
             parts.append(f"{label} {_fmt_secs(v)}")

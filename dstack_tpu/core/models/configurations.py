@@ -167,6 +167,16 @@ class TaskConfiguration(BaseRunConfiguration):
     ports: List[PortMapping] = Field(default_factory=list)
     startup_order: StartupOrder = StartupOrder.ANY
     stop_criteria: StopCriteria = StopCriteria.ALL_DONE
+    elastic: Optional[List[str]] = Field(
+        default=None,
+        description=(
+            "Alternative TPU slice topologies (e.g. [v5e-8, v5e-4]) a gang"
+            " retry may resubmit onto when the original slice is preempted or"
+            " out of capacity — tried in order, wrapping. The workload must"
+            " tolerate the topology change (checkpoint + --resume re-shards"
+            " state on load)."
+        ),
+    )
 
     @model_validator(mode="after")
     def _check(self):
@@ -175,6 +185,13 @@ class TaskConfiguration(BaseRunConfiguration):
                 "task requires `commands` (or `entrypoint`, or an `image` whose own"
                 " entrypoint runs the job)"
             )
+        if self.elastic:
+            from dstack_tpu.core.models.resources import TpuSliceSpec
+
+            if self.resources.tpu is None:
+                raise ValueError("`elastic` requires a `resources.tpu` request")
+            for topo in self.elastic:
+                TpuSliceSpec.model_validate(topo)  # fail at submit, not at rescue
         return self
 
 

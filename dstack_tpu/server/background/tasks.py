@@ -1042,12 +1042,21 @@ async def process_runs(db: Database, batch: Optional[int] = None) -> None:
 
 
 def _latest_submissions(job_rows: List) -> Dict[Tuple[int, int], object]:
+    """The live gang view: each replica's LATEST submission's jobs only.
+
+    Per-replica (not per-(replica, job)) because an elastic gang retry may
+    resubmit onto a topology with a different host count — a shrunk gang must
+    not leave the old submission's extra job_nums haunting the aggregation as
+    phantom failures (they'd re-trigger retry against the healthy new gang)."""
+    max_sub: Dict[int, int] = {}
+    for r in job_rows:
+        n = r["replica_num"]
+        if r["submission_num"] > max_sub.get(n, -1):
+            max_sub[n] = r["submission_num"]
     latest: Dict[Tuple[int, int], object] = {}
     for r in job_rows:
-        key = (r["replica_num"], r["job_num"])
-        cur = latest.get(key)
-        if cur is None or r["submission_num"] > cur["submission_num"]:
-            latest[key] = r
+        if r["submission_num"] == max_sub[r["replica_num"]]:
+            latest[(r["replica_num"], r["job_num"])] = r
     return latest
 
 
@@ -1195,6 +1204,7 @@ async def _maybe_retry_replica(
     retry = profile.retry
     if retry is None:
         return False
+    capacity_failure = True  # every failure is a lost/unobtainable slice
     for r in failed:
         reason = (
             JobTerminationReason(r["termination_reason"]) if r["termination_reason"] else None
@@ -1202,6 +1212,8 @@ async def _maybe_retry_replica(
         event = _REASON_TO_RETRY_EVENT.get(reason)
         if event is None or event not in retry.on_events:
             return False
+        if event not in (RetryEvent.NO_CAPACITY, RetryEvent.INTERRUPTION):
+            capacity_failure = False
     # Duration window is anchored at the replica's FIRST submission (submission_num 0),
     # not the latest resubmission — otherwise every retry would reset the clock.
     first_row = await db.fetchone(
@@ -1229,22 +1241,52 @@ async def _maybe_retry_replica(
         return True  # backoff window
 
     now = to_iso(now_utc())
+    replica_num = replica_rows[0]["replica_num"]
+    # Elastic rescue: when every failure is a capacity event (preempted slice
+    # or stockout) and the run declares elastic topology bounds, rebuild the
+    # gang's job specs for the next topology in the list — tried in order,
+    # wrapping — instead of requeueing for hardware that may stay gone. The
+    # gang size follows the new host count; the workload re-shards its
+    # checkpoint on resume (workloads/checkpoint.py).
+    spec_rows = [(r["job_num"], r["job_spec"]) for r in replica_rows]
+    topo_msg = None
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    elastic = getattr(run_spec.configuration, "elastic", None)
+    if elastic and capacity_failure:
+        from dstack_tpu.core.models.resources import TpuSliceSpec
+        from dstack_tpu.server.services.jobs.configurators import get_job_specs
+
+        topo = elastic[submission_num % len(elastic)]
+        try:
+            respec = run_spec.model_copy(deep=True)
+            respec.configuration.resources.tpu = TpuSliceSpec.model_validate(topo)
+            spec_rows = [
+                (s.job_num, s.model_dump_json())
+                for s in get_job_specs(respec, replica_num=replica_num)
+            ]
+            topo_msg = f"elastic retry onto {topo}"
+        except Exception:
+            logger.exception(
+                "run %s: elastic topology %r rejected; retrying original gang",
+                run_row["run_name"], topo,
+            )
+            spec_rows = [(r["job_num"], r["job_spec"]) for r in replica_rows]
     # One transaction: the resubmitted gang (and its lifecycle events) appears
     # whole or not at all (a partial gang would deadlock the slice-atomic
     # placement forever).
     gang = [
         (
             new_id(),
-            r["project_id"],
-            r["run_id"],
-            r["run_name"],
-            r["job_num"],
-            r["replica_num"],
+            replica_rows[0]["project_id"],
+            run_row["id"],
+            run_row["run_name"],
+            job_num,
+            replica_num,
             submission_num + 1,
-            r["job_spec"],
+            spec_json,
             now,
         )
-        for r in replica_rows
+        for job_num, spec_json in spec_rows
     ]
 
     def _resubmit(conn) -> None:
@@ -1257,13 +1299,14 @@ async def _maybe_retry_replica(
         for g in gang:
             events_service.record_event_tx(
                 conn, g[2], "submitted", job_id=g[0],
-                actor="scheduler", reason="gang_retry",
+                actor="scheduler", reason="gang_retry", message=topo_msg,
             )
 
     await db.run(_resubmit)
     logger.info(
-        "run %s: retrying replica %s (submission %s)",
-        run_row["run_name"], replica_rows[0]["replica_num"], submission_num + 1,
+        "run %s: retrying replica %s (submission %s%s)",
+        run_row["run_name"], replica_num, submission_num + 1,
+        f", {topo_msg}" if topo_msg else "",
     )
     return True
 

@@ -57,7 +57,7 @@ def record_event_tx(
         # the cold-start histogram, labeled by whether the service was at
         # zero (that's the latency a scale-to-zero policy trades away).
         first_sub = conn.execute(
-            "SELECT timestamp, actor, reason FROM run_events WHERE job_id = ?"
+            "SELECT timestamp, actor, reason, seq FROM run_events WHERE job_id = ?"
             " AND new_status = 'submitted' ORDER BY seq LIMIT 1",
             (job_id,),
         ).fetchone()
@@ -68,6 +68,38 @@ def record_event_tx(
                     "dstack_tpu_service_cold_start_seconds",
                     elapsed,
                     {"from_zero": str(first_sub["reason"] == "scale_from_zero").lower()},
+                )
+        if first_sub is not None and first_sub["reason"] == "gang_retry":
+            # Preemption rescue closing the loop: a gang-retried replica back
+            # at `running` is the run making progress again. Time-to-recover
+            # is anchored at the moment the failure was DETECTED (the prior
+            # submission's first job leaving the running set), not at the
+            # resubmit — teardown, backoff, and re-placement all count.
+            # Lead job only: a gang's N hosts reach running N times but the
+            # replica recovered once (the run_step_seconds lesson).
+            lead = conn.execute(
+                "SELECT job_num FROM jobs WHERE id = ?", (job_id,)
+            ).fetchone()
+            if lead is not None and lead["job_num"] != 0:
+                first_sub = None
+        if first_sub is not None and first_sub["reason"] == "gang_retry":
+            anchor = conn.execute(
+                "SELECT timestamp FROM run_events WHERE run_id = ?"
+                " AND new_status IN ('terminating', 'failed', 'aborted')"
+                " AND job_id IS NOT NULL AND seq < ?"
+                " ORDER BY seq DESC LIMIT 1",
+                (run_id, first_sub["seq"]),
+            ).fetchone()
+            base_ts = anchor["timestamp"] if anchor is not None else first_sub["timestamp"]
+            elapsed = (now - from_iso(base_ts)).total_seconds()
+            if elapsed >= 0:
+                name_row = conn.execute(
+                    "SELECT run_name FROM runs WHERE id = ?", (run_id,)
+                ).fetchone()
+                tracing.observe(
+                    "dstack_tpu_run_recovery_seconds",
+                    elapsed,
+                    {"run": name_row["run_name"] if name_row is not None else ""},
                 )
     if job_id is not None and old_status in _PHASE_HISTOGRAMS:
         prev = conn.execute(

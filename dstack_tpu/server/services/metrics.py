@@ -150,7 +150,11 @@ def compute_goodput(points: List[dict]) -> Dict[str, Optional[float]]:
     """The goodput ledger over one job-lineage's telemetry points.
 
     ``ratio`` = productive step time / wall clock, where wall is the span from
-    the first to the last point. The non-productive remainder is attributed:
+    the first to the last point. *Productive* means **net forward progress**:
+    a step whose number is not past the furthest step already seen (a restart
+    that resumed from an old checkpoint — or from step 0 — re-doing work) is
+    ``rework_s``, not productive; this is exactly the wall-clock waste the
+    preemption benches measure. The non-productive remainder is attributed:
 
     * ``compile_s``    — time inside compile_start→compile_end marks (the
       compile_end's own measured ``compile_s`` wins when present, because the
@@ -161,14 +165,20 @@ def compute_goodput(points: List[dict]) -> Dict[str, Optional[float]]:
     * ``restart_s``    — downtime between the last point of one process and
       the next process's ``run_start``/``restart`` mark (preemption →
       reschedule → re-init shows up exactly here).
-    * ``other_s``      — whatever remains (checkpoint stalls, eval pauses,
-      emitter gaps).
+    * ``checkpoint_s`` — train-thread stalls inside checkpoint_start→
+      checkpoint_end marks (the end mark's measured ``blocked_s`` wins; the
+      async storage write deliberately does NOT count — only the time the
+      step loop actually stood still).
+    * ``rework_s``     — step time spent re-running steps a previous attempt
+      had already completed (restart-from-behind-the-frontier).
+    * ``other_s``      — whatever remains (eval pauses, emitter gaps).
 
     Returns ratio=None when there is no wall clock to divide by (fewer than
     two points) or no step points at all (e.g. a serving engine)."""
     zeros = {
         "ratio": None, "wall_s": 0.0, "productive_s": 0.0, "compile_s": 0.0,
-        "input_wait_s": 0.0, "restart_s": 0.0, "other_s": 0.0, "steps": 0,
+        "input_wait_s": 0.0, "restart_s": 0.0, "checkpoint_s": 0.0,
+        "rework_s": 0.0, "other_s": 0.0, "steps": 0,
     }
     parsed = []
     for p in points:
@@ -182,19 +192,36 @@ def compute_goodput(points: List[dict]) -> Dict[str, Optional[float]]:
     first_ts, last_ts = parsed[0][0], parsed[-1][0]
     wall = (last_ts - first_ts).total_seconds()
 
-    productive = input_wait = compile_s = restart = 0.0
+    productive = input_wait = compile_s = restart = checkpoint_s = rework = 0.0
     steps = 0
+    frontier: Optional[float] = None  # furthest step number seen so far
     compile_open: Optional[datetime.datetime] = None
+    checkpoint_open: Optional[datetime.datetime] = None
     prev_ts: Optional[datetime.datetime] = None
     for t, p in parsed:
         kind = p.get("kind")
         if kind == "step":
             try:
-                productive += float(p.get("step_time_s") or 0.0)
-                input_wait += float(p.get("input_wait_s") or 0.0)
+                step_time = float(p.get("step_time_s") or 0.0)
+                wait = float(p.get("input_wait_s") or 0.0)
             except (TypeError, ValueError):
                 continue
-            steps += 1
+            step_num = p.get("step")
+            redone = (
+                isinstance(step_num, (int, float))
+                and frontier is not None
+                and step_num <= frontier
+            )
+            if redone:
+                # Forward progress already reached this step once; re-doing
+                # it is wasted hardware time, not goodput.
+                rework += step_time
+            else:
+                productive += step_time
+                input_wait += wait
+                steps += 1
+                if isinstance(step_num, (int, float)):
+                    frontier = max(frontier or 0.0, float(step_num))
         elif kind == "mark":
             event = p.get("event")
             if event == "compile_start":
@@ -209,20 +236,36 @@ def compute_goodput(points: List[dict]) -> Dict[str, Optional[float]]:
                 elif compile_open is not None:
                     compile_s += (t - compile_open).total_seconds()
                 compile_open = None
+            elif event == "checkpoint_start":
+                checkpoint_open = t
+            elif event == "checkpoint_end":
+                try:
+                    measured = float(p.get("blocked_s"))
+                except (TypeError, ValueError):
+                    measured = None
+                if measured is not None:
+                    checkpoint_s += measured
+                elif checkpoint_open is not None:
+                    checkpoint_s += (t - checkpoint_open).total_seconds()
+                checkpoint_open = None
             elif event in ("run_start", "restart") and prev_ts is not None:
                 restart += max(0.0, (t - prev_ts).total_seconds())
         prev_ts = t
     if compile_open is not None:  # still compiling at the window's edge
         compile_s += (last_ts - compile_open).total_seconds()
+    if checkpoint_open is not None:  # mid-checkpoint at the window's edge
+        checkpoint_s += (last_ts - checkpoint_open).total_seconds()
 
     productive = max(0.0, productive - input_wait)
-    attributed = productive + compile_s + input_wait + restart
+    attributed = productive + compile_s + input_wait + restart + checkpoint_s + rework
     out = {
         "wall_s": round(wall, 4),
         "productive_s": round(productive, 4),
         "compile_s": round(compile_s, 4),
         "input_wait_s": round(input_wait, 4),
         "restart_s": round(restart, 4),
+        "checkpoint_s": round(checkpoint_s, 4),
+        "rework_s": round(rework, 4),
         "other_s": round(max(0.0, wall - attributed), 4),
         "steps": steps,
         "ratio": None,

@@ -71,6 +71,7 @@ _HISTOGRAM_HELP = {
     "dstack_tpu_backend_create_slice_seconds": "Cloud slice provisioning call time",
     "dstack_tpu_ssh_tunnel_open_seconds": "SSH tunnel establishment time",
     "dstack_tpu_run_step_seconds": "Workload-reported training step wall time by run",
+    "dstack_tpu_run_recovery_seconds": "Preemption rescue time-to-recover (failure detected -> gang-retried replica running) by run",
 }
 
 

@@ -46,17 +46,24 @@ def synthetic_batches(
     seed: int = 0,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    start_batch: int = 0,
 ) -> Iterator[Batch]:
     """Endless stream of random-token batches; each host draws only its own
-    rows (the per-host generator is seeded by (seed, process_index) so shards
-    are distinct but every host's stream is reproducible)."""
+    rows. The stream is *seekable*: batch b is generated from its own
+    (seed, process_index, b)-seeded generator, so a resumed run passing
+    ``start_batch`` (the checkpoint manifest's data offset) sees exactly the
+    batches an uninterrupted run would — per-batch seeding costs nothing and
+    is what makes O(1) seek possible (a sequential generator would need to
+    draw-and-discard its way back to the offset)."""
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
     _, rows = host_shard(global_batch, pi, pc)
-    rng = np.random.default_rng((seed, pi))
+    b = start_batch
     while True:
+        rng = np.random.default_rng((seed, pi, b))
         tokens = rng.integers(0, vocab_size, (rows, seq), dtype=np.int32)
         yield tokens, tokens
+        b += 1
 
 
 def token_file_batches(
@@ -67,12 +74,15 @@ def token_file_batches(
     loop: bool = True,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    start_batch: int = 0,
 ) -> Iterator[Batch]:
     """Batches from a flat binary file of token ids (np.memmap — the file is
     never loaded whole). Windows of seq+1 tokens give (tokens, next-token
     targets). Hosts stride the corpus disjointly: window w belongs to the host
     where (w // rows_per_host) % process_count lands, so a pass covers the file
-    once across the fleet."""
+    once across the fleet. ``start_batch`` seeks: batch b always maps to the
+    same file windows ((b mod batches_per_pass) * global_batch), so a resumed
+    run neither replays nor skips data."""
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
     _, rows = host_shard(global_batch, pi, pc)
@@ -84,19 +94,18 @@ def token_file_batches(
             f"{path}: {len(data)} tokens = {n_windows} windows of {window}; "
             f"need at least {global_batch} for one global batch"
         )
-    w = 0
+    per_pass = n_windows // global_batch
+    b = start_batch
     while True:
         # Each global batch consumes `global_batch` consecutive windows; this
         # host takes the `rows` of them at offset process_index * rows.
-        if w + global_batch > n_windows:
-            if not loop:
-                return
-            w = 0
-        start = w + pi * rows
+        if not loop and b >= per_pass:
+            return
+        start = (b % per_pass) * global_batch + pi * rows
         idx = np.arange(start, start + rows) * window
         chunk = np.stack([data[i : i + window] for i in idx]).astype(np.int32)
         yield chunk[:, :-1], chunk[:, 1:]
-        w += global_batch
+        b += 1
 
 
 def make_global_array(
@@ -227,11 +236,18 @@ def input_pipeline(
     data_path: Optional[str] = None,
     prefetch: int = 2,
     seed: int = 0,
+    start_batch: int = 0,
 ) -> Prefetcher:
     """The train entrypoint's one-call feed: pick the source (token file or
-    synthetic), shard per host, wrap in the prefetcher."""
+    synthetic), shard per host, wrap in the prefetcher. ``start_batch`` seeks
+    both sources to the checkpoint manifest's data offset on resume (one
+    global batch is consumed per optimizer step, so offset == step)."""
     if data_path:
-        source: Iterator[Batch] = token_file_batches(data_path, global_batch, seq)
+        source: Iterator[Batch] = token_file_batches(
+            data_path, global_batch, seq, start_batch=start_batch
+        )
     else:
-        source = synthetic_batches(vocab_size, global_batch, seq, seed=seed)
+        source = synthetic_batches(
+            vocab_size, global_batch, seq, seed=seed, start_batch=start_batch
+        )
     return Prefetcher(sharded_batches(source, mesh, spec, global_batch), depth=prefetch)

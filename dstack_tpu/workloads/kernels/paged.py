@@ -5,10 +5,17 @@ page table into a dense [S, P·page, Kh, D] tensor each step — on TPU that is 
 full HBM materialization of the padded KV window per layer per token. This
 kernel walks each slot's page list directly: pages stay in HBM, each one is
 DMA'd into a VMEM scratch buffer exactly once, and the online softmax
-accumulates per page, so the working set is one page instead of the whole
+accumulates per page, so the working set is two pages instead of the whole
 padded window. Page ids and KV lengths ride the scalar-prefetch lane
 (``PrefetchScalarGridSpec``) so the DMA addresses are known before the body
 runs.
+
+The page walk is **double-buffered**: two VMEM scratch slots per stream, and
+the copy for page i+1 starts *before* the body waits on (and computes over)
+page i, so the HBM->VMEM hop for the next page hides under the current page's
+dot products instead of serializing copy-wait-compute per page (ROADMAP item
+4's leftover). Semantics are untouched — the same pages land in the same
+order; only the wait moves.
 
 Semantics are identical to the XLA reference (tests assert token-identity
 through the engine, preemption included): slots attend to their first
@@ -29,11 +36,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _page_dma(pages_ref, scratch, sems, page_id, buf):
+    """The (re)constructible descriptor for one page's HBM->VMEM copy into
+    scratch slot ``buf``. Pallas async copies are started and awaited through
+    an identical descriptor, so the double-buffer loop rebuilds it on both
+    sides of the overlap window."""
+    return pltpu.make_async_copy(pages_ref.at[page_id], scratch.at[buf], sems.at[buf])
+
+
 def _paged_kernel(page_table_ref, kv_lens_ref, q_ref, k_pages_ref,
                   v_pages_ref, o_ref, k_scratch, v_scratch, sems, *,
                   page: int, n_rep: int):
     """One program per decode slot. q [1, H, D]; k/v pages stay in HBM and are
-    DMA'd per page; out [1, H, D] fp32."""
+    DMA'd per page into alternating scratch slots (copy for page i+1 in
+    flight while page i computes); out [1, H, D] fp32."""
     slot = pl.program_id(0)
     kh, d = k_pages_ref.shape[2], k_pages_ref.shape[3]
     kv_len = kv_lens_ref[slot]
@@ -42,21 +58,28 @@ def _paged_kernel(page_table_ref, kv_lens_ref, q_ref, k_pages_ref,
     q = q_ref[0].astype(jnp.float32).reshape(kh, n_rep, d)
     scale = 1.0 / (d ** 0.5)
 
+    @pl.when(n_pages > 0)
+    def _prime():  # stage page 0 into slot 0 before the walk begins
+        pid0 = page_table_ref[slot, 0]
+        _page_dma(k_pages_ref, k_scratch, sems.at[0], pid0, 0).start()
+        _page_dma(v_pages_ref, v_scratch, sems.at[1], pid0, 0).start()
+
     def body(p_idx, carry):
         o, l, m = carry
         page_id = page_table_ref[slot, p_idx]
-        k_dma = pltpu.make_async_copy(
-            k_pages_ref.at[page_id], k_scratch, sems.at[0]
-        )
-        v_dma = pltpu.make_async_copy(
-            v_pages_ref.at[page_id], v_scratch, sems.at[1]
-        )
-        k_dma.start()
-        v_dma.start()
-        k_dma.wait()
-        v_dma.wait()
-        k_blk = k_scratch[...].astype(jnp.float32)  # [page, Kh, D]
-        v_blk = v_scratch[...].astype(jnp.float32)
+        buf = jax.lax.rem(p_idx, 2)
+
+        @pl.when(p_idx + 1 < n_pages)
+        def _start_next():  # overlap: page i+1's DMA rides under page i's math
+            nxt = page_table_ref[slot, p_idx + 1]
+            nbuf = jax.lax.rem(p_idx + 1, 2)
+            _page_dma(k_pages_ref, k_scratch, sems.at[0], nxt, nbuf).start()
+            _page_dma(v_pages_ref, v_scratch, sems.at[1], nxt, nbuf).start()
+
+        _page_dma(k_pages_ref, k_scratch, sems.at[0], page_id, buf).wait()
+        _page_dma(v_pages_ref, v_scratch, sems.at[1], page_id, buf).wait()
+        k_blk = k_scratch[buf].astype(jnp.float32)  # [page, Kh, D]
+        v_blk = v_scratch[buf].astype(jnp.float32)
         # s[kh, n_rep, page]: contract D per KV head group.
         s = jax.lax.dot_general(
             q, k_blk, (((2,), (2,)), ((0,), (1,))),
@@ -115,9 +138,10 @@ def paged_decode_attention_pallas(
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((page, kh, d), k_pages.dtype),
-            pltpu.VMEM((page, kh, d), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            # Two slots per stream: page i computes while page i+1 copies.
+            pltpu.VMEM((2, page, kh, d), k_pages.dtype),
+            pltpu.VMEM((2, page, kh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
     kernel = functools.partial(_paged_kernel, page=page, n_rep=n_rep)
@@ -134,10 +158,11 @@ def _paged_chunk_kernel(page_table_ref, kv_lens_ref, starts_ref, q_ref,
                         k_pages_ref, v_pages_ref, o_ref, k_scratch, v_scratch,
                         sems, *, page: int, n_rep: int, chunk: int):
     """One program per slot, C chunk queries at positions starts[s]..+C-1.
-    q [1, C, H, D]; pages stay in HBM, DMA'd per page; out [1, C, H, D] fp32.
-    Query i attends causally through its own position (its K/V already
-    scattered into the pages), so the decode kernel above is the C == 1
-    special case of this accumulation."""
+    q [1, C, H, D]; pages stay in HBM, DMA'd per page into alternating
+    scratch slots (same double-buffered walk as the decode kernel);
+    out [1, C, H, D] fp32. Query i attends causally through its own position
+    (its K/V already scattered into the pages), so the decode kernel above is
+    the C == 1 special case of this accumulation."""
     slot = pl.program_id(0)
     kh, d = k_pages_ref.shape[2], k_pages_ref.shape[3]
     kv_len = kv_lens_ref[slot]
@@ -153,21 +178,28 @@ def _paged_chunk_kernel(page_table_ref, kv_lens_ref, starts_ref, q_ref,
         jnp.int32, (kh, chunk * n_rep, page), 1
     ) // n_rep
 
+    @pl.when(n_pages > 0)
+    def _prime():
+        pid0 = page_table_ref[slot, 0]
+        _page_dma(k_pages_ref, k_scratch, sems.at[0], pid0, 0).start()
+        _page_dma(v_pages_ref, v_scratch, sems.at[1], pid0, 0).start()
+
     def body(p_idx, carry):
         o, l, m = carry
         page_id = page_table_ref[slot, p_idx]
-        k_dma = pltpu.make_async_copy(
-            k_pages_ref.at[page_id], k_scratch, sems.at[0]
-        )
-        v_dma = pltpu.make_async_copy(
-            v_pages_ref.at[page_id], v_scratch, sems.at[1]
-        )
-        k_dma.start()
-        v_dma.start()
-        k_dma.wait()
-        v_dma.wait()
-        k_blk = k_scratch[...].astype(jnp.float32)  # [page, Kh, D]
-        v_blk = v_scratch[...].astype(jnp.float32)
+        buf = jax.lax.rem(p_idx, 2)
+
+        @pl.when(p_idx + 1 < n_pages)
+        def _start_next():
+            nxt = page_table_ref[slot, p_idx + 1]
+            nbuf = jax.lax.rem(p_idx + 1, 2)
+            _page_dma(k_pages_ref, k_scratch, sems.at[0], nxt, nbuf).start()
+            _page_dma(v_pages_ref, v_scratch, sems.at[1], nxt, nbuf).start()
+
+        _page_dma(k_pages_ref, k_scratch, sems.at[0], page_id, buf).wait()
+        _page_dma(v_pages_ref, v_scratch, sems.at[1], page_id, buf).wait()
+        k_blk = k_scratch[buf].astype(jnp.float32)  # [page, Kh, D]
+        v_blk = v_scratch[buf].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((2,), (2,)), ((0,), (1,))),
             preferred_element_type=jnp.float32,
@@ -224,9 +256,9 @@ def paged_chunk_attention_pallas(
         ],
         out_specs=pl.BlockSpec((1, c, h, d), lambda i, *_: (i, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((page, kh, d), k_pages.dtype),
-            pltpu.VMEM((page, kh, d), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, page, kh, d), k_pages.dtype),
+            pltpu.VMEM((2, page, kh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
     kernel = functools.partial(

@@ -31,7 +31,9 @@ Point schema (one JSON object per line, all optional but ``ts``/``kind``):
 * ``kind="engine"`` — serving engine gauges: ``queue_depth``, ``active``,
   ``generated_tokens``, ``prefix_hit_rate``, ``spec_accept_rate``, ...
 * ``kind="mark"``  — lifecycle: ``event`` in {``run_start``, ``compile_start``,
-  ``compile_end``, ``checkpoint``, ``restart``, ``run_end``,
+  ``compile_end``, ``checkpoint_start``, ``checkpoint_end`` (carries the
+  measured ``blocked_s`` — the only time the train thread stalled),
+  ``checkpoint_saved``, ``checkpoint_error``, ``restart``, ``run_end``,
   ``profile_start``, ``profile_end``, ``profile_error``} plus free fields.
 * ``kind="emitter"`` — the emitter's own health: ``dropped``,
   ``write_errors`` (emitted only when the counters advance).

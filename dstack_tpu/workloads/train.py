@@ -204,7 +204,8 @@ def _device_peak_flops(device=None) -> float:
 
 def _timed_loop(steps: int, batch: int, seq: int, do_step,
                 flops_per_step: float = 0.0, telemetry=None,
-                step_extras=None) -> Dict[str, float]:
+                step_extras=None, start_step: int = 0,
+                on_step=None) -> Dict[str, float]:
     """Shared throughput loop: `do_step()` advances state and returns loss.
 
     The first call is compile + first step and is reported (and returned) as
@@ -221,11 +222,18 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     point per iteration — step_time, tok/s, TF/s, MFU against the chip's
     public peak, loss, plus whatever `step_extras()` returns (the entrypoints
     pass input-wait). This is what the server's goodput ledger is computed
-    from, so the marks bracket exactly the non-productive time."""
+    from, so the marks bracket exactly the non-productive time.
+
+    ``start_step`` resumes numbering mid-run (a checkpoint restore): the loop
+    performs ``steps - start_step`` iterations and steps are numbered
+    ``start_step+1 .. steps`` in prints and telemetry, so a resumed run's
+    step stream continues where the preempted one stopped. ``on_step(step,
+    loss)`` fires after every completed step (the checkpoint hook; its
+    exceptions propagate — an injected crash must actually kill the run)."""
     if telemetry is None:
         telemetry = telemetry_lib.get_emitter()
-    if steps <= 0:
-        print("training done (0 steps)", flush=True)
+    if steps - start_step <= 0:
+        print(f"training done (0 steps remaining of {steps})", flush=True)
         return {}
     n_dev = jax.device_count()
     peak_flops = _device_peak_flops() * n_dev if flops_per_step else 0.0
@@ -236,11 +244,13 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     jax.block_until_ready(loss)
     compile_s = time.perf_counter() - t0
     telemetry.mark("compile_end", compile_s=compile_s)
-    print(f"step 1/{steps} loss={float(loss):.4f} "
+    print(f"step {start_step + 1}/{steps} loss={float(loss):.4f} "
           f"compile+first-step {compile_s:.2f}s", flush=True)
+    if on_step is not None:
+        on_step(start_step + 1, loss)
 
     times = []
-    for i in range(1, steps):
+    for i in range(start_step + 1, steps):
         t0 = time.perf_counter()
         loss = do_step()
         jax.block_until_ready(loss)
@@ -260,6 +270,8 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
             except Exception:
                 pass  # extras are advisory; never let them kill the loop
         telemetry.step(i + 1, round(dt, 6), **point)
+        if on_step is not None:
+            on_step(i + 1, loss)
         if (i + 1) % 10 == 0 or i == steps - 1:
             window = times[-10:]
             dt = sum(window) / len(window)
@@ -274,7 +286,7 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     if times:
         p50 = stats["p50_s"]
         stats["tokens_per_sec"] = batch * seq / max(p50, 1e-9)
-        summary = (f"done: {steps} steps, compile {compile_s:.2f}s, "
+        summary = (f"done: {steps - start_step} steps, compile {compile_s:.2f}s, "
                    f"step p50 {p50 * 1000:.1f}ms p90 {stats['p90_s'] * 1000:.1f}ms, "
                    f"{stats['tokens_per_sec']:,.0f} tok/s")
         if flops_per_step:
@@ -291,6 +303,61 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     )
     telemetry.flush()
     return stats
+
+
+def make_checkpoint_manager(args, telemetry):
+    """--checkpoint-dir -> a CheckpointManager (None when checkpointing is
+    off). Import is lazy so the module stays importable without the flag."""
+    if not getattr(args, "checkpoint_dir", ""):
+        return None
+    from dstack_tpu.workloads.checkpoint import CheckpointManager
+
+    return CheckpointManager(args.checkpoint_dir, telemetry=telemetry)
+
+
+def maybe_resume(manager, resume: bool, template, telemetry):
+    """Restore the latest complete checkpoint into ``template`` when --resume
+    is set. Returns (state, start_step). A fresh dir under --resume starts at
+    step 0 (the first attempt of a retried gang passes the same flags)."""
+    if manager is None or not resume:
+        return template, 0
+    step = manager.latest_step()
+    if step is None:
+        print("resume: no complete checkpoint found; starting fresh", flush=True)
+        return template, 0
+    state, manifest = manager.restore(template, step)
+    start_step = int(manifest["step"])
+    telemetry.mark(
+        "restart", step=start_step, resumed=True,
+        from_mesh=manifest.get("mesh"),
+    )
+    print(
+        f"resumed from checkpoint step {start_step}"
+        f" (saved on mesh {manifest.get('mesh')})",
+        flush=True,
+    )
+    return state, start_step
+
+
+def make_checkpoint_hook(manager, every: int, total_steps: int, get_state,
+                         mesh_shape=None, resumed: bool = False):
+    """The _timed_loop on_step hook: save every N steps (the final state is
+    saved by the entrypoint after the loop, not here, so the last step isn't
+    written twice). DSTACK_TPU_TRAIN_CRASH_AT_STEP injects a preemption for
+    the smoke/bench harnesses — first attempt only (a resumed run sails past
+    the crash step it already survived)."""
+    import os
+
+    crash_at = int(os.environ.get("DSTACK_TPU_TRAIN_CRASH_AT_STEP", "0") or 0)
+
+    def on_step(step: int, loss) -> None:
+        if manager is not None and every > 0 and step % every == 0 and step < total_steps:
+            manager.save(step, get_state(), data_offset=step, mesh_shape=mesh_shape)
+        if crash_at and not resumed and step >= crash_at:
+            print(f"injected preemption: exiting at step {step}", flush=True)
+            raise SystemExit(1)
+
+    return on_step
 
 
 def apply_perf_overrides(cfg, args):
@@ -354,19 +421,22 @@ def _moe_main(args, moe_lib, data_lib) -> None:
     telemetry.mark("run_start", workload="train", config=args.config,
                    devices=n, batch=batch, seq=seq)
     optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
+    ckpt = make_checkpoint_manager(args, telemetry)
     with mesh:
         params = moe_lib.shard_moe_params(
             moe_lib.init_moe_params(cfg, jax.random.PRNGKey(0)), mesh
         )
         opt_state = optimizer.init(params)
+        state = {"params": params, "opt": opt_state}
+        state, start_step = maybe_resume(ckpt, args.resume, state, telemetry)
         step_fn = moe_lib.make_moe_train_step(
             cfg, optimizer, mesh, grad_accum=args.grad_accum
         )
         feed = data_lib.input_pipeline(
             mesh, moe_lib.MOE_BATCH, batch, seq, cfg.vocab_size,
             data_path=args.data or None, prefetch=args.prefetch,
+            start_batch=start_step,
         )
-        state = {"params": params, "opt": opt_state}
         feed_wait = {"s": 0.0}
 
         def do_step():
@@ -378,11 +448,21 @@ def _moe_main(args, moe_lib, data_lib) -> None:
             )
             return loss
 
+        on_step = make_checkpoint_hook(
+            ckpt, args.checkpoint_every, args.steps, lambda: state,
+            mesh_shape=dict(mesh.shape), resumed=start_step > 0,
+        )
         try:
             _timed_loop(args.steps, batch, seq, do_step, telemetry=telemetry,
-                        step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)})
+                        step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)},
+                        start_step=start_step, on_step=on_step)
+            if ckpt is not None and args.checkpoint_every:
+                ckpt.save(args.steps, state, data_offset=args.steps,
+                          mesh_shape=dict(mesh.shape), block=True)
         finally:
             feed.close()
+            if ckpt is not None:
+                ckpt.close()
             telemetry.close()
 
 
@@ -460,7 +540,21 @@ def main() -> None:
     parser.add_argument("--data", default="",
                         help="flat binary token-id file (np.uint16) to train"
                              " on; empty = synthetic tokens")
+    parser.add_argument("--checkpoint-every", type=int, default=0,
+                        dest="checkpoint_every",
+                        help="save an async distributed checkpoint every N"
+                             " steps (0 = off; requires --checkpoint-dir)")
+    parser.add_argument("--checkpoint-dir", default="", dest="checkpoint_dir",
+                        help="directory for per-host checkpoint shards"
+                             " (shared storage for multi-host restore)")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from the latest complete checkpoint in"
+                             " --checkpoint-dir (elastic: the current mesh"
+                             " may differ from the one that saved it); a"
+                             " fresh dir starts at step 0")
     args = parser.parse_args()
+    if args.checkpoint_every and not args.checkpoint_dir:
+        raise SystemExit("--checkpoint-every requires --checkpoint-dir")
 
     if args.config in moe_lib.MOE_PRESETS:
         _moe_main(args, moe_lib, data_lib)
@@ -503,12 +597,17 @@ def main() -> None:
                    devices=len(devices), mesh=dict(mesh.shape), batch=batch,
                    seq=seq, grad_accum=args.grad_accum)
     optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
+    ckpt = make_checkpoint_manager(args, telemetry)
     with mesh:
         state = init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
+        # Elastic restore: the template above is already sharded for THIS
+        # mesh, so a checkpoint saved on a different topology re-shards here.
+        state, start_step = maybe_resume(ckpt, args.resume, state, telemetry)
         step_fn = make_train_step(cfg, optimizer, mesh, grad_accum=args.grad_accum)
         feed = data_lib.input_pipeline(
             mesh, BATCH_SPEC, batch, seq, cfg.vocab_size,
             data_path=args.data or None, prefetch=args.prefetch,
+            start_batch=start_step,
         )
         flops_per_step = cfg.flops_per_token(seq) * batch * seq
         box = {"state": state}
@@ -521,12 +620,24 @@ def main() -> None:
             box["state"], metrics = step_fn(box["state"], tokens, targets)
             return metrics["loss"]
 
+        on_step = make_checkpoint_hook(
+            ckpt, args.checkpoint_every, args.steps,
+            lambda: box["state"], mesh_shape=dict(mesh.shape),
+            resumed=start_step > 0,
+        )
         try:
             _timed_loop(args.steps, batch, seq, do_step, flops_per_step,
                         telemetry=telemetry,
-                        step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)})
+                        step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)},
+                        start_step=start_step, on_step=on_step)
+            if ckpt is not None and args.checkpoint_every:
+                # Final state: a completed run's last step is restorable too.
+                ckpt.save(args.steps, box["state"], data_offset=args.steps,
+                          mesh_shape=dict(mesh.shape), block=True)
         finally:
             feed.close()
+            if ckpt is not None:
+                ckpt.close()
             telemetry.close()
 
 

@@ -1,0 +1,475 @@
+"""Async distributed checkpointing: round-trip bit-exactness, elastic
+reshard-on-load, data-source resume, commit protocol, and the goodput
+ledger's checkpoint/rework attribution.
+
+Numerics on the virtual 8-device CPU mesh (conftest); the tiny config keeps
+each jit under a second so the reshard test can afford two meshes."""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+from dstack_tpu.workloads import data as data_lib
+from dstack_tpu.workloads import train as train_lib
+from dstack_tpu.workloads.checkpoint import CheckpointManager, leaf_entries
+from dstack_tpu.workloads.config import get_config
+from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh
+
+
+def tiny_cfg(**over):
+    over.setdefault("max_seq_len", 32)
+    over.setdefault("d_model", 64)
+    over.setdefault("n_layers", 2)
+    over.setdefault("n_heads", 4)
+    over.setdefault("n_kv_heads", 2)
+    over.setdefault("d_ff", 128)
+    over.setdefault("vocab_size", 256)
+    over.setdefault("remat", False)
+    return get_config("test", **over)
+
+
+class CaptureEmitter:
+    def __init__(self):
+        self.points = []
+
+    def emit(self, kind, **fields):
+        self.points.append({"kind": kind, **fields})
+
+    def mark(self, event, **fields):
+        self.emit("mark", event=event, **fields)
+
+    def step(self, step, step_time_s, **fields):
+        self.emit("step", step=step, step_time_s=step_time_s, **fields)
+
+    def marks(self, event):
+        return [p for p in self.points if p.get("event") == event]
+
+
+def make_state(cfg, mesh, mu_dtype=None):
+    optimizer = train_lib.make_optimizer(mu_dtype=mu_dtype)
+    state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
+    return optimizer, state
+
+
+class TestRoundTrip:
+    def test_bit_exact_same_mesh(self, tmp_path):
+        """Every leaf — params, both Adam moments (bf16 mu included), the
+        step counter — restores bit-identically on the 8-dev mesh."""
+        cfg = tiny_cfg()
+        mesh = make_mesh(dp=2, fsdp=4, devices=jax.devices()[:8])
+        optimizer, state = make_state(cfg, mesh, mu_dtype="bfloat16")
+        # Perturb every leaf so the state is not all-init (distinct values per
+        # leaf, nonzero moments) without paying a train-step compile.
+        with mesh:
+            counter = iter(range(1, 10_000))
+
+            def bump(x):
+                if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating):
+                    return x + jnp.asarray(next(counter) * 0.125, x.dtype)
+                return x
+
+            state = jax.tree.map(bump, state)
+            state.step = jnp.int32(1)
+
+        emitter = CaptureEmitter()
+        mgr = CheckpointManager(str(tmp_path), telemetry=emitter,
+                                process_index=0, process_count=1)
+        mgr.save(1, state, data_offset=1, mesh_shape=dict(mesh.shape), block=True)
+        assert mgr.latest_step() == 1
+        assert mgr.save_errors == 0, mgr.last_error
+
+        _, template = make_state(cfg, mesh, mu_dtype="bfloat16")
+        restored, manifest = mgr.restore(template)
+        assert manifest["step"] == 1
+        assert manifest["data_offset"] == 1
+        assert manifest["mesh"] == dict(mesh.shape)
+        for (key, a), (_, b) in zip(leaf_entries(state), leaf_entries(restored)):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=key
+            )
+            if hasattr(a, "dtype"):
+                assert np.asarray(b).dtype == np.asarray(a).dtype, key
+        # The telemetry bracket landed: start + end (with the measured
+        # blocked window) + the writer's durability mark.
+        assert emitter.marks("checkpoint_start")
+        end = emitter.marks("checkpoint_end")
+        assert end and end[0]["blocked_s"] >= 0
+        assert emitter.marks("checkpoint_saved")
+
+    def test_restored_shardings_match_template(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = make_mesh(dp=2, fsdp=4, devices=jax.devices()[:8])
+        _, state = make_state(cfg, mesh)
+        mgr = CheckpointManager(str(tmp_path), process_index=0, process_count=1)
+        mgr.save(3, state, block=True)
+        _, template = make_state(cfg, mesh)
+        restored, _ = mgr.restore(template)
+        for (key, t), (_, r) in zip(leaf_entries(template), leaf_entries(restored)):
+            if isinstance(t, jax.Array):
+                assert r.sharding == t.sharding, key
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = make_mesh(dp=2, fsdp=4, devices=jax.devices()[:8])
+        _, state = make_state(cfg, mesh)
+        mgr = CheckpointManager(str(tmp_path), process_index=0, process_count=1)
+        mgr.save(1, state, block=True)
+        with pytest.raises(ValueError, match="structure mismatch"):
+            mgr.restore({"just": jnp.zeros((2,))})
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = make_mesh(dp=2, fsdp=4, devices=jax.devices()[:8])
+        _, state = make_state(cfg, mesh)
+        mgr = CheckpointManager(str(tmp_path), process_index=0, process_count=1)
+        mgr.save(1, state, block=True)
+        _, template = make_state(tiny_cfg(d_ff=256), mesh)
+        with pytest.raises(ValueError, match="shape"):
+            mgr.restore(template)
+
+
+class TestElasticReshard:
+    def test_save_dp2_fsdp4_resume_dp4_fsdp2_loss_identical(self, tmp_path):
+        """The acceptance criterion: a run checkpointed on one topology
+        resumes on a different one with the SAME loss trajectory as an
+        uninterrupted run — asserted step by step, not eyeballed."""
+        cfg = tiny_cfg(dtype="float32", param_dtype="float32")
+        devices = jax.devices()[:8]
+        mesh_a = make_mesh(dp=2, fsdp=4, devices=devices)
+        mesh_b = make_mesh(dp=4, fsdp=2, devices=devices)
+        batch, seq, total, cut = 8, 32, 6, 3
+        # One optimizer + one jitted step per mesh, shared by the reference
+        # and interrupted runs (same jit object -> one compile each).
+        optimizer = train_lib.make_optimizer()
+        step_fns = {
+            mesh_a: train_lib.make_train_step(cfg, optimizer, mesh_a),
+            mesh_b: train_lib.make_train_step(cfg, optimizer, mesh_b),
+        }
+
+        def run_steps(mesh, state, start, stop, losses):
+            with mesh:
+                feed = data_lib.input_pipeline(
+                    mesh, BATCH_SPEC, batch, seq, cfg.vocab_size,
+                    prefetch=0, start_batch=start,
+                )
+                try:
+                    for step in range(start + 1, stop + 1):
+                        tok, tgt = next(feed)
+                        state, m = step_fns[mesh](state, tok, tgt)
+                        losses[step] = float(m["loss"])
+                finally:
+                    feed.close()
+            return state
+
+        def fresh_state(mesh):
+            return train_lib.init_train_state(
+                cfg, jax.random.PRNGKey(0), optimizer, mesh
+            )
+
+        # Uninterrupted reference on mesh A.
+        ref_losses = {}
+        run_steps(mesh_a, fresh_state(mesh_a), 0, total, ref_losses)
+
+        # Interrupted: steps 1..cut on mesh A, checkpoint, resume on mesh B.
+        losses = {}
+        state_a = run_steps(mesh_a, fresh_state(mesh_a), 0, cut, losses)
+        mgr = CheckpointManager(str(tmp_path), process_index=0, process_count=1)
+        mgr.save(cut, state_a, data_offset=cut, mesh_shape=dict(mesh_a.shape),
+                 block=True)
+
+        restored, manifest = mgr.restore(fresh_state(mesh_b))
+        assert manifest["mesh"] == dict(mesh_a.shape)  # provably cross-mesh
+        # The restored params live under mesh B's sharding rules now.
+        w = restored.params["wq"]
+        assert w.sharding.mesh.shape == mesh_b.shape
+        run_steps(mesh_b, restored, cut, total, losses)
+
+        assert set(losses) == set(ref_losses)
+        for step in sorted(ref_losses):
+            assert losses[step] == ref_losses[step], (
+                f"step {step}: {losses[step]} != {ref_losses[step]}"
+            )
+
+
+class TestDataSourceResume:
+    def test_synthetic_seek_no_replay_no_skip(self):
+        fresh = data_lib.synthetic_batches(
+            100, 8, 16, process_index=0, process_count=1
+        )
+        want = [next(fresh)[0] for _ in range(10)]
+        resumed = data_lib.synthetic_batches(
+            100, 8, 16, process_index=0, process_count=1, start_batch=4
+        )
+        got = [next(resumed)[0] for _ in range(6)]
+        for i, g in enumerate(got):
+            np.testing.assert_array_equal(g, want[4 + i])
+
+    def test_synthetic_hosts_stay_disjoint_after_seek(self):
+        a = next(data_lib.synthetic_batches(
+            100, 8, 16, process_index=0, process_count=2, start_batch=3
+        ))[0]
+        b = next(data_lib.synthetic_batches(
+            100, 8, 16, process_index=1, process_count=2, start_batch=3
+        ))[0]
+        assert not np.array_equal(a, b)
+
+    def test_token_file_seek_no_replay_no_skip(self, tmp_path):
+        path = tmp_path / "tokens.bin"
+        np.arange(4 * 9 * 4, dtype=np.uint16).tofile(path)  # 16 windows of 9
+        fresh = data_lib.token_file_batches(
+            str(path), global_batch=4, seq=8,
+            process_index=0, process_count=1,
+        )
+        want = [next(fresh)[0] for _ in range(8)]  # wraps after 4 batches
+        resumed = data_lib.token_file_batches(
+            str(path), global_batch=4, seq=8,
+            process_index=0, process_count=1, start_batch=3,
+        )
+        got = [next(resumed)[0] for _ in range(5)]
+        for i, g in enumerate(got):
+            np.testing.assert_array_equal(g, want[3 + i])
+
+    def test_token_file_seek_past_wrap(self, tmp_path):
+        path = tmp_path / "tokens.bin"
+        np.arange(4 * 9 * 4, dtype=np.uint16).tofile(path)
+        fresh = data_lib.token_file_batches(
+            str(path), global_batch=4, seq=8,
+            process_index=0, process_count=1,
+        )
+        want = [next(fresh)[0] for _ in range(7)]
+        resumed = data_lib.token_file_batches(
+            str(path), global_batch=4, seq=8,
+            process_index=0, process_count=1, start_batch=6,
+        )
+        np.testing.assert_array_equal(next(resumed)[0], want[6])
+
+    def test_token_file_noloop_respects_offset(self, tmp_path):
+        path = tmp_path / "tokens.bin"
+        np.arange(4 * 9 * 4, dtype=np.uint16).tofile(path)
+        it = data_lib.token_file_batches(
+            str(path), global_batch=4, seq=8, loop=False,
+            process_index=0, process_count=1, start_batch=2,
+        )
+        assert len(list(it)) == 2  # 4 per pass, 2 already consumed
+
+
+class TestCommitProtocol:
+    def test_incomplete_checkpoint_ignored(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), process_index=0, process_count=1)
+        state = {"x": jnp.arange(8.0)}
+        mgr.save(5, state, block=True)
+        # A later step whose commit marker is missing (killed mid-write).
+        torn = tmp_path / "step-00000009"
+        torn.mkdir()
+        (torn / "manifest.json").write_text(json.dumps(
+            {"step": 9, "process_count": 1, "leaves": []}
+        ))
+        (torn / "shard-00000.npz").write_bytes(b"garbage")
+        assert mgr.latest_step() == 5
+        restored, manifest = mgr.restore({"x": jnp.zeros(8)})
+        assert manifest["step"] == 5
+
+    def test_prune_keeps_latest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2,
+                                process_index=0, process_count=1)
+        state = {"x": jnp.arange(4.0)}
+        for step in (1, 2, 3, 4):
+            mgr.save(step, state, block=True)
+        assert mgr.complete_steps() == [3, 4]
+
+    def test_multihost_restore_merges_shards(self, tmp_path):
+        """Two processes' shard files (each holding half the rows) rebuild
+        the full array; a missing host's file fails loudly instead of
+        restoring zeros where that host's rows were."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh(dp=2, fsdp=4, devices=jax.devices()[:8])
+        full = jnp.arange(64.0).reshape(8, 8)
+        arr = jax.device_put(full, NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        m0 = CheckpointManager(str(tmp_path), process_index=0, process_count=2)
+        m1 = CheckpointManager(str(tmp_path), process_index=1, process_count=2)
+        m0.save(1, {"w": arr}, block=True)
+        assert m1.latest_step() is None  # only host 0 committed so far
+        m1.save(1, {"w": arr}, block=True)
+        assert m1.latest_step() == 1
+        # Carve the single-process stand-in into true per-host files: host 0
+        # keeps the shards for rows 0..3, host 1 rows 4..7.
+        step_dir = tmp_path / "step-00000001"
+        for pi, keep in ((0, range(0, 4)), (1, range(4, 8))):
+            f = step_dir / f"shard-{pi:05d}.npz"
+            with np.load(f) as z:
+                kept = {
+                    k: z[k] for k in z.files
+                    if int(k.split("@")[1].split(":")[0]) in keep
+                }
+            with open(f, "wb") as fh:
+                np.savez(fh, **kept)
+        template = {"w": jax.device_put(jnp.zeros((8, 8)), arr.sharding)}
+        restored, _ = m1.restore(template)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(full))
+        # Host 1's file gone -> its rows are uncovered -> loud failure.
+        (step_dir / "shard-00001.npz").unlink()
+        with pytest.raises(ValueError, match="cover"):
+            m1.restore(template)
+
+    def test_save_error_degrades_never_raises(self, tmp_path):
+        emitter = CaptureEmitter()
+        target = tmp_path / "dir"
+        target.mkdir()
+        blocker = target / "step-00000001"
+        blocker.write_text("a file where the step dir must go")
+        mgr = CheckpointManager(str(target), telemetry=emitter,
+                                process_index=0, process_count=1)
+        mgr.save(1, {"x": jnp.zeros(4)}, block=True)
+        assert mgr.save_errors == 1
+        assert emitter.marks("checkpoint_error")
+        # The bracket still closes: a dangling checkpoint_start would bill
+        # wall clock to checkpoint_s in the ledger until the window edge.
+        ends = emitter.marks("checkpoint_end")
+        assert len(ends) == len(emitter.marks("checkpoint_start"))
+        assert mgr.latest_step() is None
+        # The manager still works after the failure.
+        mgr.save(2, {"x": jnp.zeros(4)}, block=True)
+        assert mgr.latest_step() == 2
+
+    def test_snapshot_stage_failure_closes_bracket(self, tmp_path):
+        """A failure BEFORE the write thread (device->host stage) must also
+        emit checkpoint_end — the ledger would otherwise attribute wall
+        clock to checkpoint_s until the window edge."""
+
+        class Unsnapshotable:
+            shape = (2,)
+            dtype = np.float32
+
+            def __array__(self, *a, **k):
+                raise RuntimeError("host OOM")
+
+        emitter = CaptureEmitter()
+        mgr = CheckpointManager(str(tmp_path), telemetry=emitter,
+                                process_index=0, process_count=1)
+        mgr.save(1, {"bad": Unsnapshotable()}, block=True)
+        assert mgr.save_errors == 1
+        assert emitter.marks("checkpoint_error")
+        ends = emitter.marks("checkpoint_end")
+        assert len(ends) == 1 and ends[0].get("failed") is True
+
+
+class TestTrainHooks:
+    def test_checkpoint_hook_saves_on_cadence(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=8,
+                                process_index=0, process_count=1)
+        box = {"state": {"x": jnp.arange(2.0)}}
+        hook = train_lib.make_checkpoint_hook(
+            mgr, every=2, total_steps=10, get_state=lambda: box["state"]
+        )
+        for step in range(1, 7):
+            hook(step, None)
+        mgr.close()
+        assert mgr.complete_steps() == [2, 4, 6]
+        assert mgr.read_manifest(4)["data_offset"] == 4
+
+    def test_crash_hook_fires_once_then_respects_resume(self, monkeypatch):
+        monkeypatch.setenv("DSTACK_TPU_TRAIN_CRASH_AT_STEP", "3")
+        hook = train_lib.make_checkpoint_hook(
+            None, every=0, total_steps=10, get_state=lambda: None, resumed=False
+        )
+        hook(2, None)
+        with pytest.raises(SystemExit):
+            hook(3, None)
+        resumed_hook = train_lib.make_checkpoint_hook(
+            None, every=0, total_steps=10, get_state=lambda: None, resumed=True
+        )
+        resumed_hook(3, None)  # a resumed run sails past the crash step
+
+    def test_timed_loop_resumes_numbering(self, capsys):
+        seen = []
+        stats = train_lib._timed_loop(
+            6, 2, 4, lambda: jnp.float32(0.5), start_step=4,
+            on_step=lambda s, _l: seen.append(s),
+        )
+        out = capsys.readouterr().out
+        assert "step 5/6" in out
+        assert seen == [5, 6]
+        assert "done: 2 steps" in out
+
+
+class TestGoodputLedger:
+    def _iso(self, off):
+        import datetime
+
+        from dstack_tpu.utils.common import to_iso
+
+        base = datetime.datetime(2026, 8, 1, tzinfo=datetime.timezone.utc)
+        return to_iso(base + datetime.timedelta(seconds=off))
+
+    def test_checkpoint_bucket_from_marks(self):
+        from dstack_tpu.server.services.metrics import compute_goodput
+
+        points = [
+            {"ts": self._iso(0), "kind": "mark", "event": "run_start"},
+            {"ts": self._iso(1), "kind": "step", "step": 2, "step_time_s": 1.0},
+            {"ts": self._iso(1.1), "kind": "mark", "event": "checkpoint_start"},
+            {"ts": self._iso(1.6), "kind": "mark", "event": "checkpoint_end",
+             "blocked_s": 0.5},
+            {"ts": self._iso(2.6), "kind": "step", "step": 3, "step_time_s": 1.0},
+        ]
+        ledger = compute_goodput(points)
+        assert ledger["checkpoint_s"] == 0.5
+        assert ledger["steps"] == 2
+        assert ledger["rework_s"] == 0.0
+        # checkpoint_s no longer hides in other_s.
+        assert ledger["other_s"] < 0.2
+
+    def test_checkpoint_bracket_without_measured_value(self):
+        from dstack_tpu.server.services.metrics import compute_goodput
+
+        points = [
+            {"ts": self._iso(0), "kind": "step", "step": 1, "step_time_s": 0.5},
+            {"ts": self._iso(1), "kind": "mark", "event": "checkpoint_start"},
+            {"ts": self._iso(1.7), "kind": "mark", "event": "checkpoint_end"},
+            {"ts": self._iso(2), "kind": "step", "step": 2, "step_time_s": 0.3},
+        ]
+        ledger = compute_goodput(points)
+        assert ledger["checkpoint_s"] == pytest.approx(0.7)
+
+    def test_rework_debits_redone_steps(self):
+        from dstack_tpu.server.services.metrics import compute_goodput
+
+        points = [
+            {"ts": self._iso(0), "kind": "mark", "event": "run_start"},
+            {"ts": self._iso(1), "kind": "step", "step": 2, "step_time_s": 1.0},
+            {"ts": self._iso(2), "kind": "step", "step": 3, "step_time_s": 1.0},
+            # preemption: restart from scratch
+            {"ts": self._iso(10), "kind": "mark", "event": "run_start"},
+            {"ts": self._iso(11), "kind": "step", "step": 2, "step_time_s": 1.0},
+            {"ts": self._iso(12), "kind": "step", "step": 3, "step_time_s": 1.0},
+            {"ts": self._iso(13), "kind": "step", "step": 4, "step_time_s": 1.0},
+        ]
+        ledger = compute_goodput(points)
+        assert ledger["steps"] == 3          # net progress: 2, 3, 4
+        assert ledger["productive_s"] == 3.0
+        assert ledger["rework_s"] == 2.0     # redone 2 and 3
+        assert ledger["restart_s"] == 8.0    # the gap before the 2nd run_start
+        assert ledger["ratio"] == pytest.approx(3.0 / 13.0, abs=1e-3)
+
+    def test_resume_past_frontier_is_all_productive(self):
+        from dstack_tpu.server.services.metrics import compute_goodput
+
+        points = [
+            {"ts": self._iso(0), "kind": "step", "step": 5, "step_time_s": 1.0},
+            {"ts": self._iso(5), "kind": "mark", "event": "restart"},
+            {"ts": self._iso(6), "kind": "step", "step": 6, "step_time_s": 1.0},
+        ]
+        ledger = compute_goodput(points)
+        assert ledger["rework_s"] == 0.0
+        assert ledger["steps"] == 2
+        # The gap between the dead process's last point (t=0) and the restart.
+        assert ledger["restart_s"] == pytest.approx(5.0)

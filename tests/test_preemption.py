@@ -1,0 +1,296 @@
+"""Preemption-aware gang rescue through the real server FSM: kill-mid-step
+lifecycle with run_events timeline assertions, time-to-recover histogram,
+and elastic retry onto a different slice topology.
+
+Same strategy as test_scheduler.py: real FSM loops + real DB + mock Compute +
+scripted runner clients."""
+
+import json
+
+import pytest
+
+from dstack_tpu.core import tracing
+from dstack_tpu.server import settings
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+    tpu_task_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fake_runner(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    tracing.reset()
+    monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+    monkeypatch.setattr(settings, "RETRY_BACKOFF_BASE", 0.0)
+    yield
+    FakeRunnerClient.reset()
+
+
+async def _job_rows(db, run_name):
+    return await db.fetchall(
+        "SELECT * FROM jobs WHERE run_name = ?"
+        " ORDER BY submission_num, replica_num, job_num",
+        (run_name,),
+    )
+
+
+def _recovery_count():
+    snap = tracing.histogram_snapshot("dstack_tpu_run_recovery_seconds")
+    if snap is None:
+        return 0
+    _, series = snap
+    return sum(count for _labels, _cum, _total, count in series)
+
+
+class TestGangRescueLifecycle:
+    async def test_kill_mid_step_rescue_timeline_and_recovery(self):
+        """A job dying mid-run (exit 1 while RUNNING) tears the gang down,
+        the retry policy resubmits it whole, and the rescued run finishes —
+        with the full story readable from run_events and the time-to-recover
+        observed into dstack_tpu_run_recovery_seconds."""
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            orig_for_jpd = FakeRunnerClient.for_jpd
+            injected = []
+
+            def failing_first_attempt(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                if not injected and fake.submitted is None:
+                    injected.append(True)
+                    # RUNNING for a couple of pulls, then the container dies
+                    # mid-step (what a preempted host's workload looks like
+                    # from the agent).
+                    fake.script = [
+                        {"job_states": [{"state": "running"}], "logs": [], "offset": 1},
+                        {"job_states": [], "logs": [], "offset": 2},
+                        {
+                            "job_states": [{"state": "failed", "exit_status": 137}],
+                            "logs": [],
+                            "offset": 3,
+                        },
+                    ]
+                return fake
+
+            tasks.get_runner_client = failing_first_attempt
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "rescue", "v5p-16",
+                    retry={"on_events": ["error"], "duration": "1h"},
+                ),
+            )
+            await drive(api.db, passes=25)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "rescue"})
+            assert run["status"] == "done"
+            rows = await _job_rows(api.db, "rescue")
+            assert max(r["submission_num"] for r in rows) == 1
+            # 2 hosts x 2 submissions, second gang complete.
+            assert len([r for r in rows if r["submission_num"] == 1]) == 2
+
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "rescue"}
+            )
+            events = data["events"]
+            # The first lineage ran and died...
+            seq = [
+                (e["new_status"], e["reason"])
+                for e in events
+                if e["job_id"] is not None
+            ]
+            statuses = [s for s, _ in seq]
+            assert "running" in statuses
+            fail_idx = statuses.index("failed")
+            assert statuses.index("running") < fail_idx
+            # ...then the rescue: gang_retry resubmission AFTER the failure,
+            # reaching running and done again.
+            retry_idx = seq.index(("submitted", "gang_retry"))
+            assert retry_idx > fail_idx
+            assert "running" in statuses[retry_idx:]
+            assert statuses[-1] == "done"
+            # Both gang members were resubmitted by the retry.
+            assert seq.count(("submitted", "gang_retry")) == 2
+
+            # Time-to-recover observed exactly once (lead job only — not
+            # once per gang host).
+            assert _recovery_count() == 1
+
+    async def test_recovery_histogram_advertised_on_metrics(self):
+        async with api_server() as api:
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            assert "# TYPE dstack_tpu_run_recovery_seconds histogram" in text
+
+
+class TestElasticRetry:
+    async def test_interruption_reschedules_onto_alternate_topology(self, monkeypatch):
+        """A slice lost mid-run (runner unreachable -> INSTANCE_UNREACHABLE,
+        an interruption event) retries the gang onto the run's next elastic
+        topology: v5e-8 (2 hosts) shrinks to v5e-4 (1 host), and the
+        resubmitted spec carries the new slice."""
+        monkeypatch.setattr(settings, "RUNNER_DISCONNECT_TIMEOUT", 0.0)
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            orig_for_jpd = FakeRunnerClient.for_jpd
+            lost = []
+
+            class LostSliceClient:
+                def __init__(self, inner):
+                    self.inner = inner
+
+                def __getattr__(self, name):
+                    return getattr(self.inner, name)
+
+                async def pull(self, offset: int = 0):
+                    if self.inner.pulls >= 1:
+                        raise ConnectionError("slice preempted")
+                    return await self.inner.pull(offset)
+
+            def for_jpd(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                if not lost or fake.key in lost:
+                    # Only the FIRST submission's workers become unreachable.
+                    if fake.key not in lost and len(lost) < 2:
+                        lost.append(fake.key)
+                    if fake.key in lost:
+                        return LostSliceClient(fake)
+                return fake
+
+            tasks.get_runner_client = for_jpd
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "elastic", "v5e-16",
+                    retry={"on_events": ["interruption"], "duration": "1h"},
+                    elastic=["v5e-8"],
+                ),
+            )
+            await drive(api.db, passes=30)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "elastic"})
+            assert run["status"] == "done", run["status"]
+
+            rows = await _job_rows(api.db, "elastic")
+            sub0 = [r for r in rows if r["submission_num"] == 0]
+            sub1 = [r for r in rows if r["submission_num"] == 1]
+            assert len(sub0) == 2  # v5e-16 = 2 hosts
+            assert len(sub1) == 1  # v5e-8 = 1 host — the gang SHRANK
+            spec = json.loads(sub1[0]["job_spec"])
+            tpu = spec["requirements"]["resources"]["tpu"]
+            assert tpu["chips"] == 8
+            assert all(r["status"] == "done" for r in sub1)
+
+            # The timeline says why, and the recovery histogram closed.
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "elastic"}
+            )
+            retried = [
+                e for e in data["events"]
+                if e["new_status"] == "submitted" and e["reason"] == "gang_retry"
+            ]
+            assert retried and any(
+                "elastic retry onto v5e-8" in (e["message"] or "") for e in retried
+            )
+            assert _recovery_count() == 1
+
+    async def test_error_failure_does_not_rotate_topology(self):
+        """A plain container error (the workload's own bug) retries the gang
+        but does NOT switch topology — elastic rotation is reserved for
+        capacity failures (preemption/stockout)."""
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            orig_for_jpd = FakeRunnerClient.for_jpd
+            injected = []
+
+            def failing_for_jpd(jpd, jrd):
+                fake = orig_for_jpd(jpd, jrd)
+                if not injected and fake.submitted is None:
+                    injected.append(True)
+                    fake.script = [
+                        {
+                            "job_states": [{"state": "failed", "exit_status": 1}],
+                            "logs": [],
+                            "offset": 1,
+                        }
+                    ]
+                return fake
+
+            tasks.get_runner_client = failing_for_jpd
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "no-rotate", "v5e-16",
+                    retry={"on_events": ["error"], "duration": "1h"},
+                    elastic=["v5e-4"],  # never used: error is not a capacity event
+                ),
+            )
+            await drive(api.db, passes=25)
+            run = await api.post(
+                "/api/project/main/runs/get", {"run_name": "no-rotate"}
+            )
+            assert run["status"] == "done"
+            rows = await _job_rows(api.db, "no-rotate")
+            sub1 = [r for r in rows if r["submission_num"] == 1]
+            assert len(sub1) == 2  # still v5e-16's 2 hosts
+            tpu = json.loads(sub1[0]["job_spec"])["requirements"]["resources"]["tpu"]
+            assert tpu["chips"] == 16
+
+    async def test_elastic_requires_tpu_resources(self):
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/runs/submit",
+                {
+                    "run_spec": {
+                        "run_name": "bad-elastic",
+                        "configuration": {
+                            "type": "task",
+                            "commands": ["echo hi"],
+                            "elastic": ["v5e-4"],
+                        },
+                    }
+                },
+                expect=422,
+            )
+
+    async def test_elastic_validates_topology_names_at_submit(self):
+        async with api_server() as api:
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec("bad-topo", "v5e-8", elastic=["warp9"]),
+                expect=422,
+            )
+
+
+class TestLatestSubmissions:
+    def test_shrunk_gang_leaves_no_phantom_jobs(self):
+        rows = [
+            {"replica_num": 0, "job_num": 0, "submission_num": 0, "status": "failed"},
+            {"replica_num": 0, "job_num": 1, "submission_num": 0, "status": "failed"},
+            {"replica_num": 0, "job_num": 0, "submission_num": 1, "status": "running"},
+        ]
+        latest = tasks._latest_submissions(rows)
+        assert set(latest) == {(0, 0)}
+        assert latest[(0, 0)]["submission_num"] == 1
+
+    def test_grown_gang_takes_all_new_jobs(self):
+        rows = [
+            {"replica_num": 0, "job_num": 0, "submission_num": 0, "status": "failed"},
+            {"replica_num": 0, "job_num": 0, "submission_num": 1, "status": "running"},
+            {"replica_num": 0, "job_num": 1, "submission_num": 1, "status": "running"},
+        ]
+        latest = tasks._latest_submissions(rows)
+        assert set(latest) == {(0, 0), (0, 1)}
+
+    def test_replicas_keep_independent_submissions(self):
+        rows = [
+            {"replica_num": 0, "job_num": 0, "submission_num": 2, "status": "running"},
+            {"replica_num": 1, "job_num": 0, "submission_num": 0, "status": "running"},
+        ]
+        latest = tasks._latest_submissions(rows)
+        assert latest[(0, 0)]["submission_num"] == 2
+        assert latest[(1, 0)]["submission_num"] == 0

@@ -270,8 +270,11 @@ class TestGoodput:
         ]
         ledger = metrics_service.compute_goodput(points)
         assert ledger["restart_s"] == pytest.approx(10.0)
-        assert ledger["productive_s"] == pytest.approx(2.0)
-        assert ledger["ratio"] == pytest.approx(2.0 / 12.0, abs=1e-3)
+        # The restarted process RE-RAN step 2 (no checkpoint): that step is
+        # rework, not productive — net forward progress is one step.
+        assert ledger["productive_s"] == pytest.approx(1.0)
+        assert ledger["rework_s"] == pytest.approx(1.0)
+        assert ledger["ratio"] == pytest.approx(1.0 / 12.0, abs=1e-3)
 
     def test_no_steps_or_no_points_means_no_ratio(self):
         assert metrics_service.compute_goodput([])["ratio"] is None
@@ -590,8 +593,9 @@ class TestWorkloadFlow:
             families = parse_exposition(await resp.text())
             gauges = families["dstack_tpu_run_goodput_ratio"]["samples"]
             val = next(v for _, l, v in gauges if l.get("run") == "pre-run")
-            # 2s productive over a 22s wall: the restart gap debits the gauge.
-            assert val == pytest.approx(2.0 / 22.0, abs=1e-3)
+            # 1s of NET progress over a 22s wall: the restart gap debits the
+            # gauge, and the replayed step 2 counts as rework, not goodput.
+            assert val == pytest.approx(1.0 / 22.0, abs=1e-3)
 
 
 class TestProfileEndpoint:
