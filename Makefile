@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels bench-preemption smoke-observability smoke-serve smoke-preemption release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos release publish clean
 
 all: runner wheel
 
@@ -80,6 +80,21 @@ bench-kernels:
 bench-preemption:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  python -c "import json, bench; print(json.dumps(bench.bench_preemption()))"
+
+# Chaos bench: N runs across TWO scheduler replicas (lease-sharded, one DB)
+# under an injected fault schedule — agent drops, backend 5xx — with one
+# replica killed mid-run. FAILS (non-zero exit) unless 100% of runs reach
+# `done`, zero slices are double-booked, and every orphaned run is reclaimed;
+# prints recovery-time p50/p90 derived from run_events.
+bench-chaos:
+	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_chaos()))"
+
+# Chaos smoke: lease reclaim through the REAL server + native agent. Replica A
+# drives an actual local-backend process to RUNNING and dies; replica B must
+# reclaim the expired lease, reconcile (probing the live agent), and finish
+# the SAME workload without a restart. Non-zero exit on any missing piece.
+smoke-chaos:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_chaos()"
 
 # Elastic-training smoke: boots the server, drives a REAL train run through
 # the native agent with async checkpointing, kills the workload mid-run, and

@@ -1951,6 +1951,326 @@ def smoke_serve() -> dict:
     return result
 
 
+def bench_chaos() -> dict:
+    """`make bench-chaos`: control-plane fault tolerance under an injected
+    fault schedule. N runs are driven by TWO scheduler replicas (distinct
+    lease identities sharing one DB — the multi-replica deployment shape,
+    conservatively sharing one in-process locker; the DB-level lease/claim
+    transactions are the guard under test) while a fraction of runner calls
+    drop and backend create_slice calls 5xx; replica A is then KILLED
+    mid-run (its task cancelled between awaits, exactly like a process
+    crash). FAILS unless: 100%% of runs reach `done`, no slice is ever
+    double-booked across the replicas, and every run orphaned by the kill is
+    reclaimed + reconciled. Reports recovery-time p50/p90 (kill ->
+    `reconciled` run_event) through the run_events machinery."""
+    import asyncio
+
+    from dstack_tpu.core import faults, tracing
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.background import tasks
+    from dstack_tpu.server.services import leases, resilience
+    from dstack_tpu.utils.common import from_iso, now_utc
+    from tests.common import FakeRunnerClient, api_server, setup_mock_backend, tpu_task_spec
+
+    N = 24
+    tracing.reset()
+    resilience.reset()
+    saved = (
+        settings.LEASE_TTL, settings.RETRY_BACKOFF_BASE,
+        settings.BREAKER_COOLDOWN, settings.BREAKER_THRESHOLD,
+    )
+    settings.LEASE_TTL = 1.5
+    settings.RETRY_BACKOFF_BASE = 0.1
+    settings.BREAKER_COOLDOWN = 0.5
+    settings.BREAKER_THRESHOLD = 4
+
+    class ChaosRunnerClient(FakeRunnerClient):
+        """The scripted agent with the chaos schedule's drop faults applied:
+        a dropped healthcheck reads as unreachable, a dropped pull exercises
+        the disconnect grace path."""
+
+        async def healthcheck(self):
+            try:
+                await faults.check("runner.request", detail=f"{self.key}/healthcheck")
+            except faults.FaultInjected:
+                return None
+            return await super().healthcheck()
+
+        async def pull(self, offset: int = 0):
+            await faults.check("runner.request", detail=f"{self.key}/pull")
+            return await super().pull(offset)
+
+        def default_script(self):
+            # Jobs stay RUNNING across ~40 pulls before finishing, so the
+            # replica kill lands while real work is in flight (a 2-pull script
+            # would complete every run before the chaos even starts).
+            running = {"job_states": [{"state": "running"}], "logs": [], "offset": 1}
+            return [running] * 40 + [
+                {"job_states": [{"state": "done", "exit_status": 0}], "logs": [], "offset": 2}
+            ]
+
+    faults.configure(
+        {
+            "seed": 7,
+            "sites": {
+                "runner.request": {"fail": 0.15, "error": "injected agent drop"},
+                "backend.create_slice": {
+                    "fail": 0.35, "times": 12, "error": "injected backend 5xx",
+                },
+            },
+        }
+    )
+
+    async def run() -> dict:
+        ChaosRunnerClient.reset()
+        tasks.get_runner_client = ChaosRunnerClient.for_jpd
+        double_booked: list = []
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            for i in range(N):
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    tpu_task_spec(
+                        f"chaos-{i}", "v5e-8",
+                        retry={"on_events": ["no-capacity"], "duration": "1h"},
+                    ),
+                )
+
+            async def check_double_booking() -> None:
+                rows = await api.db.fetchall(
+                    "SELECT instance_id, COUNT(*) AS n FROM jobs"
+                    " WHERE instance_id IS NOT NULL"
+                    " AND status IN ('provisioning', 'pulling', 'running')"
+                    " GROUP BY instance_id HAVING COUNT(*) > 1"
+                )
+                double_booked.extend((r["instance_id"], r["n"]) for r in rows)
+
+            async def replica(rid: str) -> None:
+                with leases.as_replica(rid):
+                    while True:
+                        # Small submitted batch: placement claims interleave, so
+                        # ownership genuinely partitions across the replicas.
+                        await tasks.process_submitted_jobs(api.db, batch=8)
+                        await tasks.process_running_jobs(api.db, batch=50)
+                        await tasks.process_terminating_jobs(api.db, batch=50)
+                        await tasks.process_runs(api.db, batch=50)
+                        await check_double_booking()
+                        await asyncio.sleep(0.05)
+
+            task_a = asyncio.create_task(replica("chaos-a"))
+            task_b = asyncio.create_task(replica("chaos-b"))
+            await asyncio.sleep(2.0)  # both replicas mid-schedule
+            partition = {
+                r["owner"]: r["n"]
+                for r in await api.db.fetchall(
+                    "SELECT owner, COUNT(*) AS n FROM run_leases GROUP BY owner"
+                )
+            }
+
+            # KILL replica A: a hard cancel between awaits is a process crash
+            # as far as the DB is concerned (every transition is transactional).
+            task_a.cancel()
+            try:
+                await task_a
+            except asyncio.CancelledError:
+                pass
+            t_kill = now_utc()
+            orphan_rows = await api.db.fetchall(
+                "SELECT l.run_id FROM run_leases l JOIN runs r ON r.id = l.run_id"
+                " WHERE l.owner = 'chaos-a'"
+                " AND r.status NOT IN ('terminated', 'failed', 'done')"
+            )
+            orphans = {r["run_id"] for r in orphan_rows}
+            # An empty orphan set means the schedule is mistuned (everything
+            # finished before the kill) and the bench would prove nothing.
+            assert orphans, "replica kill orphaned no runs; chaos schedule mistuned"
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                done = await api.db.fetchone(
+                    "SELECT COUNT(*) AS n FROM runs WHERE status = 'done'"
+                )
+                if done["n"] >= N:
+                    break
+                await asyncio.sleep(0.2)
+            task_b.cancel()
+            try:
+                await task_b
+            except asyncio.CancelledError:
+                pass
+
+            statuses = await api.db.fetchall("SELECT run_name, status FROM runs")
+            not_done = [(r["run_name"], r["status"]) for r in statuses if r["status"] != "done"]
+            assert not not_done, f"runs did not recover: {not_done}"
+            assert not double_booked, f"double-booked slices: {double_booked}"
+
+            # Every orphaned run was reclaimed + reconciled; recovery time is
+            # kill -> its reconciled event, straight from the timeline.
+            recoveries = []
+            for run_id in orphans:
+                evs = await api.db.fetchall(
+                    "SELECT * FROM run_events WHERE run_id = ?"
+                    " AND new_status = 'reconciled' ORDER BY seq",
+                    (run_id,),
+                )
+                assert evs, f"orphaned run {run_id} was never reconciled"
+                recoveries.append(
+                    (from_iso(evs[0]["timestamp"]) - t_kill).total_seconds()
+                )
+            recoveries.sort()
+            from dstack_tpu.utils.common import nearest_rank
+
+            p50 = nearest_rank(recoveries, 0.50) if recoveries else None
+            p90 = nearest_rank(recoveries, 0.90) if recoveries else None
+            return {
+                "metric": "chaos_recovery_p90_s",
+                "value": round(p90, 2) if p90 is not None else 0.0,
+                "unit": "s",
+                "vs_baseline": 1.0,
+                "extra": {
+                    "runs": N,
+                    "completed_pct": 100.0,
+                    "lease_partition_at_kill": partition,
+                    "orphaned_by_kill": len(orphans),
+                    "recovery_p50_s": round(p50, 2) if p50 is not None else None,
+                    "recovery_p90_s": round(p90, 2) if p90 is not None else None,
+                    "double_booked": 0,
+                    "faults_injected": faults.stats(),
+                    "lease_ttl_s": settings.LEASE_TTL,
+                },
+            }
+
+    try:
+        result = asyncio.run(run())
+    finally:
+        (
+            settings.LEASE_TTL, settings.RETRY_BACKOFF_BASE,
+            settings.BREAKER_COOLDOWN, settings.BREAKER_THRESHOLD,
+        ) = saved
+        faults.clear()
+        resilience.reset()
+        FakeRunnerClient.reset()
+    return result
+
+
+def smoke_chaos() -> dict:
+    """`make smoke-chaos`: lease reclaim proven through the REAL server + the
+    native agent. A run executes an actual process via the local backend;
+    scheduler replica A drives it to RUNNING and then dies (its passes simply
+    stop — a crashed process renews nothing). Replica B must reclaim the
+    expired lease, reconcile (probing the live agent), and carry the SAME
+    workload process to `done` — the workload never restarts. Non-zero exit
+    on any missing piece."""
+    import asyncio
+
+    from dstack_tpu.core import tracing
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.background import tasks
+    from dstack_tpu.server.services import leases
+    from tests.common import api_server
+
+    tracing.reset()
+    saved_ttl = settings.LEASE_TTL
+    settings.LEASE_TTL = 2.0
+
+    async def run() -> dict:
+        async with api_server() as api:
+            spec = {
+                "run_spec": {
+                    "run_name": "smoke-chaos",
+                    "configuration": {
+                        "type": "task",
+                        "commands": ["python3 -c 'import time; time.sleep(15)'"],
+                    },
+                }
+            }
+            await api.post("/api/project/main/runs/submit", spec)
+
+            async def passes() -> None:
+                await tasks.process_submitted_jobs(api.db)
+                await tasks.process_running_jobs(api.db)
+                await tasks.process_terminating_jobs(api.db)
+                await tasks.process_runs(api.db)
+                await tasks.process_instances(api.db)
+
+            async def owner() -> str:
+                row = await api.db.fetchone(
+                    "SELECT l.owner FROM run_leases l JOIN runs r ON r.id = l.run_id"
+                    " WHERE r.run_name = 'smoke-chaos'"
+                )
+                return row["owner"] if row else ""
+
+            # Replica A: drive the run onto the real agent, then die.
+            async def drive_a() -> None:
+                with leases.as_replica("smoke-a"):
+                    while True:
+                        await passes()
+                        run = await api.post(
+                            "/api/project/main/runs/get", {"run_name": "smoke-chaos"}
+                        )
+                        if run["status"] == "running":
+                            return
+                        await asyncio.sleep(0.2)
+
+            await asyncio.wait_for(drive_a(), timeout=180)
+            assert await owner() == "smoke-a", await owner()
+            t_kill = time.monotonic()
+
+            # Replica B: reclaim after the TTL and finish the run.
+            reclaimed_at = None
+            status = None
+            with leases.as_replica("smoke-b"):
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    await passes()
+                    if reclaimed_at is None and await owner() == "smoke-b":
+                        reclaimed_at = time.monotonic()
+                    run = await api.post(
+                        "/api/project/main/runs/get", {"run_name": "smoke-chaos"}
+                    )
+                    status = run["status"]
+                    if status in ("done", "failed", "terminated"):
+                        break
+                    await asyncio.sleep(0.2)
+            assert status == "done", f"rescued run ended {status}"
+            assert reclaimed_at is not None, "replica B never took the lease"
+            reclaim_s = reclaimed_at - t_kill
+
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "smoke-chaos"}
+            )
+            recon = [e for e in data["events"] if e["new_status"] == "reconciled"]
+            assert recon, "no reconciled event in the timeline"
+            assert recon[0]["reason"] == "lease_reclaimed", recon[0]
+            assert "smoke-b" in recon[0]["message"], recon[0]
+            assert "1 reachable" in recon[0]["message"], recon[0]
+
+            # The SAME submission finished — reclaim adopted, it didn't restart.
+            subs = await api.db.fetchall(
+                "SELECT DISTINCT submission_num FROM jobs WHERE run_name = 'smoke-chaos'"
+            )
+            assert [s["submission_num"] for s in subs] == [0], subs
+
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            assert "# TYPE dstack_tpu_run_leases gauge" in text
+            assert "# TYPE dstack_tpu_circuit_breaker_state gauge" in text
+            return {
+                "metric": "smoke_chaos",
+                "value": round(reclaim_s, 2),
+                "unit": "s lease reclaim (kill -> new owner)",
+                "reconciled_reason": recon[0]["reason"],
+                "final_status": status,
+            }
+
+    try:
+        result = asyncio.run(run())
+    finally:
+        settings.LEASE_TTL = saved_ttl
+    print(json.dumps(result))
+    return result
+
+
 def main() -> None:
     try:
         import jax

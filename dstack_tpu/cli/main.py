@@ -326,15 +326,18 @@ def cmd_ps(args) -> None:
         runs = client.runs.list()
         if not args.all:
             runs = [r for r in runs if not r.status.is_finished()] or runs[:5]
-        headers = ["NAME", "TYPE", "RESOURCES", "STATUS", "COST", "AGE"]
+        headers = ["NAME", "TYPE", "RESOURCES", "STATUS", "OWNER", "COST", "AGE"]
         if args.verbose:
             headers.append("PHASES")
         rows = []
         for r in runs:
             conf = r.run_spec.configuration
             resources = conf.resources.pretty() if conf.resources else ""
+            # OWNER: which server replica's scheduler holds the run's lease
+            # (multi-replica control plane); finished runs hold no lease.
+            owner = getattr(r, "owner", None) or "-"
             row = [
-                r.run_name, conf.type, resources, r.status.value,
+                r.run_name, conf.type, resources, r.status.value, owner,
                 f"${r.cost:.2f}", _age(r.submitted_at),
             ]
             if args.verbose:

@@ -1,0 +1,177 @@
+"""Fault injection points for chaos testing the control plane.
+
+Named call sites (``runner.request``, ``backend.create_slice``,
+``backend.update``, ``proxy.forward``) call :func:`check` before doing real
+work; an active fault spec makes a configured fraction of those calls fail
+(:class:`FaultInjected`) and/or stall. The caller converts the injection into
+its site's natural failure type (RunnerError, BackendError, a 502), so the
+whole production failure path downstream of the injection point is exercised —
+disconnect grace windows, gang retries, circuit breakers, lease reclaim.
+
+Configuration, in precedence order:
+
+1. ``configure(spec)`` — programmatic (bench_chaos, tests).
+2. ``DSTACK_TPU_FAULTS`` — a JSON spec in the environment.
+3. ``DSTACK_TPU_FAULTS_FILE`` — path to a JSON spec re-read when its mtime
+   changes (flip faults on a LIVE server by editing the file; throttled to
+   one stat per second).
+
+Spec shape::
+
+    {"seed": 7,
+     "sites": {
+        "runner.request":       {"fail": 0.2, "error": "injected agent drop"},
+        "backend.create_slice": {"fail": 0.5, "times": 6},
+        "proxy.forward":        {"fail": 1.0, "match": ":8801"},
+        "backend.update":       {"delay": 0.2, "delay_p": 0.5}}}
+
+Per site: ``fail`` — probability a call raises; ``delay``/``delay_p`` —
+stall seconds and the probability of stalling; ``times`` — total injection
+budget (delays + failures) after which the site goes quiet; ``match`` —
+substring the call's detail must contain; ``error`` — message carried by the
+raised FaultInjected. ``seed`` makes a schedule reproducible. The whole module
+is a no-op (one dict lookup) when nothing is configured — production hot paths
+pay nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["FaultInjected", "check", "configure", "clear", "active", "stats"]
+
+
+class FaultInjected(Exception):
+    """Raised by an injection point; callers convert to their native error."""
+
+    def __init__(self, site: str, message: str = ""):
+        super().__init__(message or f"fault injected at {site}")
+        self.site = site
+
+
+_lock = threading.Lock()
+_spec: Optional[dict] = None          # programmatic spec (configure())
+_env_spec: Optional[dict] = None      # parsed DSTACK_TPU_FAULTS cache
+_env_raw: Optional[str] = None
+_file_spec: Optional[dict] = None     # parsed DSTACK_TPU_FAULTS_FILE cache
+_file_mtime: Optional[float] = None
+_file_checked_at: float = 0.0
+_rng = random.Random()
+_counts: Dict[str, int] = {}
+_budget: Dict[str, int] = {}
+
+
+def _normalize(spec: dict) -> dict:
+    sites = spec.get("sites", spec)  # bare {site: conf} accepted
+    return {"seed": spec.get("seed"), "sites": dict(sites)}
+
+
+def configure(spec: Optional[dict]) -> None:
+    """Install a fault spec programmatically (None removes it). Resets the
+    per-site counters/budgets and reseeds the schedule."""
+    global _spec
+    with _lock:
+        _spec = _normalize(spec) if spec else None
+        _counts.clear()
+        _budget.clear()
+        if _spec and _spec.get("seed") is not None:
+            _rng.seed(_spec["seed"])
+
+
+def clear() -> None:
+    configure(None)
+
+
+def _current_spec() -> Optional[dict]:
+    global _env_spec, _env_raw, _file_spec, _file_mtime, _file_checked_at
+    if _spec is not None:
+        return _spec
+    raw = os.getenv("DSTACK_TPU_FAULTS")
+    if raw:
+        if raw != _env_raw:
+            try:
+                _env_spec = _normalize(json.loads(raw))
+                if _env_spec.get("seed") is not None:
+                    _rng.seed(_env_spec["seed"])
+            except ValueError:
+                _env_spec = None
+            _env_raw = raw
+        return _env_spec
+    path = os.getenv("DSTACK_TPU_FAULTS_FILE")
+    if path:
+        now = time.monotonic()
+        if now - _file_checked_at >= 1.0:
+            _file_checked_at = now
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                _file_spec, _file_mtime = None, None
+                return None
+            if mtime != _file_mtime:
+                _file_mtime = mtime
+                try:
+                    with open(path) as f:
+                        _file_spec = _normalize(json.load(f))
+                    if _file_spec.get("seed") is not None:
+                        _rng.seed(_file_spec["seed"])
+                except (OSError, ValueError):
+                    _file_spec = None
+        return _file_spec
+    return None
+
+
+def active() -> bool:
+    return _current_spec() is not None
+
+
+def stats() -> Dict[str, int]:
+    """Injections delivered so far, by site (chaos-bench reporting)."""
+    with _lock:
+        return dict(_counts)
+
+
+def _consume_budget(site: str, conf: dict) -> bool:
+    times = conf.get("times")
+    if times is None:
+        return True
+    with _lock:
+        left = _budget.get(site, int(times))
+        if left <= 0:
+            return False
+        _budget[site] = left - 1
+    return True
+
+
+def _count(site: str) -> None:
+    with _lock:
+        _counts[site] = _counts.get(site, 0) + 1
+
+
+async def check(site: str, detail: str = "") -> None:
+    """Injection point. May sleep (delay faults) and/or raise FaultInjected.
+    A no-op unless a spec names this site (and its ``match`` hits `detail`)."""
+    spec = _current_spec()
+    if spec is None:
+        return
+    conf = spec["sites"].get(site)
+    if conf is None:
+        return
+    match = conf.get("match")
+    if match and match not in detail:
+        return
+    delay = conf.get("delay")
+    if delay and _rng.random() < conf.get("delay_p", 1.0):
+        if _consume_budget(site, conf):
+            _count(site)
+            await asyncio.sleep(float(delay))
+    p = conf.get("fail", 0.0)
+    if p and _rng.random() < p:
+        if _consume_budget(site, conf):
+            _count(site)
+            raise FaultInjected(site, conf.get("error", "") or f"{site} {detail}".strip())

@@ -341,6 +341,9 @@ class Run(CoreModel):
     cost: float = 0.0
     service: Optional[ServiceSpec] = None
     error: Optional[str] = None
+    # Which server replica's scheduler currently owns this run (run_leases);
+    # None for finished runs and single-replica deployments without a lease.
+    owner: Optional[str] = None
 
     @property
     def run_name(self) -> str:

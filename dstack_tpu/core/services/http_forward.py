@@ -173,7 +173,7 @@ async def forward(
             async with aiohttp.ClientSession(timeout=timeout) as session:
                 async with session.request(
                     request.method, url, headers=headers, data=body,
-                    allow_redirects=False,
+                    allow_redirects=False, timeout=timeout,
                 ) as upstream:
                     return await _stream(upstream)
     except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as e:

@@ -99,6 +99,21 @@ async def _on_startup(app: web.Application) -> None:
         await proxy_service.prime_stats(db)
     except Exception:
         logger.exception("priming service stats failed; starting with an empty window")
+    # Crash-safe startup reconciliation: adopt active runs whose lease holder
+    # died (or whose lease is ours from a previous incarnation) BEFORE the
+    # scheduler loops start — killing a replica mid-provision loses nothing
+    # but the interrupted pass (services/leases.py).
+    try:
+        from dstack_tpu.server.services import leases as leases_service
+
+        adopted = await leases_service.startup_reconcile(db)
+        if adopted:
+            logger.info(
+                "replica %s adopted %d orphaned in-flight run(s) at startup",
+                leases_service.replica_id(), adopted,
+            )
+    except Exception:
+        logger.exception("startup lease reconciliation failed; continuing")
     if app["run_background_tasks"]:
         from dstack_tpu.server.background import start_background_tasks
 

@@ -49,16 +49,18 @@ from dstack_tpu.core.models.runs import (
     RunStatus,
     RunTerminationReason,
 )
-from dstack_tpu.core import tracing
+from dstack_tpu.core import faults, tracing
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, in_clause, loads, new_id
 from dstack_tpu.server.services import backends as backends_service
 from dstack_tpu.server.services import events as events_service
 from dstack_tpu.server.services import fleets as fleets_service
 from dstack_tpu.server.services import instances as instances_service
+from dstack_tpu.server.services import leases as leases_service
 from dstack_tpu.server.services import logs as logs_service
 from dstack_tpu.server.services import offers as offers_service
 from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services import resilience
 from dstack_tpu.server.services.jobs import (
     build_cluster_info,
     job_jpd,
@@ -136,6 +138,25 @@ async def _fan_out(coros: Iterable[Awaitable]) -> None:
             raise r
 
 
+async def _claim_owned(db: Database, run_ids: Iterable[str]) -> set:
+    """Lease gate for the run-keyed passes: claim/renew the candidate runs and
+    return the subset this replica owns. Runs reclaimed from an expired holder
+    (their replica died mid-work) are reconciled first — runner probes + a
+    ``reconciled`` run_event — before this pass schedules them."""
+    owned, reclaimed = await leases_service.claim_runs(db, run_ids)
+    if reclaimed:
+        # Concurrent: a mass reclaim (the dead replica owned many runs) must
+        # not serialize one probe-timeout per run in front of this pass.
+        async def _reconcile(run_id: str) -> None:
+            try:
+                await leases_service.reconcile_run(db, run_id)
+            except Exception:
+                logger.exception("reconciling reclaimed run %s failed", run_id)
+
+        await asyncio.gather(*(_reconcile(r) for r in reclaimed))
+    return owned
+
+
 # =====================================================================================
 # process_submitted_jobs
 
@@ -157,6 +178,13 @@ async def process_submitted_jobs(db: Database, batch: Optional[int] = None) -> N
     groups: Dict[Tuple[str, int, int], List] = {}
     for r in rows:
         groups.setdefault((r["run_id"], r["replica_num"], r["submission_num"]), []).append(r)
+    # Claim only what this pass will actually process: claiming the whole
+    # over-fetched candidate list would let one replica hoard every queued run
+    # while its siblings idle (last_processed_at ordering rotates the rest
+    # into later passes).
+    keys = list(groups)[: batch]
+    owned = await _claim_owned(db, (key[0] for key in keys))
+    groups = {key: groups[key] for key in keys if key[0] in owned}
 
     async def _one(run_id: str, replica_num: int, submission_num: int) -> None:
         # Keyed lock + fresh gang re-fetch inside _place_replica: an overlapping
@@ -168,7 +196,7 @@ async def process_submitted_jobs(db: Database, batch: Optional[int] = None) -> N
             with tracing.span("scheduler.place_replica", run=run_id, replica=replica_num):
                 await _place_replica(db, run_id, replica_num, submission_num)
 
-    await _fan_out(_one(*key) for key in list(groups)[:batch])
+    await _fan_out(_one(*key) for key in groups)
 
 
 async def _place_replica(db: Database, run_id: str, replica_num: int, submission_num: int) -> None:
@@ -240,6 +268,7 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
     )
     offers: Optional[List[InstanceOffer]] = None
     placed_all = True
+    breaker_open = False
     for s in range(num_slices):
         slice_jobs = job_rows[s * hosts_per_slice : (s + 1) * hosts_per_slice]
         if not slice_jobs or slice_jobs[0]["status"] != "submitted":
@@ -281,14 +310,23 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
                 db, project_row, requirements, profile
             )
             offers = [o for o in offers if o.availability.is_available()]
-        created = await _provision_slice(
+        outcome = await _provision_slice(
             db, project_row, run_row, run_spec, offers, slice_jobs, volumes=run_volumes
         )
-        if not created:
+        if outcome != "created":
             placed_all = False
+            if outcome == "breaker_open":
+                breaker_open = True
 
     if not placed_all:
-        await _handle_no_capacity(db, run_row, job_rows, profile)
+        if breaker_open:
+            # Graceful degradation: at least one matching offer sits behind a
+            # backend whose circuit is open. That is not "no capacity" — the
+            # backend is (temporarily) unreachable. Requeue and say why instead
+            # of burning the run's retry window on a dead API.
+            await _requeue_breaker_open(db, run_row, job_rows)
+        else:
+            await _handle_no_capacity(db, run_row, job_rows, profile)
 
 
 def _assign_job_tx(conn, job_row, instance_id: str, jpd_dict: dict) -> None:
@@ -346,9 +384,11 @@ def _volume_attachment_data(volume, index: int = 0) -> dict:
 async def _provision_slice(
     db: Database, project_row, run_row, run_spec: RunSpec, offers: List[InstanceOffer],
     slice_jobs: List, volumes: Optional[List] = None,
-) -> bool:
+) -> str:
     """Try offers in price order until a slice provisions; create instance rows and
-    assign the gang. Returns False when every offer fails with no capacity.
+    assign the gang. Returns "created", "no_capacity" (every offer failed or was
+    out of stock), or "breaker_open" (nothing created AND at least one offer was
+    skipped because its backend's circuit is open — requeue, don't fail).
 
     The cloud create happens first (it cannot be inside a DB transaction), but ALL the
     bookkeeping it implies — fleet resolution, slice rows, busy marks, the gang's job
@@ -356,7 +396,15 @@ async def _provision_slice(
     process_submitted_jobs.py:193-241). A crash after create_slice but before commit
     leaves zero rows: the orphaned cloud slice is visible (billed) but the scheduler
     state is consistent and the next pass re-provisions cleanly."""
+    breaker_skipped = False
     for offer in offers[: settings.MAX_OFFERS_TRIED]:
+        target = f"backend:{offer.backend}"
+        if resilience.is_open(target):
+            # Dead backend API: don't spend this pass's budget dialing it.
+            # (A cooled-down breaker reads not-open here, so exactly one offer
+            # per cooldown becomes the half-open probe.)
+            breaker_skipped = True
+            continue
         try:
             compute = await backends_service.get_compute(db, project_row, offer.backend)
         except Exception:
@@ -364,6 +412,16 @@ async def _provision_slice(
         name = f"{run_row['run_name']}-{slice_jobs[0]['replica_num']}-{new_id()[:8]}"
         # Authorized keys: the user's run key plus the server's tunnel identity.
         keys = [k for k in (run_spec.ssh_key_pub, _server_public_key()) if k]
+
+        async def _create(compute=compute, offer=offer, name=name, keys=keys):
+            try:
+                await faults.check("backend.create_slice", detail=offer.backend)
+            except faults.FaultInjected as e:
+                raise BackendError(f"fault injected: {e}") from e
+            return await compute.create_slice(
+                offer, name, ssh_public_key="\n".join(keys), volumes=volumes or None
+            )
+
         try:
             with tracing.span(
                 "backend.create_slice",
@@ -371,11 +429,31 @@ async def _provision_slice(
                 labels={"backend": offer.backend},
                 run=run_row["run_name"],
             ):
-                jpds = await compute.create_slice(
-                    offer, name, ssh_public_key="\n".join(keys), volumes=volumes or None
+                # Single attempt (a timed-out create may still have provisioned
+                # — retrying could double-buy), but with an explicit deadline
+                # and breaker accounting: repeated failures open the backend's
+                # circuit so later gangs skip it. A NoCapacityError is a
+                # healthy backend saying no — it closes the breaker.
+                jpds = await resilience.with_retry(
+                    _create,
+                    target=target,
+                    op="create_slice",
+                    attempts=1,
+                    timeout=settings.BACKEND_CALL_TIMEOUT,
+                    retry_on=(BackendError, asyncio.TimeoutError),
+                    treat_as_success=(NoCapacityError,),
                 )
+        except resilience.BreakerOpenError:
+            breaker_skipped = True
+            continue
         except NoCapacityError as e:
             logger.debug("offer %s/%s no capacity: %s", offer.backend, offer.instance.name, e)
+            continue
+        except asyncio.TimeoutError:
+            logger.warning(
+                "offer %s/%s create_slice exceeded %ss deadline",
+                offer.backend, offer.instance.name, settings.BACKEND_CALL_TIMEOUT,
+            )
             continue
         except BackendError as e:
             logger.warning("offer %s/%s provisioning failed: %s", offer.backend, offer.instance.name, e)
@@ -416,8 +494,35 @@ async def _provision_slice(
                     )
 
         await db.run(_commit_placement)
-        return True
-    return False
+        return "created"
+    return "breaker_open" if breaker_skipped else "no_capacity"
+
+
+async def _requeue_breaker_open(db: Database, run_row, job_rows: List) -> None:
+    """Skip-and-requeue: the gang stays queued while its backend's circuit is
+    open, with ONE reason'd run_event (not one per 1s pass) so the timeline
+    answers "why isn't my run placing"."""
+    submitted = [r for r in job_rows if r["status"] == "submitted"]
+    await touch_jobs(db, submitted)
+    last = await db.fetchone(
+        "SELECT reason FROM run_events WHERE run_id = ? ORDER BY seq DESC LIMIT 1",
+        (run_row["id"],),
+    )
+    if last is not None and last["reason"] == "backend_circuit_open":
+        return
+
+    def _tx(conn) -> None:
+        events_service.record_event_tx(
+            conn,
+            run_row["id"],
+            run_row["status"],
+            old_status=run_row["status"],
+            actor="scheduler",
+            reason="backend_circuit_open",
+            message="placement deferred: backend circuit breaker open; will retry",
+        )
+
+    await db.run(_tx)
 
 
 def _server_public_key() -> str:
@@ -483,6 +588,8 @@ async def process_running_jobs(db: Database, batch: Optional[int] = None) -> Non
     by_run: Dict[str, List] = {}
     for row in rows:
         by_run.setdefault(row["run_id"], []).append(row)
+    owned = await _claim_owned(db, by_run)
+    by_run = {rid: rr for rid, rr in by_run.items() if rid in owned}
 
     async def _one_run(run_id: str, run_rows: List) -> None:
         tracing.new_trace()
@@ -854,8 +961,33 @@ async def _update_jpd_from_backend(db: Database, job_row, jpd) -> Optional[JobPr
     except Exception:
         await touch_jobs(db, [job_row])
         return jpd
+
+    async def _poll():
+        try:
+            await faults.check("backend.update", detail=jpd.backend)
+        except faults.FaultInjected as e:
+            raise asyncio.TimeoutError(f"fault injected: {e}") from e
+        return await compute.update_provisioning_data(jpd)
+
     try:
-        updated = await compute.update_provisioning_data(jpd)
+        # Idempotent read: retried once under an explicit deadline. A
+        # NoCapacityError/BackendError is the backend ANSWERING that the slice
+        # failed — a real result, so it closes the breaker and propagates;
+        # only timeouts/transport trouble count against the circuit.
+        updated = await resilience.with_retry(
+            _poll,
+            target=f"backend:{jpd.backend}",
+            op="update_provisioning_data",
+            attempts=2,
+            timeout=settings.BACKEND_POLL_TIMEOUT,
+            retry_on=(asyncio.TimeoutError,),
+            treat_as_success=(NoCapacityError, BackendError),
+        )
+    except (resilience.BreakerOpenError, asyncio.TimeoutError):
+        # Backend API unreachable (or its circuit already open): the slice may
+        # be fine — requeue the poll rather than terminating the gang.
+        await touch_jobs(db, [job_row])
+        return jpd
     except (NoCapacityError, BackendError) as e:
         logger.info("slice %s failed to provision: %s", jpd.slice_id, e)
         for r in await _replica_rows(db, job_row):
@@ -956,6 +1088,8 @@ async def process_terminating_jobs(db: Database, batch: Optional[int] = None) ->
     by_run: Dict[str, List] = {}
     for row in rows:
         by_run.setdefault(row["run_id"], []).append(row)
+    owned = await _claim_owned(db, by_run)
+    by_run = {rid: rr for rid, rr in by_run.items() if rid in owned}
 
     async def _one_run(run_id: str, run_rows: List) -> None:
         tracing.new_trace()
@@ -1016,6 +1150,12 @@ async def process_runs(db: Database, batch: Optional[int] = None) -> None:
         " ORDER BY last_processed_at IS NOT NULL, last_processed_at LIMIT ?",
         (batch,),
     )
+    owned = await _claim_owned(db, (row["id"] for row in rows))
+    rows = [row for row in rows if row["id"] in owned]
+    # Leases of finished/deleted runs are released at finalize; the sweep
+    # catches a crash between the terminal transition and the release.
+    if settings.RUN_LEASES_ENABLED:
+        await leases_service.sweep(db)
 
     async def _one(row) -> None:
         tracing.new_trace()
@@ -1083,6 +1223,8 @@ async def _process_terminating_run(db: Database, run_row) -> None:
                 conn, run_row["id"], final,
                 old_status=run_row["status"], actor="scheduler", reason=reason.value,
             )
+            # Ownership ends atomically with the terminal transition.
+            leases_service.release_tx(conn, run_row["id"])
 
         await db.run(_finalize)
 
@@ -1191,9 +1333,20 @@ async def _process_active_run(db: Database, run_row) -> None:
         await db.run(_run_status)
 
 
-def _retry_delay(submission_num: int) -> float:
-    """Exponential backoff between resubmissions (reference _get_retry_delay :206)."""
-    return min(settings.RETRY_BACKOFF_BASE * (2 ** submission_num), settings.RETRY_BACKOFF_MAX)
+def _retry_delay(submission_num: int, jitter_key: str = "") -> float:
+    """Jittered exponential backoff between resubmissions (reference
+    _get_retry_delay :206). The jitter is DETERMINISTIC per (run, submission) —
+    hashed into [0.5, 1.0) of the exponential cap — so the elapsed-vs-delay
+    comparison is stable across passes, while a capacity stockout that failed
+    50 runs at once spreads their resubmissions over half the window instead
+    of stampeding the backend in sync."""
+    import zlib
+
+    cap = min(settings.RETRY_BACKOFF_BASE * (2 ** submission_num), settings.RETRY_BACKOFF_MAX)
+    if not jitter_key:
+        return cap
+    frac = (zlib.crc32(jitter_key.encode()) % 1024) / 1024.0
+    return cap * (0.5 + 0.5 * frac)
 
 
 async def _maybe_retry_replica(
@@ -1236,7 +1389,8 @@ async def _maybe_retry_replica(
     )
     submission_num = max(r["submission_num"] for r in replica_rows)
     if last_finished is not None and (now_utc() - last_finished).total_seconds() < _retry_delay(
-        submission_num
+        submission_num,
+        jitter_key=f"{run_row['id']}:{replica_rows[0]['replica_num']}:{submission_num}",
     ):
         return True  # backoff window
 

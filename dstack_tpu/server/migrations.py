@@ -264,6 +264,28 @@ MIGRATIONS: List[Tuple[int, str]] = [
         ALTER TABLE jobs ADD COLUMN metrics_sampled_at TEXT;
         """,
     ),
+    (
+        5,
+        # Run-ownership leases for multi-replica scheduling (generalizes the
+        # migration-1 conditional slice claim to whole runs): each scheduler
+        # pass processes only runs whose lease it holds; expired leases are
+        # reclaimed by any live replica, which then reconciles the orphaned
+        # run (services/leases.py). `reclaims` counts ownership changes — a
+        # hot counter there means replicas are flapping or the TTL is too
+        # tight for the pass cadence.
+        """
+        CREATE TABLE run_leases (
+            run_id TEXT PRIMARY KEY,
+            owner TEXT NOT NULL,
+            acquired_at TEXT NOT NULL,
+            heartbeat_at TEXT NOT NULL,
+            expires_at TEXT NOT NULL,
+            reclaims INTEGER NOT NULL DEFAULT 0
+        );
+        CREATE INDEX ix_run_leases_owner ON run_leases(owner);
+        CREATE INDEX ix_run_leases_expires ON run_leases(expires_at);
+        """,
+    ),
 ]
 
 

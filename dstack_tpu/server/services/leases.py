@@ -1,0 +1,286 @@
+"""Lease-based run ownership: the multi-replica scheduling contract.
+
+Generalizes the PR 1 conditional slice-claim from one placement decision to
+the whole run lifecycle. Every run-keyed scheduler pass (submitted / running /
+terminating jobs, runs) first claims the runs it is about to process; a claim
+succeeds when the run is unleased, already ours (renewal), or the holder's
+lease expired (reclaim). N server replicas sharing one database therefore each
+own a disjoint partition of runs with no coordinator: the partition is just
+whoever claimed first, rebalanced by the TTL when a replica dies.
+
+All claim logic is conditional SQL inside one transaction, so it is correct
+under both sqlite (single writer thread) and postgres (row-level locking):
+two replicas racing for an expired lease resolve by UPDATE rowcount, exactly
+like ``mark_slice_busy_tx``.
+
+Reclaiming an expired lease means the previous owner died (or stalled past the
+TTL) with the run possibly mid-provision: the new owner *reconciles* before
+scheduling — re-probe the runner of every in-flight job, re-derive the FSM
+position from the rows (which are transactionally consistent — every transition
+commits atomically with its run_event), and emit a ``reconciled`` run_event so
+the timeline records the ownership change and what was found. Nothing is
+rolled back: the job FSM is re-entrant by design (each pass re-fetches fresh
+rows), so reconciliation is observation + adoption, not repair.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import contextvars
+import logging
+import os
+import socket
+import uuid
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, in_clause
+from dstack_tpu.utils.common import now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+# Job states that mean "the control plane has work in flight for this run"
+# (provisioned capacity, a submitted agent, or a live workload).
+IN_FLIGHT_JOB_STATUSES = ("provisioning", "pulling", "running")
+_ACTIVE_RUN_FILTER = "status NOT IN ('terminated', 'failed', 'done')"
+
+# The bench/chaos harness runs several logical replicas inside one process:
+# the contextvar override scopes a replica identity to an asyncio task.
+_replica_override: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "dstack_tpu_replica_id", default=None
+)
+_process_replica_id: Optional[str] = None
+
+
+def replica_id() -> str:
+    """This scheduler's lease identity: DSTACK_TPU_REPLICA_ID, else a
+    host-pid-rand string minted once per process (a restarted server is a NEW
+    replica; its previous incarnation's leases age out via the TTL)."""
+    override = _replica_override.get()
+    if override is not None:
+        return override
+    global _process_replica_id
+    if settings.REPLICA_ID:
+        return settings.REPLICA_ID
+    if _process_replica_id is None:
+        _process_replica_id = (
+            f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        )
+    return _process_replica_id
+
+
+@contextlib.contextmanager
+def as_replica(rid: str):
+    """Scope a replica identity to the current task (chaos harness / tests)."""
+    token = _replica_override.set(rid)
+    try:
+        yield
+    finally:
+        _replica_override.reset(token)
+
+
+def _expiry(now) -> str:
+    from datetime import timedelta
+
+    return to_iso(now + timedelta(seconds=settings.LEASE_TTL))
+
+
+def claim_runs_tx(
+    conn, run_ids: Sequence[str], owner: str
+) -> Tuple[Set[str], Set[str]]:
+    """Claim/renew leases inside an open transaction. Returns
+    ``(owned, reclaimed)``: run ids this owner now holds, and the subset taken
+    over from an expired holder (those runs need reconciliation — their
+    previous owner died mid-work)."""
+    now = now_utc()
+    now_s, exp_s = to_iso(now), _expiry(now)
+    owned: Set[str] = set()
+    reclaimed: Set[str] = set()
+    for run_id in run_ids:
+        # Renewal first: the common steady-state case is one UPDATE, no read.
+        cur = conn.execute(
+            "UPDATE run_leases SET heartbeat_at = ?, expires_at = ?"
+            " WHERE run_id = ? AND owner = ?",
+            (now_s, exp_s, run_id, owner),
+        )
+        if cur.rowcount == 1:
+            owned.add(run_id)
+            continue
+        # Fresh claim: INSERT-if-absent settles races via the primary key.
+        cur = conn.execute(
+            "INSERT INTO run_leases (run_id, owner, acquired_at, heartbeat_at,"
+            " expires_at) VALUES (?, ?, ?, ?, ?) ON CONFLICT (run_id) DO NOTHING",
+            (run_id, owner, now_s, now_s, exp_s),
+        )
+        if cur.rowcount == 1:
+            owned.add(run_id)
+            continue
+        # Held by someone else: take over only if their lease expired. The
+        # conditional UPDATE is the whole consensus — a racing replica's
+        # transaction sees rowcount 0 and moves on.
+        cur = conn.execute(
+            "UPDATE run_leases SET owner = ?, acquired_at = ?, heartbeat_at = ?,"
+            " expires_at = ?, reclaims = reclaims + 1"
+            " WHERE run_id = ? AND owner != ? AND expires_at < ?",
+            (owner, now_s, now_s, exp_s, run_id, owner, now_s),
+        )
+        if cur.rowcount == 1:
+            owned.add(run_id)
+            reclaimed.add(run_id)
+    return owned, reclaimed
+
+
+async def claim_runs(
+    db: Database, run_ids: Iterable[str]
+) -> Tuple[Set[str], Set[str]]:
+    """Claim (or renew) leases on `run_ids` for this replica; one transaction.
+    With leases disabled everything is owned and nothing is ever reclaimed."""
+    run_ids = list(dict.fromkeys(run_ids))
+    if not run_ids:
+        return set(), set()
+    if not settings.RUN_LEASES_ENABLED:
+        return set(run_ids), set()
+    owner = replica_id()
+    result = await db.run(lambda conn: claim_runs_tx(conn, run_ids, owner))
+    owned, reclaimed = result
+    if reclaimed:
+        logger.info(
+            "replica %s reclaimed %d expired run lease(s): %s",
+            owner, len(reclaimed), ", ".join(sorted(reclaimed)),
+        )
+    return owned, reclaimed
+
+
+def release_tx(conn, run_id: str) -> None:
+    """Drop a run's lease inside the transaction that finalizes the run, so
+    ownership ends atomically with the terminal transition."""
+    conn.execute("DELETE FROM run_leases WHERE run_id = ?", (run_id,))
+
+
+async def release_runs(db: Database, run_ids: Iterable[str]) -> None:
+    run_ids = list(run_ids)
+    if not run_ids:
+        return
+    await db.execute(
+        f"DELETE FROM run_leases WHERE run_id IN ({in_clause(run_ids)})", run_ids
+    )
+
+
+async def sweep(db: Database) -> None:
+    """Drop leases whose run is finished, deleted, or gone — the table must
+    track only live scheduling work (finalize already releases; this catches
+    crashes between the terminal transition and the release)."""
+    await db.execute(
+        "DELETE FROM run_leases WHERE run_id NOT IN"
+        f" (SELECT id FROM runs WHERE deleted = 0 AND {_ACTIVE_RUN_FILTER})"
+    )
+
+
+async def owners(db: Database, run_ids: Sequence[str]) -> dict:
+    """run_id -> owner for the given runs (ps/API surface)."""
+    if not run_ids:
+        return {}
+    rows = await db.fetch_in(
+        "SELECT run_id, owner FROM run_leases WHERE run_id IN ({in})", run_ids
+    )
+    return {r["run_id"]: r["owner"] for r in rows}
+
+
+async def reconcile_run(db: Database, run_id: str, reason: str = "lease_reclaimed") -> None:
+    """Adopt an orphaned run: re-probe the runner of every in-flight job,
+    re-derive the FSM position from the rows, and emit a ``reconciled``
+    run_event recording both. The FSM itself needs no repair — every
+    transition commits atomically with its event, so the rows ARE the
+    position; what a dead replica loses is only the work of its interrupted
+    pass, which the next pass redoes from the fresh rows."""
+    from dstack_tpu.server.services import events as events_service
+    from dstack_tpu.server.services.jobs import job_jpd, job_jrd
+
+    run_row = await db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+    if run_row is None:
+        return
+    job_rows = await db.fetch_in(
+        "SELECT * FROM jobs WHERE run_id = ? AND status IN ({in})",
+        IN_FLIGHT_JOB_STATUSES,
+        params=(run_id,),
+    )
+    async def _probe(row) -> Optional[bool]:
+        jpd = job_jpd(row)
+        if jpd is None or jpd.hostname is None:
+            return None  # still resolving its endpoint; nothing to probe yet
+        try:
+            # Late import: background.tasks imports this module, and tests/
+            # bench monkeypatch tasks.get_runner_client — resolve through it
+            # so reconciliation probes the same (possibly faked) agents.
+            from dstack_tpu.server.background import tasks as _tasks
+
+            client = _tasks.get_runner_client(jpd, job_jrd(row))
+            return await client.healthcheck() is not None
+        except Exception:
+            return False
+
+    # Probes fan out: a gang of dead agents must cost one healthcheck
+    # timeout, not hosts-per-gang of them (the adopting replica is a live
+    # scheduler — reconciliation can't stall its passes for minutes).
+    outcomes = await asyncio.gather(*(_probe(row) for row in job_rows))
+    probed_ok = sum(1 for o in outcomes if o is True)
+    probed_bad = sum(1 for o in outcomes if o is False)
+    message = (
+        f"adopted by {replica_id()}: {len(job_rows)} in-flight job(s),"
+        f" {probed_ok} reachable, {probed_bad} unreachable"
+    )
+
+    def _tx(conn) -> None:
+        events_service.record_event_tx(
+            conn,
+            run_id,
+            "reconciled",
+            old_status=run_row["status"],
+            actor="scheduler",
+            reason=reason,
+            message=message,
+        )
+
+    await db.run(_tx)
+    logger.info("run %s reconciled (%s): %s", run_row["run_name"], reason, message)
+
+
+async def startup_reconcile(db: Database) -> int:
+    """Crash-safe startup: adopt active runs with in-flight jobs whose lease is
+    missing, expired, or (with a pinned DSTACK_TPU_REPLICA_ID) left over from
+    this replica's previous incarnation — killing a replica mid-provision loses
+    nothing but the interrupted pass. Returns the number of runs adopted."""
+    if not settings.RUN_LEASES_ENABLED:
+        return 0
+    rows = await db.fetchall(
+        f"SELECT r.id FROM runs r WHERE r.deleted = 0 AND r.{_ACTIVE_RUN_FILTER}"
+        " AND EXISTS (SELECT 1 FROM jobs j WHERE j.run_id = r.id AND j.status IN"
+        f" ({','.join(repr(s) for s in IN_FLIGHT_JOB_STATUSES)}))"
+    )
+    candidate_ids = [r["id"] for r in rows]
+    if not candidate_ids:
+        return 0
+    me = replica_id()
+    now_s = to_iso(now_utc())
+    lease_rows = await db.fetch_in(
+        "SELECT run_id, owner, expires_at FROM run_leases WHERE run_id IN ({in})",
+        candidate_ids,
+    )
+    leases = {r["run_id"]: r for r in lease_rows}
+    orphans = [
+        rid
+        for rid in candidate_ids
+        if rid not in leases
+        or leases[rid]["owner"] == me
+        or leases[rid]["expires_at"] < now_s
+    ]
+    if not orphans:
+        return 0
+    owned, _ = await claim_runs(db, orphans)
+    for rid in sorted(owned):
+        try:
+            await reconcile_run(db, rid, reason="startup")
+        except Exception:
+            logger.exception("startup reconciliation of run %s failed", rid)
+    return len(owned)

@@ -313,6 +313,32 @@ async def render_metrics(db: Database) -> str:
     for key, (family, help_) in engine_families.items():
         sections.append(_fmt(family, help_, "gauge", engine_samples[key]))
 
+    # Control-plane fault-tolerance surfaces: who owns which runs (lease
+    # sharding across server replicas) and which external targets are
+    # circuit-broken. Both families render even when empty so dashboards can
+    # discover them from a cold server.
+    rows = await db.fetchall(
+        "SELECT owner, COUNT(*) AS n FROM run_leases GROUP BY owner ORDER BY owner"
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_leases",
+            "Live run leases held, by owner replica",
+            "gauge",
+            [({"owner": r["owner"]}, float(r["n"])) for r in rows],
+        )
+    )
+    from dstack_tpu.server.services import resilience
+
+    sections.append(
+        _fmt(
+            "dstack_tpu_circuit_breaker_state",
+            "Circuit breaker state by external target (0=closed, 1=half-open, 2=open)",
+            "gauge",
+            [({"target": t}, v) for t, v in resilience.snapshot()],
+        )
+    )
+
     # Background loop lag: how far behind schedule each processing loop started
     # its latest pass (0 = on time; sustained growth = an overloaded loop).
     sections.append(

@@ -645,16 +645,34 @@ async def proxy_request(
         )
     cursor = _rr.get(entry.run_id, 0)
     _rr[entry.run_id] = cursor + 1
-    host, local_port = entry.endpoints[cursor % len(entry.endpoints)]
 
+    from dstack_tpu.core import faults
     from dstack_tpu.core.services.http_forward import forward
+    from dstack_tpu.server.services import resilience
+
+    def _pick(endpoints, tried) -> Optional[Tuple[str, int]]:
+        """Round-robin over untried endpoints, preferring ones whose circuit
+        is closed; if every candidate's breaker is open, use them anyway —
+        degraded service beats refusing outright."""
+        candidates = [ep for ep in endpoints or [] if ep not in tried]
+        if not candidates:
+            return None
+        closed = [
+            ep for ep in candidates
+            if not resilience.is_open(f"replica:{ep[0]}:{ep[1]}")
+        ]
+        pool = closed or candidates
+        return pool[cursor % len(pool)]
 
     t0 = time.monotonic()
+    started = False  # headers/chunks already relayed: retrying is impossible
 
     def _on_first_chunk(upstream) -> None:
         # Streamed/SSE responses: the first body chunk is the first token —
         # record TTFT as the latency sample (the full stream duration would
         # poison the autoscaler signal) plus the engine backlog it reported.
+        nonlocal started
+        started = True
         elapsed = time.monotonic() - t0
         stats.record_latency(entry.run_id, elapsed)
         tracing.observe(
@@ -664,15 +682,44 @@ async def proxy_request(
 
     stats.record_inflight(entry.run_id, +1)
     try:
-        resp = await forward(
-            request, host, local_port, tail, body=body,
-            on_first_chunk=_on_first_chunk,
-        )
-    except web.HTTPBadGateway:
-        # A cached endpoint went dark (replica died, tunnel dropped): rebuild
-        # the route on the next request instead of pinning traffic to it.
-        route_table.invalidate(*entry.key)
-        raise
+        tried: List[Tuple[str, int]] = []
+        while True:
+            picked = _pick(entry.endpoints, tried)
+            if picked is None:
+                # Nothing left to try: drop the (re-resolved) entry so the
+                # next request rebuilds from live state instead of a route
+                # whose only endpoints just failed.
+                route_table.invalidate(*entry.key)
+                raise web.HTTPBadGateway(text="replica unreachable")
+            host, local_port = picked
+            target = f"replica:{host}:{local_port}"
+            try:
+                try:
+                    await faults.check("proxy.forward", detail=f"{host}:{local_port}")
+                except faults.FaultInjected as e:
+                    raise web.HTTPBadGateway(text=f"fault injected: {e}")
+                resp = await forward(
+                    request, host, local_port, tail, body=body,
+                    on_first_chunk=_on_first_chunk,
+                )
+                resilience.record_success(target)
+                break
+            except web.HTTPBadGateway:
+                # The endpoint went dark (replica died, tunnel dropped):
+                # count it against the replica's breaker and rebuild the route
+                # — the 502 hook invalidated it, so the re-resolve reads fresh
+                # replica state.
+                resilience.record_failure(target)
+                route_table.invalidate(*entry.key)
+                tried.append(picked)
+                if started or len(tried) >= 2:
+                    raise
+                # One retry against a DIFFERENT ready replica from the
+                # refreshed table; nothing was written downstream yet, so the
+                # request is safely replayable.
+                entry = await resolve_route(db, entry.key[0], entry.key[1])
+                if entry.endpoints is None:
+                    await _populate_endpoints(db, entry)
     finally:
         stats.record_inflight(entry.run_id, -1)
     if isinstance(resp, web.Response):

@@ -1,0 +1,249 @@
+"""Unified retry / timeout / circuit-breaker layer for external calls.
+
+The control plane talks to three families of things it does not control —
+runner agents, cloud backend APIs, and service replicas behind the proxy.
+Before this module each call site handled failure ad-hoc (a bare try/except
+here, an unbounded await there). Now one combinator owns the policy:
+
+- ``with_retry(fn, ...)``: explicit per-attempt timeout and total deadline,
+  jittered exponential backoff between attempts, and typed outcome routing
+  (``retry_on`` / ``no_retry`` / ``treat_as_success`` — e.g. NoCapacityError
+  is a *successful* conversation with a healthy backend, not a fault).
+- Per-target circuit breakers: a target opens after
+  ``settings.BREAKER_THRESHOLD`` consecutive failures, rejects calls for
+  ``settings.BREAKER_COOLDOWN`` seconds, then half-opens exactly one probe;
+  the probe's outcome closes or re-opens it. Targets are strings like
+  ``runner:http://10.0.0.7:10999`` or ``backend:gcp`` — state is process-local
+  (each replica learns about a dead dependency from its own traffic, which is
+  the traffic the breaker protects).
+
+Breaker state is exported on ``/metrics`` as
+``dstack_tpu_circuit_breaker_state{target=...}`` (0 closed, 1 half-open,
+2 open) so an open breaker is visible before anyone reads logs. Scheduler
+passes consult ``is_open()`` to degrade gracefully — skip-and-requeue with a
+reason'd run_event instead of burning a pass (and an offer's deadline) on a
+dead backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple, Type
+
+from dstack_tpu.server import settings
+
+logger = logging.getLogger(__name__)
+
+_STATE_VALUES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+class BreakerOpenError(Exception):
+    """The target's circuit is open; the call was rejected without being made."""
+
+    def __init__(self, target: str, retry_in: float = 0.0):
+        super().__init__(
+            f"circuit breaker open for {target}"
+            + (f" (probe in {retry_in:.1f}s)" if retry_in > 0 else "")
+        )
+        self.target = target
+        self.retry_in = retry_in
+
+
+class DeadlineExceededError(Exception):
+    """with_retry ran out of total wall budget before an attempt succeeded."""
+
+
+class _Breaker:
+    __slots__ = ("target", "state", "failures", "opened_at", "probing", "probe_started_at")
+
+    def __init__(self, target: str):
+        self.target = target
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+        self.probing = False
+        self.probe_started_at = 0.0
+
+
+_breakers: Dict[str, _Breaker] = {}
+
+
+def _set_state(b: _Breaker, state: str) -> None:
+    b.state = state
+
+
+def check(target: str) -> None:
+    """Admission check; raises BreakerOpenError when the target is open. On a
+    cooled-down open breaker, the FIRST caller through becomes the half-open
+    probe (concurrent callers stay rejected until its outcome lands)."""
+    b = _breakers.get(target)
+    if b is None or b.state == "closed":
+        return
+    now = time.monotonic()
+    if b.state == "open":
+        elapsed = now - b.opened_at
+        if elapsed < settings.BREAKER_COOLDOWN:
+            raise BreakerOpenError(target, settings.BREAKER_COOLDOWN - elapsed)
+        _set_state(b, "half_open")
+        b.probing = False
+    if b.state == "half_open":
+        # A probe whose caller never reported back (cancelled task, crashed
+        # pass) must not wedge the breaker: past one cooldown it is presumed
+        # dead and the next caller becomes the probe.
+        if b.probing and now - b.probe_started_at < settings.BREAKER_COOLDOWN:
+            raise BreakerOpenError(target)
+        b.probing = True
+        b.probe_started_at = now
+
+
+def abort_probe(target: str) -> None:
+    """The in-flight half-open probe was cancelled before producing an
+    outcome: hand the probe slot to the next caller instead of holding it."""
+    b = _breakers.get(target)
+    if b is not None and b.state == "half_open":
+        b.probing = False
+
+
+def record_success(target: str) -> None:
+    b = _breakers.get(target)
+    if b is None:
+        return
+    b.failures = 0
+    b.probing = False
+    if b.state != "closed":
+        logger.info("circuit breaker %s closed (probe succeeded)", target)
+        _set_state(b, "closed")
+
+
+def record_failure(target: str) -> None:
+    b = _breakers.get(target)
+    if b is None:
+        b = _breakers[target] = _Breaker(target)
+    b.failures += 1
+    if b.state == "half_open" or b.failures >= settings.BREAKER_THRESHOLD:
+        b.opened_at = time.monotonic()
+        b.probing = False
+        if b.state != "open":
+            logger.warning(
+                "circuit breaker %s opened after %d consecutive failure(s)",
+                target, b.failures,
+            )
+        _set_state(b, "open")
+
+
+def is_open(target: str) -> bool:
+    """True while the target rejects calls outright (cooldown not yet elapsed).
+    A cooled-down breaker reads False so decision points (offer filtering,
+    endpoint choice) route one probe call back at the target."""
+    b = _breakers.get(target)
+    return (
+        b is not None
+        and b.state == "open"
+        and time.monotonic() - b.opened_at < settings.BREAKER_COOLDOWN
+    )
+
+
+def state(target: str) -> str:
+    b = _breakers.get(target)
+    return b.state if b is not None else "closed"
+
+
+def snapshot() -> List[Tuple[str, float]]:
+    """(target, numeric state) for /metrics."""
+    return sorted((t, _STATE_VALUES[b.state]) for t, b in _breakers.items())
+
+
+def reset() -> None:
+    """Forget all breaker state (tests / bench rounds)."""
+    _breakers.clear()
+
+
+def backoff_delay(
+    attempt: int, base: float, cap: float, rng: Optional[random.Random] = None
+) -> float:
+    """Jittered exponential backoff: min(base * 2^attempt, cap) scaled into
+    [0.5, 1.0) so N callers failing together never retry in lockstep."""
+    return min(base * (2 ** attempt), cap) * (0.5 + 0.5 * (rng or random).random())
+
+
+async def with_retry(
+    fn: Callable[[], Awaitable],
+    *,
+    target: Optional[str] = None,
+    op: str = "",
+    attempts: int = 3,
+    timeout: Optional[float] = None,
+    deadline: Optional[float] = None,
+    base_delay: float = 0.2,
+    max_delay: float = 5.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    no_retry: Tuple[Type[BaseException], ...] = (),
+    treat_as_success: Tuple[Type[BaseException], ...] = (),
+    rng: Optional[random.Random] = None,
+):
+    """Run ``fn()`` (a zero-arg coroutine factory) under the resilience policy.
+
+    ``timeout`` bounds each attempt; ``deadline`` bounds the whole call
+    including backoff sleeps. With ``target`` set, every attempt passes the
+    breaker admission check and reports its outcome. Exception routing, in
+    priority order: ``treat_as_success`` closes the breaker and re-raises
+    (a definitive answer, not a fault); ``no_retry`` counts a failure and
+    re-raises; ``retry_on`` counts a failure and retries while budget remains.
+    CancelledError always propagates untouched.
+    """
+    start = time.monotonic()
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, attempts)):
+        if target is not None:
+            check(target)  # BreakerOpenError propagates to the caller
+        budget = timeout
+        if deadline is not None:
+            remaining = deadline - (time.monotonic() - start)
+            if remaining <= 0:
+                break
+            budget = min(budget, remaining) if budget is not None else remaining
+        try:
+            coro = fn()
+            result = await (
+                asyncio.wait_for(coro, budget) if budget is not None else coro
+            )
+        except asyncio.CancelledError:
+            # Cancellation is not a target outcome — release the half-open
+            # probe slot (if this attempt held it) instead of wedging it.
+            if target is not None:
+                abort_probe(target)
+            raise
+        except BaseException as e:
+            if isinstance(e, treat_as_success):
+                if target is not None:
+                    record_success(target)
+                raise
+            if isinstance(e, no_retry) or not isinstance(e, retry_on):
+                if target is not None:
+                    record_failure(target)
+                raise
+            if target is not None:
+                record_failure(target)
+            last = e
+            if attempt + 1 < max(1, attempts):
+                delay = backoff_delay(attempt, base_delay, max_delay, rng)
+                if deadline is not None:
+                    delay = min(delay, max(0.0, deadline - (time.monotonic() - start)))
+                logger.debug(
+                    "%s%s attempt %d/%d failed (%s); retrying in %.2fs",
+                    target or "", f" {op}" if op else "", attempt + 1, attempts, e, delay,
+                )
+                await asyncio.sleep(delay)
+            continue
+        else:
+            if target is not None:
+                record_success(target)
+            return result
+    if last is not None:
+        raise last
+    raise DeadlineExceededError(
+        f"{target or op or 'call'}: deadline of {deadline}s exhausted before any attempt"
+    )

@@ -7,6 +7,13 @@ increasing offset, so the server needs no websocket.
 
 For cloud instances the client talks through an SSH tunnel (services/runner/ssh.py);
 for the local backend it connects directly to 127.0.0.1:<runner_port>.
+
+Every request rides the unified resilience layer (services/resilience): an
+explicit per-request timeout (DSTACK_TPU_RUNNER_REQUEST_TIMEOUT), transport
+retries with jittered backoff (DSTACK_TPU_RUNNER_CALL_ATTEMPTS), and a per-
+agent circuit breaker keyed ``runner:<endpoint>``. Healthchecks bypass both
+retry and breaker accounting — an unreachable agent is the NORMAL state while
+a slice provisions, and must not open the breaker the first submit needs.
 """
 
 from __future__ import annotations
@@ -17,14 +24,27 @@ from typing import Any, Dict, List, Optional
 
 import aiohttp
 
+from dstack_tpu.core import faults
 from dstack_tpu.core.errors import SSHError
 from dstack_tpu.core.models.runs import ClusterInfo, JobRuntimeData, JobSpec
 
-REQUEST_TIMEOUT = aiohttp.ClientTimeout(total=10)
-
 
 class RunnerError(Exception):
-    pass
+    """Base for runner conversations that did not produce a result."""
+
+    def __init__(self, msg: str = "", status: Optional[int] = None):
+        super().__init__(msg)
+        self.status = status
+
+
+class RunnerRequestError(RunnerError):
+    """The agent answered with a 4xx: the request was wrong, the agent is fine
+    (never retried; counts as breaker SUCCESS — the target is reachable)."""
+
+
+class RunnerUnavailableError(RunnerError):
+    """Transport failure, timeout, or agent 5xx: the target may be down
+    (retried; counts as a breaker failure)."""
 
 
 class RunnerClient:
@@ -49,7 +69,7 @@ class RunnerClient:
             self.base = f"http://{host}:{port}"
         return self.base
 
-    async def _request(
+    async def _request_once(
         self,
         method: str,
         path: str,
@@ -57,9 +77,12 @@ class RunnerClient:
         data: Optional[bytes] = None,
         params: Optional[dict] = None,
     ) -> Any:
+        from dstack_tpu.server import settings
+
         try:
-            base = await self._ensure_base()
-            async with aiohttp.ClientSession(timeout=REQUEST_TIMEOUT) as session:
+            await faults.check("runner.request", detail=f"{self.base}{path}")
+            timeout = aiohttp.ClientTimeout(total=settings.RUNNER_REQUEST_TIMEOUT)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
                 kwargs: dict = {}
                 if payload is not None:
                     kwargs["json"] = payload
@@ -67,19 +90,68 @@ class RunnerClient:
                     kwargs["data"] = data
                 if params is not None:
                     kwargs["params"] = params
-                async with session.request(method, base + path, **kwargs) as resp:
+                async with session.request(method, self.base + path, **kwargs) as resp:
                     body = await resp.read()
+                    if resp.status >= 500:
+                        raise RunnerUnavailableError(
+                            f"{path} -> {resp.status}: {body[:200]!r}", status=resp.status
+                        )
                     if resp.status >= 400:
-                        raise RunnerError(f"{path} -> {resp.status}: {body[:200]!r}")
+                        raise RunnerRequestError(
+                            f"{path} -> {resp.status}: {body[:200]!r}", status=resp.status
+                        )
                     if not body:
                         return None
                     return json.loads(body)
+        except (
+            aiohttp.ClientError,
+            asyncio.TimeoutError,
+            OSError,
+            faults.FaultInjected,
+        ) as e:
+            raise RunnerUnavailableError(f"{path}: {e}") from e
+
+    async def _request(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[dict] = None,
+        data: Optional[bytes] = None,
+        params: Optional[dict] = None,
+        retry: bool = True,
+        breaker: bool = True,
+    ) -> Any:
+        from dstack_tpu.server import settings
+        from dstack_tpu.server.services import resilience
+
+        try:
+            base = await self._ensure_base()
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError, SSHError) as e:
-            raise RunnerError(f"{path}: {e}") from e
+            # Endpoint resolution (SSH tunnel setup, local port allocation)
+            # failing is the same story as the request failing: the agent is
+            # unreachable — keep the RunnerError contract callers rely on.
+            raise RunnerUnavailableError(f"{path}: {e}") from e
+        try:
+            return await resilience.with_retry(
+                lambda: self._request_once(method, path, payload, data, params),
+                target=f"runner:{base}" if breaker else None,
+                op=path,
+                attempts=settings.RUNNER_CALL_ATTEMPTS if retry else 1,
+                base_delay=0.2,
+                max_delay=2.0,
+                retry_on=(RunnerUnavailableError,),
+                treat_as_success=(RunnerRequestError,),
+            )
+        except resilience.BreakerOpenError as e:
+            raise RunnerUnavailableError(f"{path}: {e}") from e
 
     async def healthcheck(self) -> Optional[dict]:
+        # Single attempt, no breaker: failing healthchecks are the expected
+        # state of a provisioning slice, not a fault signal.
         try:
-            return await self._request("GET", "/api/healthcheck")
+            return await self._request(
+                "GET", "/api/healthcheck", retry=False, breaker=False
+            )
         except RunnerError:
             return None
 
@@ -113,7 +185,9 @@ class RunnerClient:
         return await self._request("GET", "/api/pull", params={"offset": str(offset)})
 
     async def stop(self, abort: bool = False) -> None:
-        await self._request("POST", "/api/stop", payload={"abort": abort})
+        # Best-effort teardown: one attempt (callers already tolerate failure;
+        # retrying a stop only delays releasing the slice).
+        await self._request("POST", "/api/stop", payload={"abort": abort}, retry=False)
 
     async def metrics(self) -> Optional[dict]:
         try:

@@ -85,12 +85,16 @@ async def rows_to_runs(db: Database, run_rows: List) -> List[Run]:
     jobs_by_run: dict = {}
     for jr in job_rows:
         jobs_by_run.setdefault(jr["run_id"], []).append(jr)
+    from dstack_tpu.server.services import leases as leases_service
+
+    owners = await leases_service.owners(db, run_ids)
     return [
         _build_run(
             r,
             username=users.get(r["user_id"], "?"),
             project_name=projects.get(r["project_id"], "?"),
             job_rows=jobs_by_run.get(r["id"], []),
+            owner=owners.get(r["id"]),
         )
         for r in run_rows
     ]
@@ -100,7 +104,10 @@ async def run_model_to_run(db: Database, run_row) -> Run:
     return (await rows_to_runs(db, [run_row]))[0]
 
 
-def _build_run(run_row, username: str, project_name: str, job_rows: List) -> Run:
+def _build_run(
+    run_row, username: str, project_name: str, job_rows: List,
+    owner: Optional[str] = None,
+) -> Run:
     by_key: dict = {}
     for jr in job_rows:
         key = (jr["replica_num"], jr["job_num"])
@@ -133,6 +140,7 @@ def _build_run(run_row, username: str, project_name: str, job_rows: List) -> Run
         jobs=jobs,
         cost=cost,
         service=ServiceSpec.model_validate(service_spec) if service_spec else None,
+        owner=owner,
     )
     run.error = _run_error(run)
     return run
@@ -487,6 +495,11 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
         from dstack_tpu.server.services import proxy as proxy_service
 
         proxy_service.forget_run(row["id"], row["run_name"])
+        # And the run's scheduler lease (finished runs normally release at
+        # finalize; this catches leases orphaned by a crash).
+        from dstack_tpu.server.services import leases as leases_service
+
+        await leases_service.release_runs(db, [row["id"]])
 
 
 def _validate_run_name(name: str) -> None:

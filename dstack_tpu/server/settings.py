@@ -82,6 +82,31 @@ RETRY_BACKOFF_BASE = float(os.getenv("DSTACK_TPU_RETRY_BACKOFF_BASE", "15"))
 RETRY_BACKOFF_MAX = float(os.getenv("DSTACK_TPU_RETRY_BACKOFF_MAX", "600"))
 TERMINATION_RETRY_WINDOW = float(os.getenv("DSTACK_TPU_TERMINATION_RETRY_WINDOW", "600"))
 
+# Run-ownership leases (services/leases.py): each run-keyed scheduler pass
+# claims only runs this replica owns; a lease not renewed within LEASE_TTL is
+# reclaimable by any live replica, which reconciles the orphaned run. The TTL
+# must comfortably exceed the slowest pass interval (passes renew by claiming).
+# DSTACK_TPU_REPLICA_ID pins the replica identity (defaults to host-pid-rand,
+# so a restart is a NEW replica and its stale leases age out via the TTL).
+RUN_LEASES_ENABLED = _env_bool("DSTACK_TPU_RUN_LEASES", True)
+LEASE_TTL = float(os.getenv("DSTACK_TPU_LEASE_TTL", "30"))
+REPLICA_ID = os.getenv("DSTACK_TPU_REPLICA_ID")
+
+# Resilience layer (services/resilience.py): per-target circuit breakers over
+# the external call families (runner agents, backend Compute, proxy->replica
+# forwards). A target opens after BREAKER_THRESHOLD consecutive failures and
+# half-opens one probe call after BREAKER_COOLDOWN seconds.
+BREAKER_THRESHOLD = int(os.getenv("DSTACK_TPU_BREAKER_THRESHOLD", "5"))
+BREAKER_COOLDOWN = float(os.getenv("DSTACK_TPU_BREAKER_COOLDOWN", "30"))
+# Runner agent calls: per-request timeout and transport-level retry attempts
+# (jittered backoff between attempts; healthcheck and stop never retry).
+RUNNER_REQUEST_TIMEOUT = float(os.getenv("DSTACK_TPU_RUNNER_REQUEST_TIMEOUT", "10"))
+RUNNER_CALL_ATTEMPTS = int(os.getenv("DSTACK_TPU_RUNNER_CALL_ATTEMPTS", "2"))
+# Backend Compute calls: explicit deadline on create_slice (cloud queued
+# resources legitimately take a while) and update_provisioning_data polls.
+BACKEND_CALL_TIMEOUT = float(os.getenv("DSTACK_TPU_BACKEND_CALL_TIMEOUT", "300"))
+BACKEND_POLL_TIMEOUT = float(os.getenv("DSTACK_TPU_BACKEND_POLL_TIMEOUT", "30"))
+
 LOCAL_BACKEND_ENABLED = _env_bool("DSTACK_TPU_LOCAL_BACKEND_ENABLED", True)
 # Container mode the local backend passes to its runner agents (--docker):
 # never = host exec (default, no engine dependency), auto/always = container path.

@@ -1,0 +1,711 @@
+"""Control-plane fault tolerance: lease-owned runs, the unified resilience
+layer (retry/timeout/circuit breaker), fault injection, runner-client failure
+paths, proxy replica failover, and the no-timeoutless-aiohttp-calls lint.
+
+Strategy matches the scheduler tests: real FSM loops + real DB + mock Compute,
+with the runner faked where the FSM is under test and REAL where the client's
+own failure handling is under test (misbehaving raw asyncio servers)."""
+
+import ast
+import asyncio
+import json
+import pathlib
+import time
+
+import pytest
+
+from dstack_tpu.core import faults
+from dstack_tpu.core.errors import NoCapacityError
+from dstack_tpu.server import settings
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import leases
+from dstack_tpu.server.services import resilience
+from dstack_tpu.server.services.runner import client as runner_client_module
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+    tpu_task_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    resilience.reset()
+    faults.clear()
+    monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+    yield
+    resilience.reset()
+    faults.clear()
+    FakeRunnerClient.reset()
+
+
+async def _run_id(db, run_name: str) -> str:
+    row = await db.fetchone("SELECT id FROM runs WHERE run_name = ?", (run_name,))
+    return row["id"]
+
+
+async def _lease_row(db, run_id: str):
+    return await db.fetchone("SELECT * FROM run_leases WHERE run_id = ?", (run_id,))
+
+
+async def _events(db, run_id: str):
+    return await db.fetchall(
+        "SELECT * FROM run_events WHERE run_id = ? ORDER BY seq", (run_id,)
+    )
+
+
+class TestLeases:
+    async def test_claim_renew_contention_reclaim(self):
+        async with api_server() as api:
+            with leases.as_replica("rep-a"):
+                owned, reclaimed = await leases.claim_runs(api.db, ["r1", "r2"])
+            assert owned == {"r1", "r2"} and reclaimed == set()
+            # Another replica cannot take a live lease...
+            with leases.as_replica("rep-b"):
+                owned, reclaimed = await leases.claim_runs(api.db, ["r1"])
+            assert owned == set() and reclaimed == set()
+            # ...the holder renews (expiry advances)...
+            before = (await _lease_row(api.db, "r1"))["expires_at"]
+            await asyncio.sleep(0.01)
+            with leases.as_replica("rep-a"):
+                owned, _ = await leases.claim_runs(api.db, ["r1"])
+            assert owned == {"r1"}
+            assert (await _lease_row(api.db, "r1"))["expires_at"] >= before
+            # ...and an EXPIRED lease is reclaimed by whoever claims next.
+            await api.db.execute(
+                "UPDATE run_leases SET expires_at = '2000-01-01T00:00:00+00:00'"
+                " WHERE run_id = 'r1'"
+            )
+            with leases.as_replica("rep-b"):
+                owned, reclaimed = await leases.claim_runs(api.db, ["r1"])
+            assert owned == {"r1"} and reclaimed == {"r1"}
+            row = await _lease_row(api.db, "r1")
+            assert row["owner"] == "rep-b" and row["reclaims"] == 1
+
+    async def test_passes_process_only_owned_runs(self):
+        """A run leased to another live replica is untouched by this replica's
+        passes; once the lease expires the run is reclaimed, reconciled (with
+        a run_event) and driven to completion."""
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("mine", "v5e-8"))
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("theirs", "v5e-8"))
+            theirs = await _run_id(api.db, "theirs")
+            with leases.as_replica("other-replica"):
+                await leases.claim_runs(api.db, [theirs])
+
+            await drive(api.db)
+            runs = {
+                r["run_name"]: r["status"]
+                for r in await api.db.fetchall("SELECT run_name, status FROM runs")
+            }
+            assert runs["mine"] == "done"
+            assert runs["theirs"] == "submitted"  # not ours to schedule
+
+            # The other replica "dies": its lease expires, we reclaim + finish.
+            await api.db.execute(
+                "UPDATE run_leases SET expires_at = '2000-01-01T00:00:00+00:00'"
+                " WHERE run_id = ?",
+                (theirs,),
+            )
+            await drive(api.db)
+            row = await api.db.fetchone(
+                "SELECT status FROM runs WHERE id = ?", (theirs,)
+            )
+            assert row["status"] == "done"
+            recon = [
+                e for e in await _events(api.db, theirs)
+                if e["new_status"] == "reconciled"
+            ]
+            assert recon and recon[0]["reason"] == "lease_reclaimed"
+            # Terminal runs hold no lease (released at finalize).
+            assert await _lease_row(api.db, theirs) is None
+
+    async def test_startup_reconcile_adopts_orphan_and_probes(self, monkeypatch):
+        """A run left mid-flight by a dead replica is adopted at startup: the
+        lease moves, the runner is re-probed, and the timeline records it."""
+        monkeypatch.setattr(
+            FakeRunnerClient,
+            "default_script",
+            lambda self: [{"job_states": [{"state": "running"}], "logs": [], "offset": 1}],
+        )
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("orphan", "v5e-8"))
+            await drive(api.db, passes=4)
+            run_id = await _run_id(api.db, "orphan")
+            row = await api.db.fetchone("SELECT status FROM runs WHERE id = ?", (run_id,))
+            assert row["status"] == "running"
+            # Simulate the owning replica having died mid-run.
+            await api.db.execute(
+                "UPDATE run_leases SET owner = 'dead-replica',"
+                " expires_at = '2000-01-01T00:00:00+00:00' WHERE run_id = ?",
+                (run_id,),
+            )
+            adopted = await leases.startup_reconcile(api.db)
+            assert adopted == 1
+            assert (await _lease_row(api.db, run_id))["owner"] == leases.replica_id()
+            recon = [
+                e for e in await _events(api.db, run_id)
+                if e["new_status"] == "reconciled"
+            ]
+            assert recon and recon[-1]["reason"] == "startup"
+            assert "1 reachable" in recon[-1]["message"]
+
+            # The OWNER column surfaces through the runs API.
+            data = await api.post("/api/project/main/runs/list")
+            by_name = {r["run_spec"]["run_name"]: r for r in data}
+            assert by_name["orphan"]["owner"] == leases.replica_id()
+
+    async def test_sweep_drops_leases_of_finished_runs(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("fin", "v5e-8"))
+            run_id = await _run_id(api.db, "fin")
+            await drive(api.db)
+            # Simulate a crash between terminal transition and release.
+            await api.db.execute(
+                "INSERT INTO run_leases (run_id, owner, acquired_at, heartbeat_at,"
+                " expires_at) VALUES (?, 'ghost', '2026-01-01', '2026-01-01', '2099-01-01')",
+                (run_id,),
+            )
+            await leases.sweep(api.db)
+            assert await _lease_row(api.db, run_id) is None
+
+    async def test_disabled_leases_own_everything(self, monkeypatch):
+        monkeypatch.setattr(settings, "RUN_LEASES_ENABLED", False)
+        async with api_server() as api:
+            owned, reclaimed = await leases.claim_runs(api.db, ["a", "b"])
+            assert owned == {"a", "b"} and reclaimed == set()
+            assert await _lease_row(api.db, "a") is None
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_then_half_open_probe(self, monkeypatch):
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 3)
+        monkeypatch.setattr(settings, "BREAKER_COOLDOWN", 0.05)
+        t = "backend:test"
+        for _ in range(2):
+            resilience.record_failure(t)
+        assert resilience.state(t) == "closed" and not resilience.is_open(t)
+        resilience.record_failure(t)
+        assert resilience.state(t) == "open" and resilience.is_open(t)
+        with pytest.raises(resilience.BreakerOpenError):
+            resilience.check(t)
+        time.sleep(0.06)
+        assert not resilience.is_open(t)  # cooled down: probe may route here
+        resilience.check(t)  # first caller becomes the half-open probe
+        assert resilience.state(t) == "half_open"
+        with pytest.raises(resilience.BreakerOpenError):
+            resilience.check(t)  # concurrent callers rejected during the probe
+        resilience.record_success(t)
+        assert resilience.state(t) == "closed"
+        resilience.check(t)
+
+    def test_half_open_failure_reopens(self, monkeypatch):
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 1)
+        monkeypatch.setattr(settings, "BREAKER_COOLDOWN", 0.02)
+        t = "backend:flaky"
+        resilience.record_failure(t)
+        assert resilience.state(t) == "open"
+        time.sleep(0.03)
+        resilience.check(t)
+        resilience.record_failure(t)  # the probe failed
+        assert resilience.state(t) == "open" and resilience.is_open(t)
+
+    async def test_cancelled_probe_releases_the_half_open_slot(self, monkeypatch):
+        """A half-open probe whose task is cancelled must hand the slot back —
+        otherwise the breaker wedges open forever (no outcome ever recorded)."""
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 1)
+        monkeypatch.setattr(settings, "BREAKER_COOLDOWN", 0.02)
+        t = "backend:wedge"
+        resilience.record_failure(t)
+        await asyncio.sleep(0.03)
+        started = asyncio.Event()
+
+        async def hang():
+            started.set()
+            await asyncio.sleep(30)
+
+        task = asyncio.create_task(resilience.with_retry(hang, target=t, attempts=1))
+        await started.wait()
+        with pytest.raises(resilience.BreakerOpenError):
+            resilience.check(t)  # probe slot held by the hanging task
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        resilience.check(t)  # released: this caller becomes the probe
+
+    def test_stale_probe_presumed_dead_after_cooldown(self, monkeypatch):
+        """Belt-and-braces for probe holders that vanish without cancelling
+        through with_retry (crashed pass): the slot expires after a cooldown."""
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 1)
+        monkeypatch.setattr(settings, "BREAKER_COOLDOWN", 0.02)
+        t = "backend:stale"
+        resilience.record_failure(t)
+        time.sleep(0.03)
+        resilience.check(t)  # probe taken...
+        with pytest.raises(resilience.BreakerOpenError):
+            resilience.check(t)
+        time.sleep(0.03)  # ...never reports back; presumed dead
+        resilience.check(t)
+
+    async def test_with_retry_retries_then_succeeds(self):
+        calls = []
+
+        async def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("transient")
+            return 7
+
+        result = await resilience.with_retry(
+            flaky, attempts=3, base_delay=0.001, max_delay=0.002
+        )
+        assert result == 7 and len(calls) == 3
+
+    async def test_with_retry_per_attempt_timeout(self):
+        async def slow():
+            await asyncio.sleep(0.5)
+
+        t0 = time.monotonic()
+        with pytest.raises(asyncio.TimeoutError):
+            await resilience.with_retry(slow, attempts=1, timeout=0.05)
+        assert time.monotonic() - t0 < 0.4
+
+    async def test_with_retry_deadline_bounds_total(self):
+        async def always_fail():
+            raise ValueError("nope")
+
+        t0 = time.monotonic()
+        with pytest.raises(ValueError):
+            await resilience.with_retry(
+                always_fail, attempts=50, base_delay=0.05, max_delay=0.05, deadline=0.2
+            )
+        assert time.monotonic() - t0 < 1.0
+
+    async def test_treat_as_success_closes_breaker(self, monkeypatch):
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 2)
+        t = "backend:answers"
+        resilience.record_failure(t)
+
+        async def no_capacity():
+            raise NoCapacityError("sold out")
+
+        with pytest.raises(NoCapacityError):
+            await resilience.with_retry(
+                no_capacity, target=t, attempts=1, treat_as_success=(NoCapacityError,)
+            )
+        # The NoCapacity answer reset the consecutive-failure count: one more
+        # failure is again below the threshold.
+        resilience.record_failure(t)
+        assert resilience.state(t) == "closed"
+
+    async def test_breaker_state_rendered_on_metrics(self, monkeypatch):
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 1)
+        async with api_server() as api:
+            resilience.record_failure("backend:gcp")
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            assert 'dstack_tpu_circuit_breaker_state{target="backend:gcp"} 2' in text
+            assert "# TYPE dstack_tpu_run_leases gauge" in text
+
+
+class TestSchedulerDegradation:
+    async def test_open_backend_breaker_requeues_instead_of_failing(self, monkeypatch):
+        """With the mock backend's circuit open, placement defers (reason'd
+        run_event, jobs stay submitted); when it closes, the run completes."""
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 1)
+        monkeypatch.setattr(settings, "BREAKER_COOLDOWN", 60.0)
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            resilience.record_failure("backend:mock")
+            await api.post("/api/project/main/runs/submit", tpu_task_spec("deferred", "v5e-8"))
+            run_id = await _run_id(api.db, "deferred")
+            await drive(api.db, passes=3)
+            row = await api.db.fetchone("SELECT status FROM runs WHERE id = ?", (run_id,))
+            assert row["status"] == "submitted"
+            evs = await _events(api.db, run_id)
+            breaker_evs = [e for e in evs if e["reason"] == "backend_circuit_open"]
+            assert len(breaker_evs) == 1  # deduped: one event, not one per pass
+            # Backend recovers -> breaker closes -> the same queued gang places.
+            resilience.record_success("backend:mock")
+            await drive(api.db)
+            row = await api.db.fetchone("SELECT status FROM runs WHERE id = ?", (run_id,))
+            assert row["status"] == "done"
+
+    async def test_injected_backend_faults_open_breaker(self, monkeypatch):
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 2)
+        monkeypatch.setattr(settings, "BREAKER_COOLDOWN", 60.0)
+        faults.configure(
+            {"sites": {"backend.create_slice": {"fail": 1.0, "error": "injected 5xx"}}}
+        )
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "chaos", "v5e-8", retry={"on_events": ["no-capacity"], "duration": "1h"}
+                ),
+            )
+            await drive(api.db, passes=4)
+            assert resilience.state("backend:mock") == "open"
+            run_id = await _run_id(api.db, "chaos")
+            row = await api.db.fetchone("SELECT status FROM runs WHERE id = ?", (run_id,))
+            assert row["status"] == "submitted"  # degraded, not failed
+
+
+class TestFaults:
+    async def test_fail_and_budget_and_match(self):
+        faults.configure(
+            {"seed": 1, "sites": {"s": {"fail": 1.0, "times": 2, "match": "yes"}}}
+        )
+        await faults.check("s", detail="no-hit")  # match filter: no injection
+        with pytest.raises(faults.FaultInjected):
+            await faults.check("s", detail="yes-1")
+        with pytest.raises(faults.FaultInjected):
+            await faults.check("s", detail="yes-2")
+        await faults.check("s", detail="yes-3")  # budget exhausted
+        assert faults.stats() == {"s": 2}
+
+    async def test_env_config_and_clear(self, monkeypatch):
+        monkeypatch.setenv(
+            "DSTACK_TPU_FAULTS", json.dumps({"sites": {"e": {"fail": 1.0}}})
+        )
+        with pytest.raises(faults.FaultInjected):
+            await faults.check("e")
+        monkeypatch.delenv("DSTACK_TPU_FAULTS")
+        await faults.check("e")
+
+    async def test_delay_injection(self):
+        faults.configure({"sites": {"d": {"delay": 0.05}}})
+        t0 = time.monotonic()
+        await faults.check("d")
+        assert time.monotonic() - t0 >= 0.05
+
+    async def test_inactive_is_noop(self):
+        await faults.check("anything")
+        assert not faults.active()
+
+
+class TestJitteredGangRetry:
+    def test_jitter_bounds_and_determinism(self):
+        cap = tasks._retry_delay(2)
+        assert cap == min(settings.RETRY_BACKOFF_BASE * 4, settings.RETRY_BACKOFF_MAX)
+        d1 = tasks._retry_delay(2, jitter_key="run-a:0:2")
+        d2 = tasks._retry_delay(2, jitter_key="run-a:0:2")
+        d3 = tasks._retry_delay(2, jitter_key="run-b:0:2")
+        assert d1 == d2  # stable across passes: the backoff window can't flap
+        assert 0.5 * cap <= d1 <= cap
+        assert 0.5 * cap <= d3 <= cap
+        assert d1 != d3  # different runs desynchronize
+
+    def test_cap_still_respected(self):
+        d = tasks._retry_delay(50, jitter_key="x")
+        assert d <= settings.RETRY_BACKOFF_MAX
+
+
+async def _seed_running_job(db, run_name: str, port: int) -> dict:
+    """A running single-job run whose agent endpoint is 127.0.0.1:port —
+    pointed at a misbehaving raw socket server by the failure-path tests."""
+    proj = await db.fetchone("SELECT * FROM projects LIMIT 1")
+    run_spec = {
+        "run_name": run_name,
+        "configuration": {"type": "task", "commands": ["sleep 1"]},
+    }
+    await db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
+        " run_spec) VALUES (?, ?, ?, ?, '2026-01-01', 'running', ?)",
+        (f"run-{run_name}", proj["id"], proj["owner_id"], run_name, json.dumps(run_spec)),
+    )
+    job_spec = {
+        "job_name": f"{run_name}-0-0",
+        "image_name": "stub",
+        "requirements": {"resources": {}},
+    }
+    jpd = {
+        "backend": "local",
+        "instance_type": {
+            "name": "local", "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1},
+        },
+        "instance_id": f"i-{run_name}",
+        "hostname": "127.0.0.1",
+        "region": "local",
+    }
+    jrd = {"runner_port": port}
+    await db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, job_spec, status,"
+        " submitted_at, job_provisioning_data, job_runtime_data)"
+        " VALUES (?, ?, ?, ?, 0, ?, 'running', '2026-01-01', ?, ?)",
+        (f"job-{run_name}", proj["id"], f"run-{run_name}", run_name,
+         json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
+    )
+    return await db.fetchone("SELECT * FROM jobs WHERE id = ?", (f"job-{run_name}",))
+
+
+async def _drive_disconnect_to_termination(db, job_row):
+    """Two pull passes: the first records the disconnect, the second (grace
+    window forced to 0) terminates. Returns the fresh job row."""
+    await tasks._process_pulling_or_running(db, job_row)
+    mid = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (job_row["id"],))
+    assert mid["disconnected_at"] is not None, "first failure should start the grace window"
+    assert mid["status"] == "running"
+    await tasks._process_pulling_or_running(db, mid)
+    return await db.fetchone("SELECT * FROM jobs WHERE id = ?", (job_row["id"],))
+
+
+class TestRunnerFailurePaths:
+    """The REAL RunnerClient against misbehaving sockets: each failure mode
+    must land the job in the right FSM position with a run_event to show for
+    it (these paths were previously untested)."""
+
+    @pytest.fixture(autouse=True)
+    def _real_client(self, monkeypatch):
+        monkeypatch.setattr(
+            tasks, "get_runner_client", runner_client_module.get_runner_client
+        )
+        monkeypatch.setattr(settings, "RUNNER_DISCONNECT_TIMEOUT", 0.0)
+        monkeypatch.setattr(settings, "RUNNER_CALL_ATTEMPTS", 1)
+        monkeypatch.setattr(settings, "RUNNER_REQUEST_TIMEOUT", 0.5)
+
+    async def test_connect_failure_transitions_to_unreachable(self):
+        # Bind-and-release a port so nothing listens on it.
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        async with api_server() as api:
+            job = await _seed_running_job(api.db, "refused", port)
+            final = await _drive_disconnect_to_termination(api.db, job)
+            assert final["status"] == "terminating"
+            assert final["termination_reason"] == "instance_unreachable"
+            evs = await _events(api.db, final["run_id"])
+            assert any(
+                e["new_status"] == "terminating" and e["job_id"] == final["id"]
+                for e in evs
+            )
+
+    async def test_mid_body_disconnect_transitions_to_unreachable(self):
+        async def handler(reader, writer):
+            await reader.read(1024)
+            # Promise 4096 bytes, deliver 7, hang up: a mid-body disconnect.
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: 4096\r\n\r\npartial")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            async with api_server() as api:
+                job = await _seed_running_job(api.db, "midbody", port)
+                final = await _drive_disconnect_to_termination(api.db, job)
+                assert final["status"] == "terminating"
+                assert final["termination_reason"] == "instance_unreachable"
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    async def test_slow_response_hits_deadline_not_forever(self):
+        async def handler(reader, writer):
+            await reader.read(1024)
+            await asyncio.sleep(30)  # never answers within the deadline
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            async with api_server() as api:
+                job = await _seed_running_job(api.db, "slowpoke", port)
+                t0 = time.monotonic()
+                final = await _drive_disconnect_to_termination(api.db, job)
+                # The explicit request timeout bounded both passes.
+                assert time.monotonic() - t0 < 5.0
+                assert final["status"] == "terminating"
+                assert final["termination_reason"] == "instance_unreachable"
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    async def test_runner_5xx_counts_toward_breaker_but_4xx_does_not(self, monkeypatch):
+        monkeypatch.setattr(settings, "BREAKER_THRESHOLD", 2)
+
+        async def handler(reader, writer):
+            data = await reader.read(1024)
+            status = b"500 Oops" if b"pull" in data else b"404 Nope"
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\nContent-Length: 0\r\n\r\n"
+            )
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        target = f"runner:http://127.0.0.1:{port}"
+        client = runner_client_module.RunnerClient("127.0.0.1", port)
+        try:
+            with pytest.raises(runner_client_module.RunnerError):
+                await client.pull()  # 500
+            with pytest.raises(runner_client_module.RunnerError):
+                await client.pull()  # 500 -> threshold reached
+            assert resilience.state(target) == "open"
+            resilience.reset()
+            with pytest.raises(runner_client_module.RunnerRequestError):
+                await client.run_job()  # 404: agent alive, breaker untouched
+            assert resilience.state(target) == "closed"
+        finally:
+            server.close()
+            await server.wait_closed()
+
+
+class TestProxyFailover:
+    async def test_upstream_502_retries_other_replica(self):
+        """Replica 0 is dark; the proxy fails over to replica 1 within the
+        same request instead of surfacing the 502."""
+        from dstack_tpu.server.services import proxy as proxy_service
+
+        async def handler(reader, writer):
+            await reader.read(1024)
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Length: 4\r\n\r\npong"
+            )
+            await writer.drain()
+            writer.close()
+
+        live = await asyncio.start_server(handler, "127.0.0.1", 0)
+        live_port = live.sockets[0].getsockname()[1]
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        try:
+            async with api_server() as api:
+                proj = await api.db.fetchone("SELECT * FROM projects LIMIT 1")
+                run_spec = {
+                    "run_name": "ha-svc",
+                    "configuration": {
+                        "type": "service", "commands": ["serve"], "port": 8000,
+                        "auth": False,
+                    },
+                }
+                await api.db.execute(
+                    "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+                    " status, run_spec) VALUES (?, ?, ?, 'ha-svc', '2026-01-01',"
+                    " 'running', ?)",
+                    ("run-ha", proj["id"], proj["owner_id"], json.dumps(run_spec)),
+                )
+                for replica_num, port in ((0, dead_port), (1, live_port)):
+                    job_spec = {
+                        "job_name": f"ha-svc-{replica_num}-0",
+                        "image_name": "stub",
+                        "requirements": {"resources": {}},
+                        "service_port": 8000,
+                    }
+                    jpd = {
+                        "backend": "local",
+                        "instance_type": {
+                            "name": "local",
+                            "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1},
+                        },
+                        "instance_id": f"i-ha-{replica_num}",
+                        "hostname": "127.0.0.1",
+                        "region": "local",
+                    }
+                    jrd = {"ports_mapping": {"8000": port}, "probe_ready": True}
+                    await api.db.execute(
+                        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num,"
+                        " replica_num, job_spec, status, submitted_at,"
+                        " job_provisioning_data, job_runtime_data)"
+                        " VALUES (?, ?, 'run-ha', 'ha-svc', 0, ?, ?, 'running',"
+                        " '2026-01-01', ?, ?)",
+                        (f"job-ha-{replica_num}", proj["id"], replica_num,
+                         json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
+                    )
+                resp = await api.client.get("/proxy/services/main/ha-svc/ping")
+                body = await resp.text()
+                assert resp.status == 200 and body == "pong", body
+                # The dead endpoint took a breaker failure (one, so still
+                # closed at the default threshold — but recorded).
+                assert resilience._breakers[f"replica:127.0.0.1:{dead_port}"].failures == 1
+                # A second request also succeeds (rebuilt route, live replica).
+                resp = await api.client.get("/proxy/services/main/ha-svc/ping")
+                assert resp.status == 200
+        finally:
+            live.close()
+            await live.wait_closed()
+
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+_SCAN_DIRS = ("dstack_tpu/server", "dstack_tpu/core/services")
+_HTTP_VERBS = {"request", "get", "post", "put", "delete", "ws_connect"}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _has_timeout_kw(node: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+class TestExternalCallTimeoutLint:
+    def test_every_aiohttp_call_has_an_explicit_timeout(self):
+        """Static analysis over the server services AST: every
+        aiohttp.ClientSession must either be constructed with `timeout=` or
+        have ALL its verb calls (`session.request/get/post/...`) carry a
+        per-request `timeout=`. An unbounded external call is exactly the bug
+        class this PR exists to remove — the lint keeps it removed."""
+        violations = []
+        for scan in _SCAN_DIRS:
+            for path in sorted((REPO / scan).rglob("*.py")):
+                source = path.read_text()
+                if "aiohttp" not in source:
+                    continue
+                tree = ast.parse(source, filename=str(path))
+                naked_sessions = []
+                naked_verb_calls = []
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = _call_name(node)
+                    if name == "ClientSession" and not _has_timeout_kw(node):
+                        naked_sessions.append(node.lineno)
+                    if (
+                        name in _HTTP_VERBS
+                        and isinstance(node.func, ast.Attribute)
+                        and "session" in ast.unparse(node.func.value).lower()
+                        and not _has_timeout_kw(node)
+                    ):
+                        naked_verb_calls.append(node.lineno)
+                # A session without a default timeout is fine ONLY when every
+                # request it serves sets its own.
+                if naked_sessions and naked_verb_calls:
+                    rel = path.relative_to(REPO)
+                    violations.append(
+                        f"{rel}: ClientSession without timeout at line(s)"
+                        f" {naked_sessions} and timeout-less call(s) at line(s)"
+                        f" {naked_verb_calls}"
+                    )
+        assert not violations, "\n".join(violations)
+
+    def test_lint_is_not_vacuous(self):
+        """The lint must actually be scanning code that uses aiohttp."""
+        scanned = [
+            p
+            for scan in _SCAN_DIRS
+            for p in (REPO / scan).rglob("*.py")
+            if "aiohttp.ClientSession" in p.read_text()
+        ]
+        assert len(scanned) >= 3, scanned
