@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos smoke-gang release publish clean
 
 all: runner wheel
 
@@ -104,6 +104,14 @@ smoke-chaos:
 # any missing piece.
 smoke-preemption:
 	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_preemption()"
+
+# Gang-health smoke: a simulated 4-host gang through the real server — real
+# TelemetryEmitters (host 3 delayed 2.5x) tailed by scripted agents; asserts
+# the straggler run_event within 2 collection passes, the {host} gauge on a
+# live /metrics scrape, the per-host CLI table, and that the goodput ledger /
+# step histogram stay lead-lineage-only.
+smoke-gang:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_gang()"
 
 # Observability smoke: boots the server in-process, drives one run through the
 # full FSM, and asserts the events timeline + /metrics histograms are live.

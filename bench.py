@@ -1020,7 +1020,8 @@ async def _render_cli_metrics(api, run_name: str) -> str:
     url = str(api.client.make_url("")).rstrip("/")
     client = Client(url, api.token, project="main")
     args = argparse.Namespace(
-        run_name=run_name, replica=0, job=0, limit=20, watch=False, interval=5.0
+        run_name=run_name, replica=0, job=0, limit=20, watch=False, interval=5.0,
+        json=False,
     )
 
     def _run() -> str:
@@ -1035,6 +1036,207 @@ async def _render_cli_metrics(api, run_name: str) -> str:
             cli_main._client = old_client
 
     return await asyncio.get_event_loop().run_in_executor(None, _run)
+
+
+def smoke_gang() -> dict:
+    """`make smoke-gang`: gang-wide observability end to end. A simulated
+    4-host gang (one run, 4 jobs on the mock backend) runs through the REAL
+    server with REAL TelemetryEmitters — each job's sidecar written by the
+    production emitter, tailed by a scripted agent exactly like the C++ agent
+    tails it — and host 3's step cadence artificially delayed 2.5x. Asserts
+    the acceptance criterion: the straggler is detected and attributed to the
+    RIGHT host within 2 collection passes of the skew appearing (run_event +
+    `dstack_tpu_run_straggler{host}` on a LIVE /metrics scrape + per-host CLI
+    table), while the goodput ledger and step histogram stay lead-lineage-
+    only. Raises (non-zero exit) on any missing piece."""
+    import asyncio
+    import os
+    import tempfile
+
+    from dstack_tpu.core import tracing
+    from dstack_tpu.server.background import tasks
+    from dstack_tpu.server.services import gang_health
+    from dstack_tpu.server.services import metrics as metrics_service
+    from dstack_tpu.utils.common import now_utc, to_iso
+    from dstack_tpu.workloads.telemetry import TelemetryEmitter
+    from tests.common import FakeRunnerClient, api_server, drive, setup_mock_backend, tpu_task_spec
+    from tests.test_run_events import parse_exposition
+
+    tracing.reset()
+    gang_health.reset()
+    tmp = tempfile.mkdtemp(prefix="smoke-gang-")
+
+    class GangAgent(FakeRunnerClient):
+        """A scripted agent whose /api/metrics tails a real emitter's sidecar
+        (complete lines only, offset advancing — the executor.cpp contract)
+        and adds the agent-side kind="host" hardware point."""
+
+        sidecars: dict = {}  # job_num -> path
+
+        def __init__(self, key):
+            super().__init__(key)
+            self.offset = 0
+
+        def default_script(self):
+            # The gang stays running until the smoke is done observing it.
+            return [{"job_states": [{"state": "running"}], "logs": [], "offset": 1}]
+
+        async def metrics(self):
+            n = self.submitted.job_num if self.submitted else 0
+            path = type(self).sidecars.get(n)
+            points = []
+            if path and os.path.exists(path):
+                with open(path, "rb") as f:
+                    f.seek(self.offset)
+                    chunk = f.read()
+                last_nl = chunk.rfind(b"\n")
+                if last_nl >= 0:
+                    for line in chunk[: last_nl + 1].splitlines():
+                        try:
+                            points.append(json.loads(line))
+                        except ValueError:
+                            continue
+                    self.offset += last_nl + 1
+            points.append({
+                "ts": to_iso(now_utc()), "kind": "host", "host": f"host{n}",
+                "cpu_percent": 40.0 + n, "mem_used_bytes": (n + 1) * 2 ** 30,
+            })
+            return {
+                "timestamp": to_iso(now_utc()),
+                "cpu_usage_micro": 1000,
+                "memory_usage_bytes": 1 << 20,
+                "workload": points,
+            }
+
+    async def run() -> dict:
+        GangAgent.reset()
+        GangAgent.sidecars = {}
+        real_tasks_client = tasks.get_runner_client
+        real_metrics_client = metrics_service.get_runner_client
+        tasks.get_runner_client = GangAgent.for_jpd
+        metrics_service.get_runner_client = GangAgent.for_jpd
+        emitters = []
+        try:
+            async with api_server() as api:
+                await setup_mock_backend(api)
+                await api.post(
+                    "/api/project/main/runs/submit",
+                    tpu_task_spec("smoke-gang", "v5e-32"),  # 4 hosts
+                )
+                await drive(api.db)
+                run = await api.post(
+                    "/api/project/main/runs/get", {"run_name": "smoke-gang"}
+                )
+                assert run["status"] == "running", f"gang not running: {run['status']}"
+                jobs = await api.db.fetchall(
+                    "SELECT job_num FROM jobs WHERE status = 'running'"
+                )
+                assert len(jobs) == 4, f"expected a 4-host gang, got {len(jobs)}"
+
+                # One REAL emitter per host; host 3's cadence delayed 2.5x.
+                for n in range(4):
+                    path = os.path.join(tmp, f"job{n}.jsonl")
+                    GangAgent.sidecars[n] = path
+                    em = TelemetryEmitter(path, flush_interval=60)  # manual flush
+                    em.set_identity(host=f"host{n}", proc=n)
+                    emitters.append(em)
+
+                step = {"n": 0}
+
+                def emit_window(steps=5, slow_factor=2.5):
+                    for _ in range(steps):
+                        step["n"] += 1
+                        for n, em in enumerate(emitters):
+                            dt = 0.05 * (slow_factor if n == 3 else 1.0)
+                            em.step(step["n"], round(dt, 6),
+                                    tokens_per_sec=1000.0, mfu=0.3,
+                                    input_wait_s=0.001,
+                                    collective_wait_s=0.001 if n == 3 else dt - 0.05 + 0.002)
+                    for em in emitters:
+                        em.flush()
+
+                async def straggler_events():
+                    return await api.db.fetchall(
+                        "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+                    )
+
+                # Pass 1: skew appears; the rule needs 2 consecutive windows.
+                emit_window()
+                await tasks.process_metrics(api.db)
+                assert not await straggler_events(), "flagged after ONE window (no hysteresis?)"
+                # Pass 2: detection — within 2 collection passes of the skew.
+                emit_window()
+                await tasks.process_metrics(api.db)
+                events = await straggler_events()
+                assert len(events) == 1, f"no straggler event after 2 passes: {events}"
+                assert events[0]["reason"] == "host3", (
+                    f"straggler attributed to {events[0]['reason']}, expected host3"
+                )
+
+                # The {host} gauge on a LIVE scrape (run still running).
+                resp = await api.client.get("/metrics")
+                families = parse_exposition(await resp.text())
+                straggler = {
+                    l["host"]: v
+                    for _, l, v in families["dstack_tpu_run_straggler"]["samples"]
+                    if l.get("run") == "smoke-gang"
+                }
+                assert straggler.get("host3") == 1.0, straggler
+                assert all(v == 0.0 for h, v in straggler.items() if h != "host3"), straggler
+                skew = next(
+                    v for _, l, v in
+                    families["dstack_tpu_run_step_skew_ratio"]["samples"]
+                    if l.get("run") == "smoke-gang"
+                )
+                assert skew > 2.0, f"skew gauge {skew} (expected ~2.5)"
+                host_cpu = {
+                    l["host"]: v
+                    for _, l, v in families["dstack_tpu_host_cpu_percent"]["samples"]
+                    if l.get("run") == "smoke-gang"
+                }
+                assert host_cpu.get("host3") == 43.0, host_cpu
+
+                # Lead-lineage-only invariants survive the per-host join: the
+                # step histogram counts ONE host's stream, not 4x.
+                hist = families["dstack_tpu_run_step_seconds"]["samples"]
+                counts = [v for nm, l, v in hist
+                          if nm.endswith("_count") and l.get("run") == "smoke-gang"]
+                assert counts == [float(step["n"])], (
+                    f"step histogram {counts} != lead stream {step['n']} (gang multiplied?)"
+                )
+
+                # The per-host CLI table names and flags the host.
+                cli_out = await _render_cli_metrics(api, "smoke-gang")
+                for needle in ("HOST", "host3", "STRAGGLER", "step skew:", "COLL WAIT"):
+                    assert needle in cli_out, f"CLI missing {needle!r}:\n{cli_out}"
+
+                # The timeline surfaces it too (dstack-tpu events).
+                data = await api.post(
+                    "/api/project/main/runs/get_events", {"run_name": "smoke-gang"}
+                )
+                straggler_ev = [
+                    e for e in data["events"] if e["new_status"] == "straggler_detected"
+                ]
+                assert straggler_ev and straggler_ev[0]["reason"] == "host3"
+
+                return {
+                    "metric": "smoke_gang",
+                    "value": 2,
+                    "unit": "passes_to_detect",
+                    "skew_ratio": round(skew, 3),
+                    "straggler": events[0]["reason"],
+                    "gang_hosts": len(jobs),
+                    "lead_steps": step["n"],
+                }
+        finally:
+            for em in emitters:
+                em.close(timeout=0.2)
+            tasks.get_runner_client = real_tasks_client
+            metrics_service.get_runner_client = real_metrics_client
+
+    result = asyncio.run(run())
+    print(json.dumps(result))
+    return result
 
 
 def smoke_preemption() -> dict:

@@ -430,6 +430,37 @@ def _workload_rows(points: list) -> list:
     return rows
 
 
+def _host_rows(hosts: list) -> list:
+    """Per-host gang table rows (gang-health view: one row per host of the
+    run, straggler flag last)."""
+    rows = []
+    for h in hosts:
+        cpu = h.get("cpu_percent")
+        mem = h.get("mem_bytes")
+        rows.append(
+            [
+                h.get("host", "-"),
+                str(h["last_step"]) if h.get("last_step") is not None else "-",
+                _fmt_secs(h.get("median_step_s")),
+                _fmt_secs(h.get("collective_wait_s")) if h.get("collective_wait_s") else "-",
+                _fmt_secs(h.get("input_wait_s")) if h.get("input_wait_s") else "-",
+                f"{cpu:.0f}%" if cpu is not None else "-",
+                f"{mem / (1024 ** 3):.1f}GB" if mem is not None else "-",
+                "STRAGGLER" if h.get("straggler") else "",
+            ]
+        )
+    return rows
+
+
+def _fmt_skew(skew) -> str:
+    if not skew or skew.get("ratio") is None:
+        return "-"
+    return (
+        f"{skew['ratio']:.2f}x (slowest {skew.get('slowest_host', '-')},"
+        f" gang median {_fmt_secs(skew.get('gang_median_s'))})"
+    )
+
+
 def cmd_metrics(args) -> None:
     client = _client()
     def render() -> None:
@@ -440,6 +471,25 @@ def cmd_metrics(args) -> None:
             wl = client.runs.get_metrics(args.run_name, limit=args.limit)
         except Exception:
             wl = None  # an old server without the workload channel
+        if args.json:
+            # Machine-readable: the workload-metrics payload (hosts/skew/
+            # goodput included) plus the sampled resource points — what
+            # `dstack-tpu top` and scripts build on.
+            import json as json_lib
+
+            payload = dict(wl or {})
+            payload["job_metrics"] = [
+                {
+                    "timestamp": p.timestamp.isoformat(),
+                    "cpu_usage_percent": p.cpu_usage_percent,
+                    "memory_usage_bytes": p.memory_usage_bytes,
+                    "tpu_duty_cycle_percent": p.tpu_duty_cycle_percent,
+                    "tpu_hbm_usage_bytes": p.tpu_hbm_usage_bytes,
+                }
+                for p in m.points
+            ]
+            print(json_lib.dumps(payload), flush=True)
+            return
         if not m.points and not (wl and (wl.get("points") or wl.get("engine"))):
             if not args.watch:
                 print("no metrics collected yet (the job may have just started)")
@@ -491,6 +541,22 @@ def cmd_metrics(args) -> None:
                 ),
                 flush=True,
             )
+        # Per-host gang view (ISSUE 15): every host of the run with its
+        # window-median step time, collective/input wait, hardware sample,
+        # and the straggler flag; skew line when the gang has >= 2 hosts.
+        hosts = wl.get("hosts") or []
+        if len(hosts) > 1 or any(h.get("straggler") for h in hosts):
+            print()
+            print(
+                _table(
+                    ["HOST", "LAST STEP", "STEP TIME", "COLL WAIT", "INPUT WAIT",
+                     "CPU", "MEM", "FLAG"],
+                    _host_rows(hosts),
+                ),
+                flush=True,
+            )
+            if wl.get("skew"):
+                print(f"\nstep skew: {_fmt_skew(wl['skew'])}", flush=True)
         if points or engine:
             print(f"\ngoodput: {_fmt_goodput(wl.get('goodput'))}", flush=True)
             if wl.get("dropped"):
@@ -567,6 +633,11 @@ def cmd_events(args) -> None:
     """Print a run's lifecycle timeline with per-phase durations."""
     client = _client()
     data = client.runs.get_events(args.run_name)
+    if args.json:
+        import json as json_lib
+
+        print(json_lib.dumps(data), flush=True)
+        return
     events = data["events"]
     if not events:
         print(f"no events recorded for {args.run_name}")
@@ -598,6 +669,80 @@ def cmd_events(args) -> None:
         print(f"  {name:<10} {_fmt_secs(phases.get(name))}")
 
 
+def cmd_top(args) -> None:
+    """Live fleet health view (`dstack-tpu top`): runs × hosts over the
+    existing REST API — last step, step time, collective wait, MFU, goodput,
+    skew, straggler flag per host — so an operator watches a pod's health
+    without a Prometheus stack. Refreshes top(1)-style by default; --once
+    renders a single frame (scripts pipe `metrics --json` instead)."""
+    client = _client()
+
+    def render() -> None:
+        runs = [r for r in client.runs.list() if not r.status.is_finished()]
+        headers = ["RUN", "STATUS", "HOST", "STEP", "STEP TIME", "COLL WAIT",
+                   "MFU", "TOK/S", "GOODPUT", "SKEW", "FLAG"]
+        rows = []
+        for r in runs:
+            try:
+                wl = client.runs.get_metrics(r.run_name, limit=1)
+            except DstackTpuError:
+                wl = None
+            if not wl:
+                rows.append([r.run_name, r.status.value] + ["-"] * 9)
+                continue
+            latest = wl.get("latest") or {}
+            ledger = wl.get("goodput") or {}
+            goodput = (
+                f"{ledger['ratio'] * 100:.1f}%" if ledger.get("ratio") is not None else "-"
+            )
+            skew = wl.get("skew") or {}
+            skew_s = f"{skew['ratio']:.2f}x" if skew.get("ratio") is not None else "-"
+            hosts = wl.get("hosts") or []
+            if not hosts:
+                mfu = latest.get("mfu")
+                rows.append(
+                    [
+                        r.run_name, r.status.value, "-",
+                        str(latest.get("step", "-")),
+                        _fmt_secs(latest.get("step_time_s")),
+                        "-",
+                        f"{mfu * 100:.1f}%" if mfu is not None else "-",
+                        f"{latest['tokens_per_sec']:,.0f}"
+                        if latest.get("tokens_per_sec") is not None else "-",
+                        goodput, skew_s, "",
+                    ]
+                )
+                continue
+            for i, h in enumerate(hosts):
+                mfu = h.get("mfu")
+                rows.append(
+                    [
+                        r.run_name if i == 0 else "",  # group rows by run
+                        r.status.value if i == 0 else "",
+                        h.get("host", "-"),
+                        str(h["last_step"]) if h.get("last_step") is not None else "-",
+                        _fmt_secs(h.get("median_step_s")),
+                        _fmt_secs(h.get("collective_wait_s"))
+                        if h.get("collective_wait_s") else "-",
+                        f"{mfu * 100:.1f}%" if mfu is not None else "-",
+                        f"{latest['tokens_per_sec']:,.0f}"
+                        if i == 0 and latest.get("tokens_per_sec") is not None else
+                        ("-" if i == 0 else ""),
+                        goodput if i == 0 else "",
+                        skew_s if i == 0 else "",
+                        "STRAGGLER" if h.get("straggler") else "",
+                    ]
+                )
+        if not args.once:
+            _clear_screen()
+        if rows:
+            print(_table(headers, rows), flush=True)
+        else:
+            print("no live runs", flush=True)
+
+    _watch_loop(render, not args.once, args.interval)
+
+
 def cmd_offer(args) -> None:
     client = _client()
     resources = {}
@@ -622,7 +767,7 @@ def cmd_offer(args) -> None:
 
 
 _SUBCOMMANDS = (
-    "server config init apply attach metrics events ps stop delete logs offer fleet"
+    "server config init apply attach metrics events ps top stop delete logs offer fleet"
     " gateway volume secret backend instance project profile stats completion"
 )
 
@@ -799,6 +944,9 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--limit", type=int, default=20)
         s.add_argument("-w", "--watch", action="store_true", help="refresh continuously")
         s.add_argument("--interval", type=float, default=5.0)
+        s.add_argument("--json", action="store_true",
+                       help="machine-readable output (workload metrics incl."
+                            " per-host table, skew, goodput + resource points)")
         s.set_defaults(func=cmd_metrics)
 
     s = sub.add_parser(
@@ -827,7 +975,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("events", help="print a run's lifecycle timeline")
     s.add_argument("run_name")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output (events + phases)")
     s.set_defaults(func=cmd_events)
+
+    s = sub.add_parser(
+        "top",
+        help="live fleet health: runs × hosts with step time, collective"
+             " wait, MFU, goodput, skew, straggler flags",
+    )
+    s.add_argument("--interval", type=float, default=2.0)
+    s.add_argument("--once", action="store_true",
+                   help="render one frame and exit (no refresh loop)")
+    s.set_defaults(func=cmd_top)
 
     s = sub.add_parser("stop", help="stop runs")
     s.add_argument("runs", nargs="+")

@@ -1831,13 +1831,17 @@ async def _cleanup_auto_fleets(db: Database) -> None:
 
 
 async def process_metrics(db: Database) -> None:
-    """Sample every running job's agent into job_metrics_points + TTL sweep.
+    """Sample every running job's agent into job_metrics_points + TTL sweep,
+    then join the fresh window across each run's gang for skew/straggler
+    analysis (services/gang_health.py — one detector window per pass).
 
     Parity: reference background/tasks/process_metrics.py (collect_metrics /
     delete_metrics)."""
+    from dstack_tpu.server.services import gang_health as gang_health_service
     from dstack_tpu.server.services import metrics as metrics_service
 
     await metrics_service.collect_job_metrics(db)
+    await gang_health_service.check_gang_health(db)
     await metrics_service.enforce_utilization_policies(db)
     await metrics_service.sweep_metrics(db)
 

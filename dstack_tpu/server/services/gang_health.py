@@ -1,0 +1,560 @@
+"""Gang-wide health: cross-host step skew, straggler detection, per-host
+fleet gauges (ISSUE 15 — the layer PR 11 deliberately left out).
+
+PR 11's aggregation is lead-lineage-only: a 16-host gang is observable as
+exactly one host, so a slow host dragging every synchronous collective, or a
+sick DCN link, is invisible until goodput silently decays. This module joins
+``workload_metrics_points`` across ALL jobs of a run on every collection pass
+and derives what the lead stream can't show:
+
+* **Skew** — per-host median step time over the trailing window; the run's
+  skew ratio is slowest-host median / gang median. In synchronous training
+  every host's step stretches to the slowest host, so on a healthy gang the
+  ratio sits near 1.0; sustained growth is a straggler even before the rule
+  below names one (reported medians can diverge because step TIME is measured
+  locally: the straggler's compute runs long while the victims' fence —
+  ``collective_wait_s`` — absorbs the lag).
+* **Stragglers** — a robust rule with hysteresis: a host whose window median
+  exceeds ``k``·(gang median) for ``M`` consecutive windows is flagged
+  (``straggler_detected`` run_event naming the host); a flagged host must sit
+  below the lower ``clear_k`` threshold for ``M`` consecutive windows to
+  clear (``straggler_cleared``), so a host flapping around the threshold
+  can't spam events. Single-host runs never flag — there is no gang to skew
+  against. A host that leaves the sample entirely (gang shrink via elastic
+  restart, agent death) is cleared with ``reason="departed"`` so the gauge
+  can't stick at 1 for a host that no longer exists.
+* **Per-host attribution** — last step, median step time, collective/input
+  wait, and the agent's host-hardware sample (``kind="host"`` points: cpu,
+  memory, network — runner/src/executor.cpp) per host, surfaced through
+  ``/runs/get_metrics`` (``hosts`` + ``skew``), ``dstack-tpu metrics``'s
+  per-host table, ``dstack-tpu top``, and the ``/metrics`` families
+  ``dstack_tpu_run_step_skew_ratio``, ``dstack_tpu_run_straggler{host}``,
+  ``dstack_tpu_host_{cpu_percent,mem_bytes,collective_wait_seconds}``.
+
+The goodput ledger and the ``run_step_seconds`` histogram stay lead-only
+(services/metrics.py) — joining hosts here must not multiply productive time.
+
+Detector state (consecutive-window counters, flagged set) is in-process and
+per-run; a server restart resets hysteresis counters, which at worst delays a
+re-flag by ``M`` windows. The exported gauge snapshot is rebuilt whole on
+every pass, so runs that finish (or hosts that depart) drop out of
+``/metrics`` without a separate sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import logging
+import statistics
+from typing import Dict, List, Optional, Set, Tuple
+
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.common import now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# The pure straggler rule (unit-tested as a function of numbers, no DB)
+
+
+@dataclasses.dataclass
+class HostStats:
+    """One host's view of the trailing window (input to the rule)."""
+
+    host: str
+    median_step_s: Optional[float] = None  # None: no step points this window
+    last_step: Optional[int] = None
+    steps: int = 0
+    collective_wait_s: Optional[float] = None  # window mean
+    input_wait_s: Optional[float] = None  # window mean
+    mfu: Optional[float] = None  # latest
+    cpu_percent: Optional[float] = None  # latest agent host sample
+    mem_bytes: Optional[float] = None
+    last_ts: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RunState:
+    """Per-run hysteresis state carried across collection passes. ``flagged``
+    is seeded from the run's straggler run_events on first sight, so a server
+    restart (or a lease moving the run to another replica) resumes with the
+    durable flag set instead of re-emitting ``straggler_detected`` for a host
+    the timeline already flagged."""
+
+    over: Dict[str, int] = dataclasses.field(default_factory=dict)
+    under: Dict[str, int] = dataclasses.field(default_factory=dict)
+    flagged: Set[str] = dataclasses.field(default_factory=set)
+    # High-water marks for the exported telemetry-loss counters: the summed
+    # per-job emitter counters can DECREASE (a job finishes, a resubmitted
+    # emitter restarts at 0), and a Prometheus counter must not — rate()
+    # would read the dip as a reset and double-count history.
+    dropped_hwm: int = 0
+    write_errors_hwm: int = 0
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One pass's decisions for a run."""
+
+    skew_ratio: Optional[float] = None
+    gang_median_s: Optional[float] = None
+    slowest_host: Optional[str] = None
+    detected: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+    cleared: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+    ratios: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+def compute_skew(medians: Dict[str, float]) -> Optional[Dict]:
+    """The ONE skew definition (rule, gauge, and API all read this): gang
+    median = median of per-host window medians; ratio = slowest / gang
+    median. None when fewer than 2 hosts reported or the median is
+    degenerate."""
+    if len(medians) < 2:
+        return None
+    gang_median = statistics.median(medians.values())
+    if gang_median <= 0:
+        return None
+    slowest = max(medians, key=medians.get)
+    return {
+        "ratio": medians[slowest] / gang_median,
+        "gang_median_s": gang_median,
+        "slowest_host": slowest,
+        "ratios": {host: m / gang_median for host, m in medians.items()},
+    }
+
+
+def evaluate_stragglers(
+    hosts: List[HostStats],
+    state: RunState,
+    k: Optional[float] = None,
+    clear_k: Optional[float] = None,
+    windows: Optional[int] = None,
+) -> Verdict:
+    """Advance the detector one window. Mutates ``state``; returns the pass's
+    skew + detect/clear transitions (each with a human message).
+
+    Robustness properties the tests pin down:
+
+    * single-host runs (or windows where <2 hosts reported steps) never flag
+      and decay nothing — a transient collection gap must not clear a real
+      straggler, so counters simply freeze until data returns;
+    * hysteresis: flagging needs ``windows`` CONSECUTIVE over-threshold
+      windows, clearing needs ``windows`` consecutive under-``clear_k``
+      windows, and one healthy window resets the over-counter (and vice
+      versa) — a host flapping across ``k`` emits nothing;
+    * gang shrink: hosts absent from ``hosts`` entirely (elastic restart
+      dropped them) are forgotten; if flagged, they clear with
+      reason ``departed``.
+    """
+    k = k if k is not None else settings.STRAGGLER_K
+    clear_k = clear_k if clear_k is not None else settings.STRAGGLER_CLEAR_K
+    windows = windows if windows is not None else settings.STRAGGLER_WINDOWS
+    verdict = Verdict()
+
+    present = {h.host for h in hosts}
+    # Gang shrink / host departure: forget state, clear stuck flags.
+    for host in list(state.flagged):
+        if host not in present:
+            state.flagged.discard(host)
+            verdict.cleared.append(
+                (host, f"host {host} left the gang (elastic restart or agent loss)")
+            )
+    for d in (state.over, state.under):
+        for host in list(d):
+            if host not in present:
+                del d[host]
+
+    reporting = [h for h in hosts if h.median_step_s and h.median_step_s > 0]
+    medians = {h.host: h.median_step_s for h in reporting}
+    skew = compute_skew(medians)
+    if skew is None:
+        return verdict  # nothing to skew against; counters freeze
+    gang_median = skew["gang_median_s"]
+    verdict.gang_median_s = gang_median
+    verdict.skew_ratio = skew["ratio"]
+    verdict.slowest_host = skew["slowest_host"]
+    verdict.ratios = skew["ratios"]
+
+    for host, ratio in verdict.ratios.items():
+        if host in state.flagged:
+            if ratio < clear_k:
+                state.under[host] = state.under.get(host, 0) + 1
+                if state.under[host] >= windows:
+                    state.flagged.discard(host)
+                    state.under[host] = 0
+                    verdict.cleared.append(
+                        (
+                            host,
+                            f"host {host} back to {ratio:.2f}x gang median"
+                            f" ({medians[host]:.3f}s vs {gang_median:.3f}s)"
+                            f" for {windows} windows",
+                        )
+                    )
+            else:
+                state.under[host] = 0
+        else:
+            if ratio > k:
+                state.over[host] = state.over.get(host, 0) + 1
+                if state.over[host] >= windows:
+                    state.flagged.add(host)
+                    state.over[host] = 0
+                    state.under[host] = 0
+                    verdict.detected.append(
+                        (
+                            host,
+                            f"host {host} median step {medians[host]:.3f}s is"
+                            f" {ratio:.2f}x the gang median {gang_median:.3f}s"
+                            f" for {windows} consecutive windows",
+                        )
+                    )
+            else:
+                state.over[host] = 0
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+# Window summarization (points -> HostStats)
+
+
+def summarize_host(host: str, points: List[dict]) -> HostStats:
+    """Fold one host's window of step + host-sample points into HostStats."""
+    stats = HostStats(host=host)
+    step_times: List[float] = []
+    coll: List[float] = []
+    inp: List[float] = []
+    for p in points:
+        kind = p.get("kind")
+        if kind == "step":
+            try:
+                st = float(p.get("step_time_s") or 0.0)
+            except (TypeError, ValueError):
+                continue
+            if st > 0:
+                step_times.append(st)
+            num = p.get("step")
+            if isinstance(num, (int, float)):
+                stats.last_step = int(num)
+            for field, acc in (("collective_wait_s", coll), ("input_wait_s", inp)):
+                v = p.get(field)
+                if isinstance(v, (int, float)):
+                    acc.append(float(v))
+            if p.get("mfu") is not None:
+                try:
+                    stats.mfu = float(p["mfu"])
+                except (TypeError, ValueError):
+                    pass
+            stats.last_ts = p.get("ts") or stats.last_ts
+        elif kind == "host":
+            for field, attr in (
+                ("cpu_percent", "cpu_percent"),
+                ("mem_used_bytes", "mem_bytes"),
+            ):
+                v = p.get(field)
+                if isinstance(v, (int, float)):
+                    setattr(stats, attr, float(v))
+    if step_times:
+        stats.median_step_s = statistics.median(step_times)
+        stats.steps = len(step_times)
+    if coll:
+        stats.collective_wait_s = sum(coll) / len(coll)
+    if inp:
+        stats.input_wait_s = sum(inp) / len(inp)
+    return stats
+
+
+def _host_labels(by_job: Dict[str, Tuple[dict, List[dict]]]) -> Dict[str, str]:
+    """Per-job host labels: the emitter-stamped hostname when present, else
+    the job lineage. Hostnames that COLLIDE across jobs (local/test gangs run
+    several "hosts" on one box) get the lineage appended, so every label is
+    unique — a straggler flag must name exactly one stream."""
+    raw: Dict[str, str] = {}
+    for job_id, (job_row, points) in by_job.items():
+        label = None
+        for p in reversed(points):
+            h = p.get("host")
+            if isinstance(h, str) and h:
+                label = h
+                break
+        raw[job_id] = label or f"job{job_row['replica_num']}-{job_row['job_num']}"
+    counts: Dict[str, int] = {}
+    for label in raw.values():
+        counts[label] = counts.get(label, 0) + 1
+    labels: Dict[str, str] = {}
+    for job_id, (job_row, _points) in by_job.items():
+        label = raw[job_id]
+        if counts[label] > 1:
+            label = f"{label}/{job_row['replica_num']}-{job_row['job_num']}"
+        labels[job_id] = label
+    return labels
+
+
+async def _window_points_by_run(
+    db: Database, run_ids: List[str], window_s: float
+) -> Dict[str, Dict[str, Tuple[dict, List[dict]]]]:
+    """The trailing window of step/host points for every RUNNING job of the
+    given runs, plus each job's emitter counters (unwindowed — emitter points
+    only appear when the counters advance). ONE windowed query for the whole
+    batch (the enforce_utilization_policies N+1 lesson from PR 11 — a pass
+    over hundreds of live runs must not issue hundreds of queries). Returns
+    {run_id: {job_id: (job_row_like, points)}}."""
+    if not run_ids:
+        return {}
+    window_start = to_iso(now_utc() - datetime.timedelta(seconds=window_s))
+    # fetch_in binds `params` before the {in} values: the ? placeholder must
+    # precede the IN clause in the SQL.
+    rows = await db.fetch_in(
+        "SELECT w.timestamp, w.kind, w.data, j.run_id, j.id AS job_id,"
+        "       j.job_num, j.replica_num"
+        " FROM workload_metrics_points w JOIN jobs j ON j.id = w.job_id"
+        " WHERE ((w.kind IN ('step', 'host') AND w.timestamp >= ?)"
+        "        OR w.kind = 'emitter')"
+        "   AND j.status = 'running' AND j.run_id IN ({in})"
+        " ORDER BY w.timestamp ASC",
+        run_ids,
+        (window_start,),
+    )
+    by_run: Dict[str, Dict[str, Tuple[dict, List[dict]]]] = {}
+    for r in rows:
+        try:
+            point = json.loads(r["data"])
+        except ValueError:
+            continue
+        point["kind"] = r["kind"]
+        entry = by_run.setdefault(r["run_id"], {}).setdefault(
+            r["job_id"],
+            ({"job_num": r["job_num"], "replica_num": r["replica_num"]}, []),
+        )
+        entry[1].append(point)
+    return by_run
+
+
+async def _run_window_points(
+    db: Database, run_id: str, window_s: float
+) -> Dict[str, Tuple[dict, List[dict]]]:
+    """Single-run window (the on-demand API path)."""
+    by_run = await _window_points_by_run(db, [run_id], window_s)
+    return by_run.get(run_id, {})
+
+
+async def _flagged_from_events(db: Database, run_id: str) -> Set[str]:
+    """The durable straggler flag set: fold the run's straggler_detected /
+    straggler_cleared timeline (reason = host). This is what seeds a fresh
+    RunState — in-process hysteresis counters die with the process, but a
+    flag the timeline raised must not be re-raised after a restart or a
+    lease handoff."""
+    rows = await db.fetchall(
+        "SELECT new_status, reason FROM run_events WHERE run_id = ?"
+        " AND new_status IN ('straggler_detected', 'straggler_cleared')"
+        " ORDER BY seq ASC",
+        (run_id,),
+    )
+    flagged: Set[str] = set()
+    for r in rows:
+        if not r["reason"]:
+            continue
+        if r["new_status"] == "straggler_detected":
+            flagged.add(r["reason"])
+        else:
+            flagged.discard(r["reason"])
+    return flagged
+
+
+def _emitter_counters(points: List[dict]) -> Tuple[int, int]:
+    """(dropped, write_errors) — the emitter reports cumulative counters, so
+    the latest (max) value per job is the truth."""
+    dropped = write_errors = 0
+    for p in points:
+        if p.get("kind") != "emitter":
+            continue
+        try:
+            dropped = max(dropped, int(p.get("dropped") or 0))
+            write_errors = max(write_errors, int(p.get("write_errors") or 0))
+        except (TypeError, ValueError):
+            continue
+    return dropped, write_errors
+
+
+# ---------------------------------------------------------------------------
+# The collection-pass check + exported gauge snapshot
+
+# run_id -> RunState, pruned to the live-run set every pass.
+_states: Dict[str, RunState] = {}
+# Rebuilt whole each pass: [{run, skew, hosts: {host: {...}}, dropped, ...}].
+_snapshot: List[dict] = []
+
+
+def reset() -> None:
+    """Test hook: forget all detector state and gauges."""
+    _states.clear()
+    _snapshot.clear()
+
+
+def forget_run(run_id: str) -> None:
+    """Run deleted: drop its detector state (the gauge snapshot self-heals
+    on the next pass)."""
+    _states.pop(run_id, None)
+
+
+def snapshot() -> List[dict]:
+    """The latest pass's per-run gang view (rendered by prometheus.py)."""
+    return list(_snapshot)
+
+
+def state_for(run_id: str) -> RunState:
+    return _states.setdefault(run_id, RunState())
+
+
+async def check_gang_health(db: Database) -> int:
+    """One pass over every live run THIS replica owns: summarize per-host
+    windows, advance the straggler rule, persist detect/clear run_events,
+    rebuild the gauge snapshot. Returns the number of runs examined. Runs
+    with a single host still land in the snapshot (per-host CLI table +
+    emitter drop counters work for solo runs) — they just can never flag.
+
+    Lease scoping: with run leases enabled (PR 14), only the replica whose
+    scheduler owns a run advances its detector — N replicas each running the
+    metrics pass must not emit N copies of every straggler event or race
+    their hysteresis counters. Unleased runs (leases disabled, or a gap
+    between lease sweeps) are processed by whoever gets there; the durable
+    flag seed below keeps a handoff from re-raising existing flags."""
+    from dstack_tpu.server.services import leases as leases_service
+
+    runs = await db.fetchall(
+        "SELECT r.id, r.run_name, r.status FROM runs r"
+        " WHERE r.deleted = 0 AND r.id IN"
+        " (SELECT DISTINCT run_id FROM jobs WHERE status = 'running')"
+    )
+    if settings.RUN_LEASES_ENABLED and runs:
+        lease_owners = await leases_service.owners(db, [r["id"] for r in runs])
+        me = leases_service.replica_id()
+        runs = [
+            r for r in runs if lease_owners.get(r["id"], me) == me
+        ]
+    fresh_snapshot: List[dict] = []
+    live_ids = set()
+    windows = await _window_points_by_run(
+        db, [r["id"] for r in runs], settings.GANG_WINDOW_SECONDS
+    )
+    for run in runs:
+        live_ids.add(run["id"])
+        by_job = windows.get(run["id"])
+        if not by_job:
+            continue
+        labels = _host_labels(by_job)
+        host_stats: List[HostStats] = []
+        dropped_total = write_errors_total = 0
+        for job_id, (job_row, points) in by_job.items():
+            host_stats.append(summarize_host(labels[job_id], points))
+            d, w = _emitter_counters(points)
+            dropped_total += d
+            write_errors_total += w
+        if run["id"] not in _states:
+            # First sight of this run in THIS process: seed the flag set
+            # from the durable timeline (restart / lease-handoff continuity).
+            seeded = state_for(run["id"])
+            seeded.flagged = await _flagged_from_events(db, run["id"])
+        state = state_for(run["id"])
+        # Monotonic export: the summed per-job counters can dip when a job
+        # finishes or a fresh emitter restarts at zero.
+        state.dropped_hwm = max(state.dropped_hwm, dropped_total)
+        state.write_errors_hwm = max(state.write_errors_hwm, write_errors_total)
+        dropped_total = state.dropped_hwm
+        write_errors_total = state.write_errors_hwm
+        verdict = evaluate_stragglers(host_stats, state)
+        for host, message in verdict.detected:
+            await _record_straggler_event(
+                db, run["id"], "straggler_detected", run["status"], host, message
+            )
+            logger.warning(
+                "run %s: straggler detected: %s", run["run_name"], message
+            )
+        for host, message in verdict.cleared:
+            await _record_straggler_event(
+                db, run["id"], "straggler_cleared", run["status"], host, message
+            )
+            logger.info("run %s: straggler cleared: %s", run["run_name"], message)
+        fresh_snapshot.append(
+            {
+                "run": run["run_name"],
+                "run_id": run["id"],
+                "skew_ratio": verdict.skew_ratio,
+                "gang_median_s": verdict.gang_median_s,
+                "slowest_host": verdict.slowest_host,
+                "flagged": sorted(state.flagged),
+                "hosts": [dataclasses.asdict(h) for h in host_stats],
+                "dropped": dropped_total,
+                "write_errors": write_errors_total,
+            }
+        )
+    for run_id in list(_states):
+        if run_id not in live_ids:
+            del _states[run_id]
+    _snapshot[:] = fresh_snapshot
+    return len(runs)
+
+
+async def _record_straggler_event(
+    db: Database, run_id: str, event: str, run_status: str, host: str, message: str
+) -> None:
+    """One straggler_detected/straggler_cleared run_event; ``reason`` carries
+    the offending host so `dstack-tpu events` (and greps) name it directly."""
+    from dstack_tpu.server.services import events as events_service
+
+    def _tx(conn) -> None:
+        events_service.record_event_tx(
+            conn,
+            run_id,
+            event,
+            old_status=run_status,
+            actor="gang_health",
+            reason=host,
+            message=message,
+        )
+
+    await db.run(_tx)
+
+
+# ---------------------------------------------------------------------------
+# API summary (the `hosts` + `skew` blocks of /runs/get_metrics)
+
+
+async def get_run_gang_metrics(db: Database, run_id: str) -> Dict:
+    """Per-host table + skew for one run, on demand (the API/CLI path; the
+    collection-pass snapshot serves /metrics so a scrape costs no query).
+    Straggler flags come from the pass-maintained state when this replica
+    owns the run, and from the durable run_events timeline otherwise — a
+    lease-sharded deployment must answer the same no matter which replica
+    the proxy routed the API call to."""
+    by_job = await _run_window_points(db, run_id, settings.GANG_WINDOW_SECONDS)
+    state = _states.get(run_id)
+    if state is not None:
+        flagged = state.flagged
+    else:
+        flagged = await _flagged_from_events(db, run_id)
+    labels = _host_labels(by_job)
+    hosts: List[Dict] = []
+    medians: Dict[str, float] = {}
+    for job_id, (job_row, points) in sorted(
+        by_job.items(), key=lambda e: (e[1][0]["replica_num"], e[1][0]["job_num"])
+    ):
+        label = labels[job_id]
+        stats = summarize_host(label, points)
+        row = dataclasses.asdict(stats)
+        row["replica_num"] = job_row["replica_num"]
+        row["job_num"] = job_row["job_num"]
+        row["straggler"] = label in flagged
+        hosts.append(row)
+        if stats.median_step_s:
+            medians[label] = stats.median_step_s
+    skew = compute_skew(medians)
+    if skew is not None:
+        skew = {
+            "ratio": round(skew["ratio"], 4),
+            "gang_median_s": round(skew["gang_median_s"], 6),
+            "slowest_host": skew["slowest_host"],
+        }
+    return {"hosts": hosts, "skew": skew, "stragglers": sorted(flagged)}

@@ -308,9 +308,25 @@ async def get_run_workload_metrics(
                 dropped = max(dropped, int(point.get("dropped") or 0))
             except (TypeError, ValueError):
                 pass
-        if r["job_num"] == 0 and r["replica_num"] == 0:
+        # The ledger reads step/mark kinds ONLY (matching the /metrics gauge
+        # query): the agent appends a kind="host" hardware point to every
+        # sample, and letting those into compute_goodput stretches the wall
+        # clock and fills restart gaps — a host point right before run_start
+        # bills pull/startup as restart_s, and host points DURING a real
+        # preemption's downtime erase the restart_s PR 12 measures.
+        if (
+            r["job_num"] == 0
+            and r["replica_num"] == 0
+            and kind in ("step", "mark")
+        ):
             lead_points.append(point)
     step_points = [p for p in lead_points if p.get("kind") == "step"]
+    # Per-host view (ISSUE 15): the lead lineage represents the run for the
+    # ledger/series above, but skew and straggler attribution need every
+    # host — gang_health joins the trailing window across ALL running jobs.
+    from dstack_tpu.server.services import gang_health
+
+    gang = await gang_health.get_run_gang_metrics(db, run_id)
     return {
         "goodput": compute_goodput(lead_points),
         "latest": step_points[-1] if step_points else None,
@@ -318,6 +334,9 @@ async def get_run_workload_metrics(
         "profile": latest_profile,
         "dropped": dropped,
         "points": step_points[-max(0, min(limit, 1000)):],
+        "hosts": gang["hosts"],
+        "skew": gang["skew"],
+        "stragglers": gang["stragglers"],
     }
 
 

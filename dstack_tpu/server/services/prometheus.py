@@ -245,6 +245,96 @@ async def render_metrics(db: Database) -> str:
         )
     )
 
+    # Gang health (services/gang_health.py): per-run cross-host step skew,
+    # straggler flags, and per-host hardware/wait attribution. Rendered from
+    # the collection-pass snapshot — a scrape costs no query, and runs that
+    # finish drop out when the next pass rebuilds it. The goodput/step
+    # families above stay lead-lineage-only; these are the ONLY families that
+    # fan out per host.
+    from dstack_tpu.server.services import gang_health
+
+    skew_samples, straggler_samples = [], []
+    host_cpu, host_mem, host_coll = [], [], []
+    dropped_samples, write_error_samples = [], []
+    for entry in gang_health.snapshot():
+        run_labels = {"run": entry["run"]}
+        if entry.get("skew_ratio") is not None:
+            skew_samples.append((run_labels, float(entry["skew_ratio"])))
+        flagged = set(entry.get("flagged") or ())
+        for host in entry.get("hosts") or ():
+            labels = {"run": entry["run"], "host": host["host"]}
+            straggler_samples.append((labels, 1.0 if host["host"] in flagged else 0.0))
+            if host.get("cpu_percent") is not None:
+                host_cpu.append((labels, float(host["cpu_percent"])))
+            if host.get("mem_bytes") is not None:
+                host_mem.append((labels, float(host["mem_bytes"])))
+            if host.get("collective_wait_s") is not None:
+                host_coll.append((labels, float(host["collective_wait_s"])))
+        if entry.get("dropped"):
+            dropped_samples.append((run_labels, float(entry["dropped"])))
+        if entry.get("write_errors"):
+            write_error_samples.append((run_labels, float(entry["write_errors"])))
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_step_skew_ratio",
+            "Slowest-host median step time over the gang median (1.0 = healthy) by run",
+            "gauge",
+            skew_samples,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_straggler",
+            "1 while the host is flagged as the run's straggler (hysteresis rule)",
+            "gauge",
+            straggler_samples,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_host_cpu_percent",
+            "Host CPU utilization sampled by the runner agent, by run and host",
+            "gauge",
+            host_cpu,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_host_mem_bytes",
+            "Host memory in use sampled by the runner agent, by run and host",
+            "gauge",
+            host_mem,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_host_collective_wait_seconds",
+            "Mean per-step collective fence wait over the trailing window, by run and host",
+            "gauge",
+            host_coll,
+        )
+    )
+    # Emitter self-reported loss: points dropped on buffer overflow and
+    # sidecar flush failures, summed across the run's hosts (cumulative
+    # per-process counters -> counter semantics; invisible outside the JSONL
+    # stream before this).
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_telemetry_dropped_points_total",
+            "Telemetry points dropped by the run's emitters (buffer overflow or failed flush)",
+            "counter",
+            dropped_samples,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_telemetry_write_errors_total",
+            "Sidecar flush failures reported by the run's emitters",
+            "counter",
+            write_error_samples,
+        )
+    )
+
     # HTTP request metrics from the middleware (services/request_metrics.py).
     from dstack_tpu.server.services import request_metrics
 

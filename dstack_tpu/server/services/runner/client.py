@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional
 
 import aiohttp
 
-from dstack_tpu.core import faults
+from dstack_tpu.core import faults, tracing
 from dstack_tpu.core.errors import SSHError
 from dstack_tpu.core.models.runs import ClusterInfo, JobRuntimeData, JobSpec
 
@@ -90,6 +90,13 @@ class RunnerClient:
                     kwargs["data"] = data
                 if params is not None:
                     kwargs["params"] = params
+                # Trace propagation: the scheduler's current trace id rides
+                # every agent call, and the agent echoes it into its own log
+                # lines — a run_event's trace_id greps straight into the
+                # agent log on the host (runner/src/main.cpp).
+                trace_id = tracing.current_trace_id()
+                if trace_id:
+                    kwargs["headers"] = {"X-Dstack-Trace-Id": trace_id}
                 async with session.request(method, self.base + path, **kwargs) as resp:
                     body = await resp.read()
                     if resp.status >= 500:

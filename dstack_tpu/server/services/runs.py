@@ -500,6 +500,11 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
         from dstack_tpu.server.services import leases as leases_service
 
         await leases_service.release_runs(db, [row["id"]])
+        # Gang-health detector state (straggler hysteresis counters) dies
+        # with the run; its /metrics snapshot self-heals on the next pass.
+        from dstack_tpu.server.services import gang_health as gang_health_service
+
+        gang_health_service.forget_run(row["id"])
 
 
 def _validate_run_name(name: str) -> None:

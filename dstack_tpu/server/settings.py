@@ -107,6 +107,17 @@ RUNNER_CALL_ATTEMPTS = int(os.getenv("DSTACK_TPU_RUNNER_CALL_ATTEMPTS", "2"))
 BACKEND_CALL_TIMEOUT = float(os.getenv("DSTACK_TPU_BACKEND_CALL_TIMEOUT", "300"))
 BACKEND_POLL_TIMEOUT = float(os.getenv("DSTACK_TPU_BACKEND_POLL_TIMEOUT", "30"))
 
+# Gang health (services/gang_health.py): per-host step-skew analysis joined
+# across ALL jobs of a run on every metrics pass. A host whose window-median
+# step time exceeds STRAGGLER_K x the gang median for STRAGGLER_WINDOWS
+# consecutive passes is flagged (run_event + /metrics gauge); a flagged host
+# clears after the same number of windows below the LOWER clear threshold
+# (hysteresis — a host flapping around K can't spam events).
+GANG_WINDOW_SECONDS = float(os.getenv("DSTACK_TPU_GANG_WINDOW_SECONDS", "120"))
+STRAGGLER_K = float(os.getenv("DSTACK_TPU_STRAGGLER_K", "1.5"))
+STRAGGLER_CLEAR_K = float(os.getenv("DSTACK_TPU_STRAGGLER_CLEAR_K", "1.2"))
+STRAGGLER_WINDOWS = int(os.getenv("DSTACK_TPU_STRAGGLER_WINDOWS", "2"))
+
 LOCAL_BACKEND_ENABLED = _env_bool("DSTACK_TPU_LOCAL_BACKEND_ENABLED", True)
 # Container mode the local backend passes to its runner agents (--docker):
 # never = host exec (default, no engine dependency), auto/always = container path.

@@ -27,7 +27,13 @@ Design contract (the whole point of this module):
 Point schema (one JSON object per line, all optional but ``ts``/``kind``):
 
 * ``kind="step"``  — per-train-step: ``step``, ``step_time_s``,
-  ``tokens_per_sec``, ``mfu``, ``tf_per_sec``, ``loss``, ``input_wait_s``.
+  ``tokens_per_sec``, ``mfu``, ``tf_per_sec``, ``loss``, ``input_wait_s``,
+  ``collective_wait_s`` (the timed psum fence train.py brackets the step
+  with — what the server's gang-health skew attribution reads).
+* every point also carries the emitting host's identity — ``host``
+  (hostname), ``proc`` (TPU worker id / node rank), ``slice`` (MegaScale
+  slice id) — so a gang's N sidecar streams stay attributable per host
+  after the server joins them (services/gang_health.py).
 * ``kind="engine"`` — serving engine gauges: ``queue_depth``, ``active``,
   ``generated_tokens``, ``prefix_hit_rate``, ``spec_accept_rate``, ...
 * ``kind="mark"``  — lifecycle: ``event`` in {``run_start``, ``compile_start``,
@@ -49,6 +55,7 @@ from __future__ import annotations
 import datetime
 import json
 import os
+import socket
 import threading
 import time
 from collections import deque
@@ -65,6 +72,33 @@ DEFAULT_FLUSH_INTERVAL = 0.25
 
 def _iso_now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _host_identity() -> Dict[str, Any]:
+    """Per-host identity stamped on every point so a gang's N streams stay
+    attributable after they merge server-side (services/gang_health.py):
+    ``host`` (hostname), ``proc`` (TPU worker / node rank when the agent's
+    cluster env is present), ``slice`` (MegaScale slice id on multislice).
+    Env-only + stdlib — jax may not be importable yet when the first marks
+    are emitted."""
+    ident: Dict[str, Any] = {}
+    try:
+        ident["host"] = socket.gethostname()
+    except Exception:
+        pass
+    for field, names in (
+        ("proc", ("TPU_WORKER_ID", "DSTACK_NODE_RANK")),
+        ("slice", ("MEGASCALE_SLICE_ID",)),
+    ):
+        for name in names:
+            raw = os.environ.get(name)
+            if raw:
+                try:
+                    ident[field] = int(raw)
+                except ValueError:
+                    continue  # unparsable -> try the next fallback var
+                break
+    return ident
 
 
 class _JaxProfiler:
@@ -109,6 +143,7 @@ class TelemetryEmitter:
         self._wake = threading.Event()
         self._closed = threading.Event()
         self._profiler = profiler if profiler is not None else _JaxProfiler()
+        self.identity: Dict[str, Any] = _host_identity()
         self._profile_id = 0  # last handled control-command id
         self._profile_stop_at: Optional[float] = None
         self._profile_artifact: Optional[str] = None
@@ -126,6 +161,9 @@ class TelemetryEmitter:
         step's — a full buffer drops (and counts), nothing here raises."""
         try:
             point = {"ts": _iso_now(), "kind": kind}
+            # Identity first so an explicit field (tests, multi-tenant
+            # harnesses) can override what the env derived.
+            point.update(self.identity)
             point.update(fields)
             with self._lock:
                 if len(self._buf) >= self.capacity:
@@ -141,6 +179,15 @@ class TelemetryEmitter:
 
     def mark(self, event: str, **fields: Any) -> None:
         self.emit("mark", event=event, **fields)
+
+    def set_identity(self, **fields: Any) -> None:
+        """Merge identity fields stamped on every subsequent point (the train
+        entrypoint refines ``proc`` with jax.process_index() once the backend
+        is up — the env derivation above may be absent in local runs)."""
+        try:
+            self.identity.update(fields)
+        except Exception:
+            pass
 
     # -- flushing ----------------------------------------------------------
 
@@ -325,7 +372,13 @@ class NullEmitter:
     dropped = 0
     write_errors = 0
 
+    def __init__(self) -> None:
+        self.identity: Dict[str, Any] = {}
+
     def emit(self, kind: str, **fields: Any) -> None:
+        pass
+
+    def set_identity(self, **fields: Any) -> None:
         pass
 
     def step(self, step: int, step_time_s: float, **fields: Any) -> None:

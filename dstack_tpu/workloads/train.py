@@ -202,10 +202,41 @@ def _device_peak_flops(device=None) -> float:
     return 197e12
 
 
+def make_collective_fence(mesh: Optional[Mesh]):
+    """A cheap timed all-reduce fence over the whole mesh, for per-step
+    collective-wait attribution (the gang-health signal, ISSUE 15).
+
+    The returned callable runs one scalar-sum over an array sharded across
+    every mesh axis — XLA lowers it to a psum touching all devices — and
+    returns its wall time. Called right after a step's ``block_until_ready``,
+    the local devices are idle, so the fence measures how long this host
+    waits for the REST of the gang: on a healthy pod it is the bare
+    collective latency; when one host runs behind, every OTHER host's fence
+    stretches by the lag (the straggler itself reports a near-zero fence and
+    a long step — services/gang_health.py reads both sides). Compiled once
+    here, outside the timed path. None when there is no mesh (nothing to
+    fence)."""
+    if mesh is None or mesh.size <= 1:
+        return None
+    axes = tuple(mesh.axis_names)
+    x = jax.device_put(
+        jnp.ones((mesh.size,), jnp.float32), NamedSharding(mesh, P(axes))
+    )
+    reduce = jax.jit(lambda a: a.sum())
+    jax.block_until_ready(reduce(x))  # compile + first hop outside the loop
+
+    def fence() -> float:
+        t0 = time.perf_counter()
+        jax.block_until_ready(reduce(x))
+        return time.perf_counter() - t0
+
+    return fence
+
+
 def _timed_loop(steps: int, batch: int, seq: int, do_step,
                 flops_per_step: float = 0.0, telemetry=None,
                 step_extras=None, start_step: int = 0,
-                on_step=None) -> Dict[str, float]:
+                on_step=None, fence=None) -> Dict[str, float]:
     """Shared throughput loop: `do_step()` advances state and returns loss.
 
     The first call is compile + first step and is reported (and returned) as
@@ -229,7 +260,10 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
     ``start_step+1 .. steps`` in prints and telemetry, so a resumed run's
     step stream continues where the preempted one stopped. ``on_step(step,
     loss)`` fires after every completed step (the checkpoint hook; its
-    exceptions propagate — an injected crash must actually kill the run)."""
+    exceptions propagate — an injected crash must actually kill the run).
+    ``fence`` (make_collective_fence) runs after each step and its wall time
+    lands on the step point as ``collective_wait_s`` — the cross-host wait
+    signal gang-health skew attribution is built on."""
     if telemetry is None:
         telemetry = telemetry_lib.get_emitter()
     if steps - start_step <= 0:
@@ -269,6 +303,11 @@ def _timed_loop(steps: int, batch: int, seq: int, do_step,
                 point.update(step_extras())
             except Exception:
                 pass  # extras are advisory; never let them kill the loop
+        if fence is not None:
+            try:
+                point["collective_wait_s"] = round(fence(), 6)
+            except Exception:
+                fence = None  # a broken fence degrades, never kills the loop
         telemetry.step(i + 1, round(dt, 6), **point)
         if on_step is not None:
             on_step(i + 1, loss)
@@ -418,6 +457,7 @@ def _moe_main(args, moe_lib, data_lib) -> None:
           f"grad_accum={args.grad_accum} prefetch={args.prefetch}",
           flush=True)
     telemetry = telemetry_lib.get_emitter()
+    telemetry.set_identity(proc=jax.process_index())
     telemetry.mark("run_start", workload="train", config=args.config,
                    devices=n, batch=batch, seq=seq)
     optimizer = make_optimizer(mu_dtype=args.mu_dtype or None)
@@ -452,10 +492,11 @@ def _moe_main(args, moe_lib, data_lib) -> None:
             ckpt, args.checkpoint_every, args.steps, lambda: state,
             mesh_shape=dict(mesh.shape), resumed=start_step > 0,
         )
+        fence = make_collective_fence(mesh)
         try:
             _timed_loop(args.steps, batch, seq, do_step, telemetry=telemetry,
                         step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)},
-                        start_step=start_step, on_step=on_step)
+                        start_step=start_step, on_step=on_step, fence=fence)
             if ckpt is not None and args.checkpoint_every:
                 ckpt.save(args.steps, state, data_offset=args.steps,
                           mesh_shape=dict(mesh.shape), block=True)
@@ -593,6 +634,9 @@ def main() -> None:
           f"batch={batch} seq={seq} grad_accum={args.grad_accum} "
           f"prefetch={args.prefetch}", flush=True)
     telemetry = telemetry_lib.get_emitter()
+    # jax is up: refine the env-derived host identity with the authoritative
+    # process index (multi-host gangs) so every point attributes per host.
+    telemetry.set_identity(proc=jax.process_index())
     telemetry.mark("run_start", workload="train", config=args.config,
                    devices=len(devices), mesh=dict(mesh.shape), batch=batch,
                    seq=seq, grad_accum=args.grad_accum)
@@ -625,11 +669,12 @@ def main() -> None:
             lambda: box["state"], mesh_shape=dict(mesh.shape),
             resumed=start_step > 0,
         )
+        fence = make_collective_fence(mesh)
         try:
             _timed_loop(args.steps, batch, seq, do_step, flops_per_step,
                         telemetry=telemetry,
                         step_extras=lambda: {"input_wait_s": round(feed_wait["s"], 6)},
-                        start_step=start_step, on_step=on_step)
+                        start_step=start_step, on_step=on_step, fence=fence)
             if ckpt is not None and args.checkpoint_every:
                 # Final state: a completed run's last step is restorable too.
                 ckpt.save(args.steps, box["state"], data_offset=args.steps,

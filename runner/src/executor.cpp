@@ -281,16 +281,115 @@ dj::Json Executor::metrics() {
   out.set("memory_usage_bytes", rss_bytes);
   // TPU duty-cycle/HBM scraped from the runtime metrics endpoint when
   // DSTACK_TPU_RUNTIME_METRICS_URL is set (the DCGM-exporter analog); null
-  // otherwise (src/tpu_metrics.cpp).
-  out.set("tpu", dtpu::sample_tpu_metrics());
+  // otherwise (src/tpu_metrics.cpp). Scraped ONCE per sample, outside mu_ —
+  // the host point below reuses it (a slow/unreachable endpoint must not
+  // stall submit/stop behind the lock, nor double the scrape load).
+  dj::Json tpu = dtpu::sample_tpu_metrics();
   // Workload telemetry points appended by the job's emitter since the last
-  // sample ride the same response (at-most-once: the offset advances on read).
+  // sample ride the same response (at-most-once: the offset advances on read),
+  // plus one agent-side host hardware sample per pull — the same stream, so
+  // per-host cpu/mem/net land in workload_metrics_points next to the step
+  // points they explain (gang-health per-host attribution).
   {
     std::lock_guard<std::mutex> lk(mu_);
     dj::Json workload = tail_telemetry_locked();
-    if (!workload.as_array().empty()) out.set("workload", std::move(workload));
+    workload.push_back(host_sample_locked(tpu));
+    out.set("workload", std::move(workload));
   }
+  out.set("tpu", std::move(tpu));
   return out;
+}
+
+static double monotonic_seconds() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + static_cast<double>(ts.tv_nsec) / 1e9;
+}
+
+dj::Json Executor::host_sample_locked(const dj::Json& tpu) {
+  dj::Json p = dj::Json::object();
+  p.set("ts", iso_now());
+  p.set("kind", "host");
+  char hn[256] = {0};
+  if (gethostname(hn, sizeof(hn) - 1) == 0) p.set("host", std::string(hn));
+
+  // CPU: /proc/stat aggregate line. busy = delta(total) - delta(idle+iowait).
+  int64_t total = 0, idle_all = 0;
+  {
+    std::ifstream stat("/proc/stat");
+    std::string label;
+    if (stat >> label && label == "cpu") {
+      int64_t v;
+      for (int i = 0; i < 10 && (stat >> v); ++i) {
+        total += v;
+        if (i == 3 || i == 4) idle_all += v;  // idle + iowait
+      }
+    }
+  }
+  if (host_cpu_total_ > 0 && total > host_cpu_total_) {
+    double window = static_cast<double>(total - host_cpu_total_);
+    double busy = window - static_cast<double>(idle_all - host_cpu_idle_);
+    double pct = 100.0 * busy / window;
+    if (pct < 0) pct = 0;
+    if (pct > 100) pct = 100;
+    p.set("cpu_percent", pct);
+  }
+  host_cpu_total_ = total;
+  host_cpu_idle_ = idle_all;
+
+  // Memory: MemTotal - MemAvailable (kB) — what the kernel says is actually
+  // committed, unlike free(1)'s cache-inflated "used".
+  {
+    std::ifstream mem("/proc/meminfo");
+    std::string line;
+    int64_t total_kb = 0, avail_kb = 0;
+    while (std::getline(mem, line)) {
+      if (line.rfind("MemTotal:", 0) == 0) total_kb = atoll(line.c_str() + 9);
+      else if (line.rfind("MemAvailable:", 0) == 0) avail_kb = atoll(line.c_str() + 13);
+      if (total_kb && avail_kb) break;
+    }
+    if (total_kb > 0) {
+      p.set("mem_total_bytes", total_kb * 1024);
+      p.set("mem_used_bytes", (total_kb - (avail_kb > 0 ? avail_kb : 0)) * 1024);
+    }
+  }
+
+  // Network: sum rx/tx bytes over non-loopback interfaces; rates via delta.
+  int64_t rx = 0, tx = 0;
+  {
+    std::ifstream net("/proc/net/dev");
+    std::string line;
+    while (std::getline(net, line)) {
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string ifname = line.substr(0, colon);
+      ifname.erase(0, ifname.find_first_not_of(' '));
+      if (ifname == "lo") continue;
+      std::istringstream fields(line.substr(colon + 1));
+      int64_t v, if_rx = 0, if_tx = 0;
+      for (int i = 0; i < 16 && (fields >> v); ++i) {
+        if (i == 0) if_rx = v;   // rx bytes
+        if (i == 8) if_tx = v;   // tx bytes
+      }
+      rx += if_rx;
+      tx += if_tx;
+    }
+  }
+  double now_mono = monotonic_seconds();
+  if (host_sample_at_ > 0 && now_mono > host_sample_at_ && rx >= host_net_rx_ &&
+      tx >= host_net_tx_) {
+    double dt = now_mono - host_sample_at_;
+    p.set("net_rx_bytes_per_s", static_cast<double>(rx - host_net_rx_) / dt);
+    p.set("net_tx_bytes_per_s", static_cast<double>(tx - host_net_tx_) / dt);
+  }
+  host_net_rx_ = rx;
+  host_net_tx_ = tx;
+  host_sample_at_ = now_mono;
+
+  // TPU runtime metrics: the sample metrics() already took (null when the
+  // endpoint is absent/unreachable).
+  if (!tpu.is_null()) p.set("tpu", tpu);
+  return p;
 }
 
 dj::Json Executor::tail_telemetry_locked() {

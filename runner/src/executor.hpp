@@ -75,6 +75,11 @@ class Executor {
   std::string telemetry_dir() const { return base_dir_ + "/telemetry"; }
   std::string telemetry_file() const { return telemetry_dir() + "/workload.jsonl"; }
   dj::Json tail_telemetry_locked();
+  // Host hardware sample (/proc cpu/mem/net + the TPU runtime sample the
+  // caller already scraped) shipped as a kind="host" point in the same
+  // workload stream — the per-host half of the control plane's gang-health
+  // view (services/gang_health.py).
+  dj::Json host_sample_locked(const dj::Json& tpu);
 
   std::string base_dir_;
   std::string docker_mode_;
@@ -108,6 +113,14 @@ class Executor {
   // also discount marks that predate their request (cli cmd_profile does).
   int64_t telemetry_offset_ = 0;
   int64_t profile_seq_ = 0;
+
+  // Host-sample deltas (guarded by mu_): cpu percent and net byte rates need
+  // the previous /proc counters; zero until the second sample.
+  int64_t host_cpu_total_ = 0;
+  int64_t host_cpu_idle_ = 0;
+  int64_t host_net_rx_ = 0;
+  int64_t host_net_tx_ = 0;
+  double host_sample_at_ = 0.0;  // CLOCK_MONOTONIC seconds of the last sample
 };
 
 }  // namespace drunner

@@ -47,17 +47,34 @@ int main(int argc, char** argv) {
   drunner::Executor executor(base_dir, docker_mode, docker_host);
   dhttp::Server server(host, port);
 
+  // Trace propagation: the control plane stamps every call with its current
+  // trace id (X-Dstack-Trace-Id, services/runner/client.py). Echoing it on
+  // the agent's own log line means a run_event's trace_id greps straight
+  // into this host's agent log. Quiet ops (healthcheck, pull, metrics) are
+  // polled every second and would drown the log — only state-changing calls
+  // are echoed.
+  auto trace_log = [](const dhttp::Request& req, const char* op) {
+    auto it = req.headers.find("x-dstack-trace-id");
+    if (it != req.headers.end() && !it->second.empty()) {
+      printf("[trace %s] %s\n", it->second.c_str(), op);
+      fflush(stdout);
+    }
+  };
+
   server.handle("GET", "/api/healthcheck", [&](const dhttp::Request&) {
     return dhttp::Response{200, "application/json", executor.health().dump()};
   });
   server.handle("POST", "/api/submit", [&](const dhttp::Request& req) {
+    trace_log(req, "POST /api/submit");
     return dhttp::Response{200, "application/json",
                            executor.submit(dj::Json::parse(req.body)).dump()};
   });
   server.handle("POST", "/api/upload_code", [&](const dhttp::Request& req) {
+    trace_log(req, "POST /api/upload_code");
     return dhttp::Response{200, "application/json", executor.upload_code(req.body).dump()};
   });
-  server.handle("POST", "/api/run", [&](const dhttp::Request&) {
+  server.handle("POST", "/api/run", [&](const dhttp::Request& req) {
+    trace_log(req, "POST /api/run");
     return dhttp::Response{200, "application/json", executor.run().dump()};
   });
   server.handle("GET", "/api/pull", [&](const dhttp::Request& req) {
@@ -67,6 +84,7 @@ int main(int argc, char** argv) {
     return dhttp::Response{200, "application/json", executor.pull(offset).dump()};
   });
   server.handle("POST", "/api/stop", [&](const dhttp::Request& req) {
+    trace_log(req, "POST /api/stop");
     bool abort = false;
     if (!req.body.empty()) abort = dj::Json::parse(req.body)["abort"].as_bool();
     return dhttp::Response{200, "application/json", executor.stop(abort).dump()};
@@ -78,6 +96,7 @@ int main(int argc, char** argv) {
   // workload's telemetry emitter polls; the trace artifact path comes back in
   // the response and in the workload's profile_end telemetry mark.
   server.handle("POST", "/api/profile", [&](const dhttp::Request& req) {
+    trace_log(req, "POST /api/profile");
     dj::Json body = req.body.empty() ? dj::Json::object() : dj::Json::parse(req.body);
     return dhttp::Response{200, "application/json", executor.profile(body).dump()};
   });

@@ -129,15 +129,34 @@ RunResult pump_until_terminal(drunner::Executor& ex, int timeout_ms = 90000,
   return r;
 }
 
+// The agent appends one kind="host" hardware sample (cpu/mem/net from /proc)
+// to EVERY metrics response — the last workload element. Returns the sidecar
+// points only (everything before it) after validating the host point.
+static dj::Json sidecar_points(const dj::Json& m) {
+  const dj::Json& workload = m["workload"];
+  CHECK(!workload.is_null());
+  size_t n = workload.as_array().size();
+  CHECK(n >= 1);
+  const dj::Json& host = workload.as_array()[n - 1];
+  CHECK_EQ(host["kind"].as_string(), std::string("host"));
+  CHECK(!host["ts"].as_string().empty());
+  CHECK(!host["host"].as_string().empty());          // hostname
+  CHECK(host["mem_total_bytes"].as_int() > 0);       // /proc/meminfo parsed
+  dj::Json rest = dj::Json::array();
+  for (size_t i = 0; i + 1 < n; ++i) rest.push_back(workload.as_array()[i]);
+  return rest;
+}
+
 void test_telemetry_tail() {
   // The workload->agent sidecar protocol: complete JSONL lines ride the
   // metrics sample exactly once; partial lines wait; corrupt lines skip.
+  // Every sample additionally carries the agent's own host hardware point.
   std::string dir = temp_dir();
   drunner::Executor ex(dir);
   std::string tfile = dir + "/telemetry/workload.jsonl";
 
   dj::Json m = ex.metrics();
-  CHECK(m["workload"].is_null());  // no sidecar yet
+  CHECK_EQ(sidecar_points(m).as_array().size(), static_cast<size_t>(0));  // no sidecar yet
 
   {
     std::ofstream f(tfile, std::ios::app);
@@ -145,8 +164,9 @@ void test_telemetry_tail() {
     f << "{\"kind\": \"ma";  // a line mid-append — must NOT be consumed
   }
   m = ex.metrics();
-  CHECK_EQ(m["workload"].as_array().size(), static_cast<size_t>(1));
-  CHECK_EQ(m["workload"].as_array()[0]["kind"].as_string(), std::string("step"));
+  dj::Json pts = sidecar_points(m);
+  CHECK_EQ(pts.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(pts.as_array()[0]["kind"].as_string(), std::string("step"));
 
   {
     std::ofstream f(tfile, std::ios::app);
@@ -155,12 +175,13 @@ void test_telemetry_tail() {
     f << "{\"kind\": \"engine\", \"queue_depth\": 3}\n";
   }
   m = ex.metrics();
-  CHECK_EQ(m["workload"].as_array().size(), static_cast<size_t>(2));
-  CHECK_EQ(m["workload"].as_array()[0]["event"].as_string(), std::string("compile_end"));
-  CHECK_EQ(m["workload"].as_array()[1]["queue_depth"].as_int(), static_cast<int64_t>(3));
+  pts = sidecar_points(m);
+  CHECK_EQ(pts.as_array().size(), static_cast<size_t>(2));
+  CHECK_EQ(pts.as_array()[0]["event"].as_string(), std::string("compile_end"));
+  CHECK_EQ(pts.as_array()[1]["queue_depth"].as_int(), static_cast<int64_t>(3));
 
-  m = ex.metrics();  // nothing new -> no workload key
-  CHECK(m["workload"].is_null());
+  m = ex.metrics();  // nothing new -> host sample only
+  CHECK_EQ(sidecar_points(m).as_array().size(), static_cast<size_t>(0));
 
   // A single line larger than the per-sample window (a job writing junk to
   // the sidecar path) must be skipped, not wedge the tail forever.
@@ -169,14 +190,15 @@ void test_telemetry_tail() {
     f << std::string(300 * 1024, 'x');  // 300KiB, no newline yet
   }
   m = ex.metrics();
-  CHECK(m["workload"].is_null());  // window full, no newline -> skipped
+  CHECK_EQ(sidecar_points(m).as_array().size(), static_cast<size_t>(0));  // window full, no newline -> skipped
   {
     std::ofstream f(tfile, std::ios::app);
     f << "\n{\"kind\": \"step\", \"step\": 9}\n";
   }
   m = ex.metrics();  // remnant of the junk line parses as garbage and skips;
-  CHECK_EQ(m["workload"].as_array().size(), static_cast<size_t>(1));
-  CHECK_EQ(m["workload"].as_array()[0]["step"].as_int(), static_cast<int64_t>(9));
+  pts = sidecar_points(m);
+  CHECK_EQ(pts.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(pts.as_array()[0]["step"].as_int(), static_cast<int64_t>(9));
 }
 
 void test_profile_control_file() {

@@ -1,0 +1,803 @@
+"""Gang-wide health (ISSUE 15): the straggler rule as a pure function (skew
+matrix, hysteresis, gang shrink, single-host), window summarization, the
+collection-pass flow (run_events + /metrics families through the strict
+exposition parser + the per-host API), and the PR 11 lead-only invariants
+that must SURVIVE the per-host join (goodput ledger and step histogram still
+count one lineage, not N hosts)."""
+
+import datetime
+import json
+
+import pytest
+
+from dstack_tpu.server.services import gang_health
+from dstack_tpu.server.services import metrics as metrics_service
+from dstack_tpu.server.services.gang_health import (
+    HostStats,
+    RunState,
+    evaluate_stragglers,
+    summarize_host,
+)
+from dstack_tpu.utils.common import now_utc, to_iso
+from tests.common import api_server
+from tests.test_run_events import parse_exposition
+from tests.test_workload_telemetry import _insert_running_job
+
+
+def _iso(base, off: float) -> str:
+    return to_iso(base + datetime.timedelta(seconds=off))
+
+
+def _hosts(medians: dict) -> list:
+    return [HostStats(host=h, median_step_s=m, steps=5) for h, m in medians.items()]
+
+
+HEALTHY = {"h0": 1.0, "h1": 1.02, "h2": 0.98, "h3": 1.01}
+SKEWED = {"h0": 1.0, "h1": 1.02, "h2": 0.98, "h3": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# The pure rule
+
+
+class TestStragglerRule:
+    def test_skew_matrix_and_flag_after_m_windows(self):
+        state = RunState()
+        v1 = evaluate_stragglers(_hosts(SKEWED), state, k=1.5, clear_k=1.2, windows=2)
+        # Skew math: gang median is the median of host medians; h3 is slowest.
+        assert v1.slowest_host == "h3"
+        # gang median = median(0.98, 1.0, 1.02, 2.0) = 1.01
+        assert v1.skew_ratio == pytest.approx(2.0 / 1.01, rel=1e-3)
+        assert v1.detected == [] and v1.cleared == []  # window 1 of 2
+        assert state.over["h3"] == 1
+        v2 = evaluate_stragglers(_hosts(SKEWED), state, k=1.5, clear_k=1.2, windows=2)
+        assert [h for h, _ in v2.detected] == ["h3"]
+        assert "h3" in v2.detected[0][1]  # message names the host
+        assert state.flagged == {"h3"}
+        # Already flagged: no duplicate event on the next window.
+        v3 = evaluate_stragglers(_hosts(SKEWED), state, k=1.5, clear_k=1.2, windows=2)
+        assert v3.detected == [] and v3.cleared == []
+
+    def test_healthy_gang_never_flags(self):
+        state = RunState()
+        for _ in range(10):
+            v = evaluate_stragglers(
+                _hosts(HEALTHY), state, k=1.5, clear_k=1.2, windows=2
+            )
+            assert v.detected == [] and v.cleared == []
+        assert not state.flagged
+        assert v.skew_ratio == pytest.approx(1.02 / 1.005, rel=1e-3)
+
+    def test_flapping_host_never_flags(self):
+        """Alternating over/under the flag threshold resets the counter each
+        healthy window — hysteresis means no event spam from a flapper."""
+        state = RunState()
+        for i in range(12):
+            medians = dict(HEALTHY, h3=2.0 if i % 2 == 0 else 1.0)
+            v = evaluate_stragglers(
+                _hosts(medians), state, k=1.5, clear_k=1.2, windows=2
+            )
+            assert v.detected == [], f"window {i} flagged a flapper"
+        assert not state.flagged
+
+    def test_clear_needs_consecutive_windows_below_clear_threshold(self):
+        state = RunState(flagged={"h3"})
+        # Between clear_k (1.2) and k (1.5): stays flagged, emits nothing.
+        mid = dict(HEALTHY, h3=1.3)
+        v = evaluate_stragglers(_hosts(mid), state, k=1.5, clear_k=1.2, windows=2)
+        assert v.cleared == [] and state.flagged == {"h3"}
+        # One healthy window is not enough...
+        v = evaluate_stragglers(_hosts(HEALTHY), state, k=1.5, clear_k=1.2, windows=2)
+        assert v.cleared == [] and state.flagged == {"h3"}
+        # ...and a relapse resets the under-counter...
+        v = evaluate_stragglers(_hosts(mid), state, k=1.5, clear_k=1.2, windows=2)
+        assert state.under["h3"] == 0
+        # ...so clearing takes 2 consecutive healthy windows from here.
+        evaluate_stragglers(_hosts(HEALTHY), state, k=1.5, clear_k=1.2, windows=2)
+        v = evaluate_stragglers(_hosts(HEALTHY), state, k=1.5, clear_k=1.2, windows=2)
+        assert [h for h, _ in v.cleared] == ["h3"]
+        assert not state.flagged
+
+    def test_single_host_never_flags(self):
+        state = RunState()
+        for median in (1.0, 50.0, 0.001):
+            v = evaluate_stragglers(
+                _hosts({"h0": median}), state, k=1.5, clear_k=1.2, windows=1
+            )
+            assert v.detected == [] and v.skew_ratio is None
+        assert not state.flagged and not state.over
+
+    def test_gang_shrink_clears_departed_straggler(self):
+        """Elastic restart dropped the flagged host: the flag must clear
+        (reason: departed) and its counters must not linger."""
+        state = RunState(flagged={"h3"}, over={"h2": 1}, under={"h3": 1})
+        survivors = {h: m for h, m in HEALTHY.items() if h != "h3"}
+        v = evaluate_stragglers(_hosts(survivors), state, k=1.5, clear_k=1.2, windows=2)
+        assert [h for h, _ in v.cleared] == ["h3"]
+        assert "left the gang" in v.cleared[0][1]
+        assert not state.flagged and "h3" not in state.under
+        # h2 is still present AND healthy this window: its counter resets.
+        assert state.over.get("h2") == 0
+
+    def test_collection_gap_freezes_counters(self):
+        """A window where <2 hosts reported steps must not decay progress
+        toward a flag (or toward a clear) — counters freeze until data
+        returns."""
+        state = RunState()
+        evaluate_stragglers(_hosts(SKEWED), state, k=1.5, clear_k=1.2, windows=2)
+        assert state.over["h3"] == 1
+        gap = [HostStats(host=h, median_step_s=None) for h in SKEWED]
+        v = evaluate_stragglers(gap, state, k=1.5, clear_k=1.2, windows=2)
+        assert v.skew_ratio is None and state.over["h3"] == 1
+        v = evaluate_stragglers(_hosts(SKEWED), state, k=1.5, clear_k=1.2, windows=2)
+        assert [h for h, _ in v.detected] == ["h3"]
+
+    def test_two_host_gang_flags_against_pair_median(self):
+        state = RunState()
+        for _ in range(2):
+            v = evaluate_stragglers(
+                _hosts({"h0": 1.0, "h1": 4.0}), state, k=1.5, clear_k=1.2, windows=2
+            )
+        # median of (1.0, 4.0) = 2.5; 4.0/2.5 = 1.6 > 1.5 -> flags.
+        assert [h for h, _ in v.detected] == ["h1"]
+
+
+class TestSummarize:
+    def test_summarize_host_window(self):
+        points = [
+            {"kind": "step", "step": 10, "step_time_s": 1.0,
+             "collective_wait_s": 0.2, "input_wait_s": 0.1, "ts": "t1"},
+            {"kind": "step", "step": 11, "step_time_s": 3.0,
+             "collective_wait_s": 0.4, "mfu": 0.41, "ts": "t2"},
+            {"kind": "step", "step": 12, "step_time_s": 2.0, "ts": "t3"},
+            {"kind": "host", "cpu_percent": 73.5, "mem_used_bytes": 2 ** 30},
+            {"kind": "step", "step": "junk", "step_time_s": "junk"},
+        ]
+        s = summarize_host("hX", points)
+        assert s.median_step_s == 2.0
+        assert s.last_step == 12
+        assert s.steps == 3
+        assert s.collective_wait_s == pytest.approx(0.3)
+        assert s.input_wait_s == pytest.approx(0.1)
+        assert s.mfu == 0.41
+        assert s.cpu_percent == 73.5
+        assert s.mem_bytes == 2 ** 30
+        assert s.last_ts == "t3"
+
+    def test_summarize_empty(self):
+        s = summarize_host("hX", [])
+        assert s.median_step_s is None and s.steps == 0
+
+
+# ---------------------------------------------------------------------------
+# The collection-pass flow: DB -> rule -> run_events -> /metrics -> API
+
+
+async def _store_gang_window(db, job_ids, base, slow_job=None, slow_factor=2.0,
+                             steps=5, start_step=1):
+    """One window of step points for each job: job_ids[i] emits as host{i};
+    slow_job's step times are slow_factor x. Also one host-hardware point per
+    job (the agent's kind="host" sample)."""
+    for i, jid in enumerate(job_ids):
+        job = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (jid,))
+        step_time = 0.1 * (slow_factor if jid == slow_job else 1.0)
+        points = [
+            {"ts": _iso(base, s * 0.1), "kind": "step", "host": f"host{i}",
+             "step": start_step + s, "step_time_s": step_time,
+             "collective_wait_s": 0.001 if jid == slow_job else 0.05,
+             "input_wait_s": 0.01, "mfu": 0.3}
+            for s in range(steps)
+        ] + [
+            {"ts": _iso(base, steps * 0.1), "kind": "host", "host": f"host{i}",
+             "cpu_percent": 50.0 + i, "mem_used_bytes": (i + 1) * 2 ** 30},
+        ]
+        await metrics_service.store_workload_points(db, job, points)
+
+
+class TestGangHealthPass:
+    @pytest.fixture(autouse=True)
+    def _fresh_state(self):
+        gang_health.reset()
+        yield
+        gang_health.reset()
+
+    async def _gang(self, api, n=4, run_id="gg", run_name="gang-run"):
+        proj = await api.db.fetchone("SELECT * FROM projects")
+        job_ids = []
+        for i in range(n):
+            jid = f"{run_id}-j{i}"
+            await _insert_running_job(
+                api.db, proj, run_id, jid, run_name=run_name, job_num=i, jpd=False
+            )
+            job_ids.append(jid)
+        return job_ids
+
+    async def test_straggler_detected_within_two_passes_and_cleared(self):
+        async with api_server() as api:
+            job_ids = await self._gang(api)
+            base = now_utc()
+            # Two windows of skewed data -> flag on the SECOND pass (the
+            # acceptance criterion: detection within 2 collection passes).
+            await _store_gang_window(api.db, job_ids, base, slow_job=job_ids[3])
+            await gang_health.check_gang_health(api.db)
+            events = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+            )
+            assert events == []
+            await _store_gang_window(
+                api.db, job_ids, base, slow_job=job_ids[3], start_step=6
+            )
+            await gang_health.check_gang_health(api.db)
+            events = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+            )
+            assert len(events) == 1
+            assert events[0]["reason"] == "host3"  # attribution: the right host
+            assert events[0]["actor"] == "gang_health"
+            assert "host3" in events[0]["message"]
+
+            # /metrics: every new family renders, parses strictly, and the
+            # straggler gauge is 1 for host3 and 0 for the healthy hosts.
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            straggler = {
+                l["host"]: v
+                for _, l, v in families["dstack_tpu_run_straggler"]["samples"]
+                if l.get("run") == "gang-run"
+            }
+            assert straggler == {"host0": 0.0, "host1": 0.0, "host2": 0.0, "host3": 1.0}
+            skew = [
+                v for _, l, v in families["dstack_tpu_run_step_skew_ratio"]["samples"]
+                if l.get("run") == "gang-run"
+            ]
+            assert skew and skew[0] == pytest.approx(2.0, rel=0.01)
+            cpu = {
+                l["host"]: v
+                for _, l, v in families["dstack_tpu_host_cpu_percent"]["samples"]
+                if l.get("run") == "gang-run"
+            }
+            assert cpu["host0"] == 50.0 and cpu["host3"] == 53.0
+            mem = {
+                l["host"]: v
+                for _, l, v in families["dstack_tpu_host_mem_bytes"]["samples"]
+                if l.get("run") == "gang-run"
+            }
+            # %g exposition formatting keeps 6 significant digits.
+            assert mem["host1"] == pytest.approx(2 * 2 ** 30, rel=1e-5)
+            coll = {
+                l["host"]: v
+                for _, l, v in
+                families["dstack_tpu_host_collective_wait_seconds"]["samples"]
+                if l.get("run") == "gang-run"
+            }
+            # The victims wait on the fence; the straggler barely does.
+            assert coll["host0"] > coll["host3"]
+
+            # The API per-host table agrees with the gauge and the event.
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "gang-run"}
+            )
+            assert [h["host"] for h in res["hosts"]] == [
+                "host0", "host1", "host2", "host3",
+            ]
+            flags = {h["host"]: h["straggler"] for h in res["hosts"]}
+            assert flags == {"host0": False, "host1": False, "host2": False,
+                             "host3": True}
+            assert res["skew"]["slowest_host"] == "host3"
+            assert res["skew"]["ratio"] == pytest.approx(2.0, rel=0.01)
+            assert res["stragglers"] == ["host3"]
+            h3 = res["hosts"][3]
+            assert h3["median_step_s"] == pytest.approx(0.2)
+            assert h3["last_step"] == 10
+            assert h3["cpu_percent"] == 53.0
+
+            # Recovery: the trailing window still holds the bad steps, so
+            # enough healthy steps must land to pull the median back under
+            # the clear threshold — then two consecutive healthy windows
+            # emit straggler_cleared and zero the gauge.
+            for start in (11, 41):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=None, start_step=start,
+                    steps=30,
+                )
+                await gang_health.check_gang_health(api.db)
+            cleared = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_cleared'"
+            )
+            assert len(cleared) == 1 and cleared[0]["reason"] == "host3"
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            straggler = {
+                l["host"]: v
+                for _, l, v in families["dstack_tpu_run_straggler"]["samples"]
+                if l.get("run") == "gang-run"
+            }
+            assert straggler["host3"] == 0.0
+
+    async def test_single_host_run_never_flags_but_gets_host_row(self):
+        async with api_server() as api:
+            job_ids = await self._gang(api, n=1, run_id="solo", run_name="solo-run")
+            for start in (1, 6, 11):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=job_ids[0], start_step=start
+                )
+                await gang_health.check_gang_health(api.db)
+            events = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status LIKE 'straggler%'"
+            )
+            assert events == []
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "solo-run"}
+            )
+            assert len(res["hosts"]) == 1 and res["skew"] is None
+            assert res["hosts"][0]["straggler"] is False
+
+    async def test_gang_shrink_mid_run_clears_via_elastic_restart(self):
+        """The flagged host's job leaves the running set (elastic restart onto
+        fewer hosts): the next pass clears the flag with a departed event."""
+        async with api_server() as api:
+            job_ids = await self._gang(api, run_id="sh", run_name="shrink-run")
+            for start in (1, 6):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=job_ids[3], start_step=start
+                )
+                await gang_health.check_gang_health(api.db)
+            detected = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+            )
+            assert len(detected) == 1
+            # The gang shrinks: host3's job is gone.
+            await api.db.execute(
+                "UPDATE jobs SET status = 'failed' WHERE id = ?", (job_ids[3],)
+            )
+            await _store_gang_window(
+                api.db, job_ids[:3], now_utc(), slow_job=None, start_step=11
+            )
+            await gang_health.check_gang_health(api.db)
+            cleared = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_cleared'"
+            )
+            assert len(cleared) == 1
+            assert cleared[0]["reason"] == "host3"
+            assert "left the gang" in cleared[0]["message"]
+
+    async def test_emitter_counters_surface_as_run_counter(self):
+        """Satellite: the emitter's own drop/flush-failure counters become
+        per-run /metrics counters (summed across the gang's hosts)."""
+        async with api_server() as api:
+            job_ids = await self._gang(api, n=2, run_id="dr", run_name="drop-run")
+            base = now_utc()
+            await _store_gang_window(api.db, job_ids, base)
+            for i, (jid, dropped) in enumerate(zip(job_ids, (7, 4))):
+                job = await api.db.fetchone("SELECT * FROM jobs WHERE id = ?", (jid,))
+                await metrics_service.store_workload_points(api.db, job, [
+                    {"ts": _iso(base, 1), "kind": "emitter", "dropped": dropped - 1,
+                     "write_errors": 0},
+                    {"ts": _iso(base, 2), "kind": "emitter", "dropped": dropped,
+                     "write_errors": i},
+                ])
+            await gang_health.check_gang_health(api.db)
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            dropped = {
+                l["run"]: v
+                for _, l, v in
+                families["dstack_tpu_run_telemetry_dropped_points_total"]["samples"]
+            }
+            # Cumulative per job (max of each stream), summed across hosts.
+            assert dropped["drop-run"] == 11.0
+            werr = {
+                l["run"]: v
+                for _, l, v in
+                families["dstack_tpu_run_telemetry_write_errors_total"]["samples"]
+            }
+            assert werr["drop-run"] == 1.0
+
+    async def test_run_delete_forgets_state_and_families_render_empty(self):
+        async with api_server() as api:
+            job_ids = await self._gang(api, run_id="del", run_name="del-run")
+            for start in (1, 6):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=job_ids[3], start_step=start
+                )
+                await gang_health.check_gang_health(api.db)
+            assert gang_health.state_for("del").flagged == {"host3"}
+            for status in ("jobs", "runs"):
+                await api.db.execute(f"UPDATE {status} SET status = 'done'")
+            await api.post("/api/project/main/runs/delete", {"runs_names": ["del-run"]})
+            assert "del" not in gang_health._states
+            # The snapshot self-heals on the next pass; the families still
+            # advertise HELP/TYPE with zero samples (cold-server discovery).
+            await gang_health.check_gang_health(api.db)
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            for fam in (
+                "dstack_tpu_run_step_skew_ratio",
+                "dstack_tpu_run_straggler",
+                "dstack_tpu_host_cpu_percent",
+                "dstack_tpu_host_mem_bytes",
+                "dstack_tpu_host_collective_wait_seconds",
+                "dstack_tpu_run_telemetry_dropped_points_total",
+                "dstack_tpu_run_telemetry_write_errors_total",
+            ):
+                assert families[fam]["samples"] == [], fam
+
+    async def test_lead_only_invariants_survive_the_per_host_join(self):
+        """PR 11's contract: a 4-host gang must NOT multiply the goodput
+        ledger or the step histogram, even though gang health now reads all
+        four streams."""
+        async with api_server() as api:
+            job_ids = await self._gang(api, run_id="inv", run_name="inv-run")
+            await _store_gang_window(api.db, job_ids, now_utc(), steps=6)
+            await gang_health.check_gang_health(api.db)
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "inv-run"}
+            )
+            # Ledger: 6 lead steps at 0.1s, not 24.
+            assert res["goodput"]["steps"] == 6
+            assert res["goodput"]["productive_s"] <= 6 * 0.1 + 1e-6
+            assert len(res["hosts"]) == 4  # while the per-host view sees all
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            counts = [
+                v for nm, l, v in families["dstack_tpu_run_step_seconds"]["samples"]
+                if nm.endswith("_count") and l.get("run") == "inv-run"
+            ]
+            assert counts == [6.0]
+
+
+class TestReviewHardening:
+    """Regression pins for the review findings: lease scoping, durable flag
+    continuity across restart/handoff, monotonic loss counters."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_state(self):
+        gang_health.reset()
+        yield
+        gang_health.reset()
+
+    async def test_pass_skips_runs_leased_to_another_replica(self):
+        from dstack_tpu.server.services import leases
+
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            job_ids = []
+            for i in range(2):
+                jid = f"ls-j{i}"
+                await _insert_running_job(
+                    api.db, proj, "ls", jid, run_name="leased-run", job_num=i,
+                    jpd=False,
+                )
+                job_ids.append(jid)
+            for start in (1, 6):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=job_ids[1],
+                    slow_factor=4.0, start_step=start,
+                )
+            # Another replica owns the run's lease: this replica's pass must
+            # not advance the detector or emit events for it.
+            with leases.as_replica("replica-other"):
+                await leases.claim_runs(api.db, ["ls"])
+            examined = await gang_health.check_gang_health(api.db)
+            await gang_health.check_gang_health(api.db)
+            assert examined == 0
+            events = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status LIKE 'straggler%'"
+            )
+            assert events == [] and "ls" not in gang_health._states
+            # The owner processes it.
+            with leases.as_replica("replica-other"):
+                await gang_health.check_gang_health(api.db)
+                await gang_health.check_gang_health(api.db)
+            events = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+            )
+            assert len(events) == 1
+
+    async def test_restart_seeds_flags_from_events_no_duplicate_detect(self):
+        async with api_server() as api:
+            job_ids = await TestGangHealthPass._gang(
+                TestGangHealthPass(), api, run_id="rs", run_name="restart-run"
+            )
+            for start in (1, 6):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=job_ids[3],
+                    start_step=start,
+                )
+                await gang_health.check_gang_health(api.db)
+            detected = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+            )
+            assert len(detected) == 1
+            # Server restart: in-process state is gone, the skew persists.
+            gang_health.reset()
+            for start in (11, 16):
+                await _store_gang_window(
+                    api.db, job_ids, now_utc(), slow_job=job_ids[3],
+                    start_step=start,
+                )
+                await gang_health.check_gang_health(api.db)
+            detected = await api.db.fetchall(
+                "SELECT * FROM run_events WHERE new_status = 'straggler_detected'"
+            )
+            assert len(detected) == 1, "restart re-raised an already-flagged host"
+            # A state-less replica answers the API from the durable timeline.
+            gang_health.reset()
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "restart-run"}
+            )
+            assert res["stragglers"] == ["host3"]
+
+    async def test_loss_counters_never_decrease(self):
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(
+                api.db, proj, "mono", "mono-j0", run_name="mono-run", jpd=False
+            )
+            base = now_utc()
+            job = await api.db.fetchone("SELECT * FROM jobs WHERE id = 'mono-j0'")
+            await metrics_service.store_workload_points(api.db, job, [
+                {"ts": _iso(base, 0), "kind": "step", "step": 1, "step_time_s": 0.1},
+                {"ts": _iso(base, 1), "kind": "emitter", "dropped": 9,
+                 "write_errors": 2},
+            ])
+            await gang_health.check_gang_health(api.db)
+            entry = next(e for e in gang_health.snapshot() if e["run"] == "mono-run")
+            assert entry["dropped"] == 9 and entry["write_errors"] == 2
+            # The emitter rows age out of the window / a fresh emitter
+            # restarts at zero: the exported counter must hold its mark.
+            await api.db.execute(
+                "DELETE FROM workload_metrics_points WHERE kind = 'emitter'"
+            )
+            await metrics_service.store_workload_points(api.db, job, [
+                {"ts": _iso(base, 2), "kind": "emitter", "dropped": 1,
+                 "write_errors": 0},
+            ])
+            await gang_health.check_gang_health(api.db)
+            entry = next(e for e in gang_health.snapshot() if e["run"] == "mono-run")
+            assert entry["dropped"] == 9 and entry["write_errors"] == 2
+
+    def test_identity_proc_falls_back_past_unparsable_var(self, monkeypatch):
+        from dstack_tpu.workloads.telemetry import _host_identity
+
+        monkeypatch.setenv("TPU_WORKER_ID", "worker-3")  # non-numeric launcher form
+        monkeypatch.setenv("DSTACK_NODE_RANK", "3")
+        assert _host_identity()["proc"] == 3
+
+    async def test_agent_host_points_do_not_contaminate_goodput(self):
+        """The agent appends a kind="host" point to EVERY sample — including
+        before the workload's run_start and during a preemption's downtime.
+        The ledger must read step/mark kinds only: a host point ahead of
+        run_start would bill pull/startup as restart_s, and host points in a
+        real restart gap would erase the restart_s PR 12 measures."""
+        async with api_server() as api:
+            proj = await api.db.fetchone("SELECT * FROM projects")
+            await _insert_running_job(
+                api.db, proj, "gp", "gp-j0", run_name="gp-run", jpd=False
+            )
+            base = now_utc() - datetime.timedelta(seconds=60)
+            job = await api.db.fetchone("SELECT * FROM jobs WHERE id = 'gp-j0'")
+            await metrics_service.store_workload_points(api.db, job, [
+                # Agent samples land 15s before the workload starts...
+                {"ts": _iso(base, 0), "kind": "host", "host": "h", "cpu_percent": 1},
+                {"ts": _iso(base, 15), "kind": "mark", "event": "run_start"},
+                {"ts": _iso(base, 16), "kind": "step", "step": 1, "step_time_s": 1.0},
+                # ...and keep landing inside a 20s restart gap.
+                {"ts": _iso(base, 26), "kind": "host", "host": "h", "cpu_percent": 1},
+                {"ts": _iso(base, 36), "kind": "mark", "event": "restart", "step": 1},
+                {"ts": _iso(base, 37), "kind": "step", "step": 2, "step_time_s": 1.0},
+            ])
+            res = await api.post(
+                "/api/project/main/runs/get_metrics", {"run_name": "gp-run"}
+            )
+            ledger = res["goodput"]
+            # Wall = run_start..last step (21s), restart gap = 20s; the host
+            # points must neither stretch the wall to the first agent sample
+            # nor split the restart gap.
+            assert ledger["wall_s"] == pytest.approx(22.0, abs=0.1)
+            assert ledger["restart_s"] == pytest.approx(20.0, abs=0.1)
+            assert ledger["productive_s"] == pytest.approx(2.0, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: per-host table, `top`, and the --json satellite
+
+
+async def _run_cli(api, argv) -> str:
+    """Run the real CLI (argparse + sync requests client) against the
+    in-process test server, off the event loop."""
+    import asyncio
+    import contextlib
+    import io
+
+    from dstack_tpu.api.client import Client
+    from dstack_tpu.cli import main as cli_main
+
+    url = str(api.client.make_url("")).rstrip("/")
+    client = Client(url, api.token, project="main")
+
+    def _run() -> str:
+        old = cli_main._client
+        cli_main._client = lambda: client
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                cli_main.main(argv)
+            return buf.getvalue()
+        finally:
+            cli_main._client = old
+
+    return await asyncio.get_event_loop().run_in_executor(None, _run)
+
+
+class TestCliSurfaces:
+    @pytest.fixture(autouse=True)
+    def _fresh_state(self):
+        gang_health.reset()
+        yield
+        gang_health.reset()
+
+    async def test_top_json_flags_and_tables(self):
+        from dstack_tpu.server.background import tasks
+        from tests.common import (
+            FakeRunnerClient,
+            drive,
+            setup_mock_backend,
+            tpu_task_spec,
+        )
+
+        class HoldAgent(FakeRunnerClient):
+            def default_script(self):
+                return [{"job_states": [{"state": "running"}], "logs": [], "offset": 1}]
+
+        HoldAgent.reset()
+        real = tasks.get_runner_client
+        tasks.get_runner_client = HoldAgent.for_jpd
+        try:
+            async with api_server() as api:
+                await setup_mock_backend(api)
+                await api.post(
+                    "/api/project/main/runs/submit", tpu_task_spec("cli-gang", "v5e-32")
+                )
+                await drive(api.db)
+                rows = await api.db.fetchall(
+                    "SELECT id FROM jobs WHERE status = 'running' ORDER BY job_num"
+                )
+                job_ids = [r["id"] for r in rows]
+                assert len(job_ids) == 4
+                for start in (1, 6):
+                    await _store_gang_window(
+                        api.db, job_ids, now_utc(), slow_job=job_ids[3],
+                        start_step=start,
+                    )
+                    await gang_health.check_gang_health(api.db)
+
+                top = await _run_cli(api, ["top", "--once"])
+                for needle in ("RUN", "HOST", "SKEW", "cli-gang", "host3", "STRAGGLER"):
+                    assert needle in top, f"top missing {needle!r}:\n{top}"
+
+                mjson = json.loads(await _run_cli(api, ["metrics", "cli-gang", "--json"]))
+                assert mjson["stragglers"] == ["host3"]
+                assert mjson["skew"]["slowest_host"] == "host3"
+                assert [h["host"] for h in mjson["hosts"]] == [
+                    "host0", "host1", "host2", "host3",
+                ]
+                assert "job_metrics" in mjson
+
+                ejson = json.loads(await _run_cli(api, ["events", "cli-gang", "--json"]))
+                kinds = [e["new_status"] for e in ejson["events"]]
+                assert "straggler_detected" in kinds
+                assert ejson["phases"]["queue"] is not None
+        finally:
+            tasks.get_runner_client = real
+
+
+# ---------------------------------------------------------------------------
+# Trace-id propagation (satellite: server trace -> agent log)
+
+
+class TestTracePropagation:
+    async def test_runner_client_sends_trace_id_header(self):
+        """Every runner call carries the scheduler's current trace id."""
+        from aiohttp import web
+
+        from dstack_tpu.core import tracing
+        from dstack_tpu.server.services.runner.client import RunnerClient
+
+        seen = {}
+
+        async def handler(request):
+            seen["trace"] = request.headers.get("X-Dstack-Trace-Id")
+            return web.json_response({"timestamp": "t"})
+
+        app = web.Application()
+        app.router.add_get("/api/metrics", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            client = RunnerClient("127.0.0.1", port)
+            with tracing.span("collect"):
+                tid = tracing.current_trace_id()
+                await client.metrics()
+            assert tid and seen["trace"] == tid
+        finally:
+            await runner.cleanup()
+
+    async def test_agent_echoes_trace_id_into_its_log(self, tmp_path):
+        """The C++ agent logs `[trace <id>] POST /api/submit` — a run_event's
+        trace_id greps straight into the agent log on the host."""
+        import asyncio
+        import subprocess
+
+        import aiohttp
+
+        from dstack_tpu.utils.runner_binary import find_runner_binary
+
+        binary = find_runner_binary()
+        if not binary:
+            pytest.skip("native agent unavailable")
+        proc = subprocess.Popen(
+            [binary, "--port", "0", "--base-dir", str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, bufsize=1,
+        )
+        loop = asyncio.get_event_loop()
+        try:
+            first = await asyncio.wait_for(
+                loop.run_in_executor(None, proc.stdout.readline), 15
+            )
+            port = int(first.strip().rsplit(":", 1)[1])
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/submit",
+                    json={"job_spec": {"job_name": "t"}, "cluster_info": {},
+                          "run_spec": {}, "secrets": {}},
+                    headers={"X-Dstack-Trace-Id": "tr4ce1d"},
+                ) as resp:
+                    assert resp.status == 200
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, proc.stdout.readline), 15
+            )
+            assert "[trace tr4ce1d] POST /api/submit" in line, line
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# Emitter identity (the workload end of per-host attribution)
+
+
+class TestEmitterIdentity:
+    def test_points_carry_host_identity(self, tmp_path, monkeypatch):
+        from dstack_tpu.workloads.telemetry import TelemetryEmitter
+
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+        em = TelemetryEmitter(str(tmp_path / "t.jsonl"), flush_interval=999)
+        try:
+            em.emit("step", step=1, step_time_s=0.5)
+            em.set_identity(proc=7)  # jax.process_index refinement wins
+            em.mark("run_end")
+            em.flush()
+        finally:
+            em.close()
+        lines = [
+            json.loads(l)
+            for l in (tmp_path / "t.jsonl").read_text().splitlines() if l
+        ]
+        step = next(p for p in lines if p["kind"] == "step")
+        assert step["proc"] == 3 and step["slice"] == 1 and step["host"]
+        end = next(p for p in lines if p.get("event") == "run_end")
+        assert end["proc"] == 7
+        # An explicit field beats the stamped identity.
+        em2 = TelemetryEmitter(str(tmp_path / "t2.jsonl"), flush_interval=999)
+        try:
+            em2.emit("step", step=2, host="override")
+            em2.flush()
+        finally:
+            em2.close()
+        p = json.loads((tmp_path / "t2.jsonl").read_text().splitlines()[0])
+        assert p["host"] == "override"
