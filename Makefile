@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos smoke-gang release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-routing bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos smoke-gang release publish clean
 
 all: runner wheel
 
@@ -63,6 +63,14 @@ bench-train:
 # decode (which FAILS the bench if it ever diverges from greedy).
 bench-serve:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_serve()))"
+
+# Fleet-routing bench: two in-process engine replicas behind the proxy's real
+# routing decision code (services/routing.choose), an 80%-shared-prefix mix
+# sized past one replica's page pool — cache-aware vs round-robin in paired
+# order-flipped rounds. One JSON line; value is the aggregate fleet tok/s
+# ratio (prefix over rr), extras carry fleet hit rates, TTFT p99, spill rate.
+bench-routing:
+	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_routing()))"
 
 # Kernel smoke: every in-repo Pallas kernel (flash fwd+bwd, paged decode),
 # the int8 quantized matmul, and the collective-matmul ring, in CPU interpret
@@ -128,10 +136,14 @@ smoke-observability:
 # the proxy, drives shared-prefix + speculative requests and asserts their
 # hit/accept ratios land on /metrics, then asserts the latency autoscaler
 # scales a service from zero (run_events carries the autoscaler actor +
-# cold-start histogram) and back. One JSON line; any missing piece is a
-# non-zero exit.
+# cold-start histogram) and back. Then two tp=2-SHARDED replicas (8 fake CPU
+# devices, disjoint pairs) serve shared-prefix traffic behind the cache-aware
+# router: asserts routing decision counters render on /metrics and the fleet
+# prefix hit rate beats a round-robin rerun of the same traffic. One JSON
+# line; any missing piece is a non-zero exit.
 smoke-serve:
-	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_serve()"
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -c "import bench; bench.smoke_serve()"
 
 release: runner wheel
 	@mkdir -p $(DIST)

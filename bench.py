@@ -669,9 +669,11 @@ def bench_scheduler() -> dict:
     }
 
 
-async def _seed_bench_service(db, run_name: str, replica_port: int) -> None:
-    """Insert a ready service run + running replica pointing at a local stub
-    (no cloud, no runner): the proxy's own overhead is what's measured."""
+async def _seed_bench_service(db, run_name: str, *replica_ports: int) -> None:
+    """Insert a ready service run + one running replica per port, each
+    pointing at a local stub (no cloud, no runner): the proxy's own overhead
+    is what's measured. Replicas are distinct job rows with job_num 0 — the
+    same shape ``list_service_replicas`` discovers in production."""
     import json
 
     proj = await db.fetchone("SELECT * FROM projects LIMIT 1")
@@ -689,27 +691,29 @@ async def _seed_bench_service(db, run_name: str, replica_port: int) -> None:
         " run_spec) VALUES (?, ?, ?, ?, '2026-01-01', 'running', ?)",
         (f"run-{run_name}", proj["id"], proj["owner_id"], run_name, json.dumps(run_spec)),
     )
-    job_spec = {
-        "job_name": f"{run_name}-0-0",
-        "image_name": "stub",
-        "requirements": {"resources": {}},
-        "service_port": 8000,
-    }
-    jpd = {
-        "backend": "local",  # direct endpoint: no SSH tunnel in the loop
-        "instance_type": {"name": "local", "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1}},
-        "instance_id": f"i-{run_name}",
-        "hostname": "127.0.0.1",
-        "region": "local",
-    }
-    jrd = {"ports_mapping": {"8000": replica_port}, "probe_ready": True}
-    await db.execute(
-        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, job_spec, status,"
-        " submitted_at, job_provisioning_data, job_runtime_data)"
-        " VALUES (?, ?, ?, ?, 0, ?, 'running', '2026-01-01', ?, ?)",
-        (f"job-{run_name}", proj["id"], f"run-{run_name}", run_name,
-         json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
-    )
+    for i, replica_port in enumerate(replica_ports):
+        job_spec = {
+            "job_name": f"{run_name}-0-{i}",
+            "image_name": "stub",
+            "requirements": {"resources": {}},
+            "service_port": 8000,
+        }
+        jpd = {
+            "backend": "local",  # direct endpoint: no SSH tunnel in the loop
+            "instance_type": {"name": "local", "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1}},
+            "instance_id": f"i-{run_name}-{i}" if i else f"i-{run_name}",
+            "hostname": "127.0.0.1",
+            "region": "local",
+        }
+        jrd = {"ports_mapping": {"8000": replica_port}, "probe_ready": True}
+        await db.execute(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, job_spec, status,"
+            " submitted_at, job_provisioning_data, job_runtime_data)"
+            " VALUES (?, ?, ?, ?, 0, ?, 'running', '2026-01-01', ?, ?)",
+            (f"job-{run_name}-{i}" if i else f"job-{run_name}", proj["id"],
+             f"run-{run_name}", run_name,
+             json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
+        )
 
 
 def bench_proxy() -> dict:
@@ -1698,6 +1702,214 @@ def _decode_itl_compare(cfg, params, steps: int = 12) -> dict:
     return out
 
 
+def _routing_schedule(
+    n_groups: int = 9, per_group: int = 8, seed: int = 23,
+    prefix_len: int = 128,
+) -> list:
+    """Arrival plan for the fleet-routing bench: 80% of requests belong to
+    one of `n_groups` prefix GROUPS (distinct `prefix_len`-token system
+    prompts, short unique suffixes, short generations — the prefill-dominated
+    regime), every 5th request is fully random. Group members arrive in
+    SHUFFLED waves (one request per group per wave, order re-drawn each wave
+    — a fixed wave order would hand a modulo cursor accidental per-parity
+    group affinity), so a round-robin fleet sends every group to every
+    replica. The group set is sized so ALL groups exceed one replica's page
+    pool while each replica's affinity share fits — the regime where
+    cache-aware routing makes fleet cache capacity additive and round-robin
+    LRU-thrashes (see _run_routing_variant's pool geometry)."""
+    import random
+
+    rng = random.Random(seed)
+    prefixes = [
+        [rng.randrange(1, 1024) for _ in range(prefix_len)]
+        for _ in range(n_groups)
+    ]
+    slots = []
+    for _wave in range(per_group):
+        wave = list(range(n_groups))
+        rng.shuffle(wave)
+        slots.extend(wave)
+    schedule, t = [], 0.0
+    for i, g in enumerate(slots):
+        t += rng.expovariate(1 / 0.004)
+        if i % 5 == 4:  # exactly 20% unshared traffic, deterministically
+            prompt = [rng.randrange(1, 1024) for _ in range(rng.randint(16, 32))]
+        else:
+            prompt = prefixes[g] + [
+                rng.randrange(1, 1024) for _ in range(rng.randint(2, 6))
+            ]
+        schedule.append((t, prompt, rng.randint(2, 4)))
+    return schedule
+
+
+def _run_routing_variant(
+    cfg, params, schedule, policy: str, n_replicas: int = 2
+) -> dict:
+    """Drive the open-loop schedule through N in-process engine replicas with
+    the proxy's ACTUAL routing decision code (services/routing.choose) picking
+    the replica per request — prefix-affinity vs round-robin differ only in
+    that call, exactly as in the server. Queue-depth feedback reaches the
+    router the same way production does (the X-Dstack-Queue-Depth value a
+    response would carry), so spill behavior is measured, not simulated."""
+    from dstack_tpu.server import settings as server_settings
+    from dstack_tpu.server.services import routing
+    from dstack_tpu.workloads import serve as serve_lib
+
+    # Pool geometry tuned against _routing_schedule: 9 groups x 8 prefix
+    # pages = 72 pages of fleet prefix working set vs 64 pages per replica —
+    # one replica cannot keep every group resident (round-robin LRU-thrashes),
+    # but an affinity share of ~5 groups (40 pages) plus active requests fits.
+    pool = dict(page_size=16, num_pages=64, max_batch=4, max_seq=192,
+                prefill_chunk=32, prefix_cache=True)
+    engines = [
+        serve_lib.ServeEngine(cfg, serve_lib.EngineConfig(**pool), params=params)
+        for _ in range(n_replicas)
+    ]
+    for eng in engines:
+        warm = eng.submit([1, 2, 3], max_new_tokens=2)
+        while not warm.done:
+            eng.step()
+    endpoints = [("bench-replica", 9000 + i) for i in range(n_replicas)]
+    by_ep = dict(zip(endpoints, engines))
+    run_id = run_name = "bench-routing"
+    routing.state.forget_run(run_id, run_name)
+    saved_policy = server_settings.PROXY_ROUTING_POLICY
+    server_settings.PROXY_ROUTING_POLICY = (
+        "prefix" if policy == "prefix" else "round_robin"
+    )
+    cursor = 0
+    arrivals, token_times, reqs = {}, {}, {}
+    try:
+        idx = 0
+        t0 = time.perf_counter()
+        first_arrival = schedule[0][0]
+        while idx < len(schedule) or any(e.has_work() for e in engines):
+            now = time.perf_counter() - t0
+            while idx < len(schedule) and schedule[idx][0] <= now:
+                arrival, prompt, max_new = schedule[idx]
+                body = json.dumps({"prompt_tokens": prompt}).encode()
+                ep = routing.choose(
+                    run_id, run_name, endpoints, endpoints,
+                    routing.prefix_key(body), cursor,
+                )
+                cursor += 1
+                req = by_ep[ep].submit(prompt, max_new_tokens=max_new)
+                arrivals[(ep, req.req_id)] = arrival
+                token_times[(ep, req.req_id)] = []
+                reqs[(ep, req.req_id)] = req
+                idx += 1
+            stepped = False
+            for ep, eng in zip(endpoints, engines):
+                if not eng.has_work():
+                    continue
+                events = eng.step()
+                t_emit = time.perf_counter() - t0
+                for ev in events:
+                    token_times[(ep, ev.req_id)].append(t_emit)
+                routing.state.record_queue_depth(run_id, ep, eng.queue_depth)
+                stepped = True
+            if not stepped and idx < len(schedule):
+                time.sleep(max(0.0, schedule[idx][0] - (time.perf_counter() - t0)))
+        t_end = time.perf_counter() - t0
+        decisions = routing.state.decisions_for(run_name)
+    finally:
+        server_settings.PROXY_ROUTING_POLICY = saved_policy
+        routing.state.forget_run(run_id, run_name)
+
+    from dstack_tpu.utils.common import nearest_rank
+
+    ttfts = sorted(
+        times[0] - arrivals[key] for key, times in token_times.items() if times
+    )
+    total_tokens = sum(len(t) for t in token_times.values())
+    assert all(r.done for r in reqs.values()), "routing bench left requests unfinished"
+    # FLEET hit rate from raw counts, not a mean of per-replica ratios — a
+    # replica that served two requests must not weigh as much as one that
+    # served twenty.
+    hits = sum(e.total_prefix_hit_tokens for e in engines)
+    lookups = sum(e.total_prefix_lookup_tokens for e in engines)
+    n_decisions = max(sum(decisions.values()), 1)
+    return {
+        "policy": policy,
+        "replicas": n_replicas,
+        "tokens_per_sec": round(total_tokens / max(t_end - first_arrival, 1e-9), 1),
+        "ttft_p50_ms": round(nearest_rank(ttfts, 0.50) * 1000, 1),
+        "ttft_p99_ms": round(nearest_rank(ttfts, 0.99) * 1000, 1),
+        "prefix_hit_rate": round(hits / max(lookups, 1), 4),
+        "requests_per_replica": [
+            sum(1 for (ep, _rid) in reqs if ep == e) for e in endpoints
+        ],
+        "spill_rate": round(
+            decisions.get(("prefix", "spilled"), 0) / n_decisions, 4
+        ),
+        "decisions": {
+            f"{pol}/{outcome}": n for (pol, outcome), n in sorted(decisions.items())
+        },
+    }
+
+
+def bench_routing() -> dict:
+    """`make bench-routing`: fleet-wide prefix-aware routing vs round-robin —
+    N in-process replicas (each with its private prefix cache) behind the
+    proxy's real routing decision code, an 80%-shared-prefix open-loop mix,
+    paired order-flipped rounds. Headline = aggregate fleet tok/s ratio; the
+    fleet prefix_hit_rate split shows WHY (affinity keeps each prefix group's
+    KV on one replica instead of re-prefilling it everywhere)."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import statistics
+
+    import jax
+
+    from dstack_tpu.workloads import model as model_lib
+
+    cfg = _serve_bench_config()
+    params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+    n_replicas = int(os.environ.get("DSTACK_TPU_BENCH_ROUTING_REPLICAS", "2"))
+    rounds = int(os.environ.get("DSTACK_TPU_BENCH_ROUTING_ROUNDS", "3"))
+    schedule = _routing_schedule()
+
+    # Rehearsal: compile every chunk/decode shape before measurement.
+    _run_routing_variant(cfg, params, schedule, "prefix", n_replicas)
+    _run_routing_variant(cfg, params, schedule, "round_robin", n_replicas)
+
+    prefix_rounds, rr_rounds, ratios = [], [], []
+    for i in range(rounds):
+        pair = {}
+        order = ("prefix", "round_robin") if i % 2 == 0 else ("round_robin", "prefix")
+        for policy in order:
+            pair[policy] = _run_routing_variant(
+                cfg, params, schedule, policy, n_replicas
+            )
+        prefix_rounds.append(pair["prefix"])
+        rr_rounds.append(pair["round_robin"])
+        ratios.append(
+            pair["prefix"]["tokens_per_sec"] / pair["round_robin"]["tokens_per_sec"]
+        )
+    mid = sorted(range(rounds), key=lambda i: ratios[i])[rounds // 2]
+    prefix, rr = prefix_rounds[mid], rr_rounds[mid]
+    return {
+        "metric": "routing_prefix_over_rr_tokens_per_sec",
+        "value": round(statistics.median(ratios), 2),
+        "unit": "x",
+        "vs_baseline": round(statistics.median(ratios), 2),
+        "extra": {
+            "replicas": n_replicas,
+            "rounds": rounds,
+            "requests": len(schedule),
+            "per_round_ratio": [round(r, 2) for r in ratios],
+            "prefix": prefix,
+            "round_robin": rr,
+            "fleet_hit_rate_prefix": prefix["prefix_hit_rate"],
+            "fleet_hit_rate_rr": rr["prefix_hit_rate"],
+            "spill_rate": prefix["spill_rate"],
+            "ttft_p99_ms_prefix": prefix["ttft_p99_ms"],
+            "ttft_p99_ms_rr": rr["ttft_p99_ms"],
+        },
+    }
+
+
 def bench_serve() -> dict:
     """`make bench-serve`: the continuous-batching engine under an open-loop
     synthetic load — continuous vs static batching plus a page-size sweep, PR 4
@@ -1779,6 +1991,29 @@ def bench_serve() -> dict:
         prefix_cache = _prefix_cache_compare(cfg, params)
     except Exception as e:  # noqa: BLE001
         prefix_cache = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # Fleet routing attribution (PR 16): cache-aware vs round-robin replica
+    # pick over two in-process replicas on the grouped shared-prefix mix.
+    # One warm pair here (first pair compiles + warms); `make bench-routing`
+    # runs the full paired order-flipped rounds.
+    try:
+        r_sched = _routing_schedule()
+        for policy in ("prefix", "round_robin"):
+            _run_routing_variant(cfg, params, r_sched, policy)
+        r_prefix = _run_routing_variant(cfg, params, r_sched, "prefix")
+        r_rr = _run_routing_variant(cfg, params, r_sched, "round_robin")
+        routing_extra = {
+            "speedup": round(
+                r_prefix["tokens_per_sec"] / max(r_rr["tokens_per_sec"], 1e-9), 2
+            ),
+            "fleet_hit_rate_prefix": r_prefix["prefix_hit_rate"],
+            "fleet_hit_rate_rr": r_rr["prefix_hit_rate"],
+            "spill_rate": r_prefix["spill_rate"],
+            "ttft_p99_ms_prefix": r_prefix["ttft_p99_ms"],
+            "ttft_p99_ms_rr": r_rr["ttft_p99_ms"],
+        }
+    except Exception as e:  # noqa: BLE001
+        routing_extra = {"error": f"{type(e).__name__}: {e}"[:200]}
     try:
         long_prompt_itl = _long_prompt_itl_compare(cfg, params)
     except Exception as e:  # noqa: BLE001
@@ -1804,6 +2039,7 @@ def bench_serve() -> dict:
             "decode_itl": decode_itl,
             "prefix_hit_rate": prefix_cache.get("prefix_hit_rate", 0.0),
             "spec_accept_rate": spec_decode["spec_accept_rate"],
+            "routing": routing_extra,
             "prefix_cache": prefix_cache,
             "long_prompt_itl": long_prompt_itl,
             "spec_decode": spec_decode,
@@ -2068,6 +2304,141 @@ def smoke_serve() -> dict:
                     "spec_accept_rate": round(engine.spec_accept_rate, 4),
                 }
 
+                # --- fleet: two tp=2-SHARDED replicas + cache-aware routing
+                # Two ServeEngines, each tensor-parallel over a DISJOINT pair
+                # of the 8 fake CPU devices, serve the same weights behind
+                # the real proxy. The same shared-prefix traffic runs twice —
+                # round_robin, then prefix — against fresh replicas each
+                # time: affinity pins every prefix group to one replica (one
+                # cold fill per group fleet-wide), rr cold-fills both, so the
+                # prefix pass must win on aggregate fleet hit rate. Routing
+                # decision counters must render on /metrics.
+                import random as _random
+
+                from dstack_tpu.server import settings as server_settings
+                from dstack_tpu.server.services import routing as routing_service
+                from dstack_tpu.workloads import sharding as sharding_lib
+
+                devices = jax.devices()
+                assert len(devices) >= 4, (
+                    "smoke-serve needs XLA_FLAGS=--xla_force_host_platform_"
+                    f"device_count=8 (got {len(devices)} devices)"
+                )
+                host_params = engine.params  # same weights on every replica
+                meshes = [
+                    sharding_lib.make_serve_mesh(2, devices=devices[0:2]),
+                    sharding_lib.make_serve_mesh(2, devices=devices[2:4]),
+                ]
+
+                async def _sharded_replica(mesh):
+                    eng = serve_lib.ServeEngine(
+                        cfg,
+                        serve_lib.EngineConfig(page_size=8, num_pages=64,
+                                               max_batch=4, max_seq=128,
+                                               prefix_cache=True,
+                                               prefill_chunk=16),
+                        params=host_params,
+                        mesh=mesh,
+                    )
+                    # Genuinely sharded, not replicated: each projection leaf
+                    # is split across the pair, the KV pages over heads.
+                    assert dict(mesh.shape) == {"dd": 1, "tp": 2}
+                    assert len(eng.k_pages.sharding.device_set) == 2
+                    rnr = serve_lib.EngineRunner(eng, idle_wait=0.01)
+                    rnr.start()
+                    arun = aioweb.AppRunner(serve_lib.create_serve_app(rnr))
+                    await arun.setup()
+                    fsite = aioweb.TCPSite(arun, "127.0.0.1", 0)
+                    await fsite.start()
+                    return eng, rnr, arun, fsite._server.sockets[0].getsockname()[1]
+
+                # 5 prefix groups x 9 full pages: longer than the router's
+                # 64-token prefix key window, so every request in a group
+                # hashes identically; 2-token unique suffixes + short
+                # generations keep the run prefill-dominated. Waves are
+                # shuffled so rr's cursor parity can't accidentally give it
+                # perfect affinity (the bench_routing lesson).
+                rng = _random.Random(5)
+                prefixes = [
+                    [((11 * g + 3 * i) % 500) + 1 for i in range(72)]
+                    for g in range(5)
+                ]
+                order = []
+                for _ in range(4):
+                    wave = list(range(5))
+                    rng.shuffle(wave)
+                    order.extend(wave)
+
+                async def _drive_fleet(run_name, port_a, port_b):
+                    await _seed_bench_service(api.db, run_name, port_a, port_b)
+                    furl = (
+                        f"http://127.0.0.1:{api.client.server.port}"
+                        f"/proxy/services/main/{run_name}/generate"
+                    )
+                    async with aiohttp.ClientSession() as session:
+                        for i, g in enumerate(order):
+                            prompt = prefixes[g] + [600 + 2 * i, 601 + 2 * i]
+                            async with session.post(
+                                furl,
+                                json={"prompt_tokens": prompt,
+                                      "max_tokens": 4, "stream": False},
+                            ) as resp:
+                                assert resp.status == 200, await resp.text()
+                                body = await resp.json()
+                                assert len(body["tokens"]) == 4
+
+                def _fleet_hit(engs):
+                    hits = sum(e.total_prefix_hit_tokens for e in engs)
+                    looks = sum(e.total_prefix_lookup_tokens for e in engs)
+                    return hits / max(1, looks)
+
+                saved_policy = server_settings.PROXY_ROUTING_POLICY
+                fleet_rates = {}
+                try:
+                    for policy, fname in (("round_robin", "smoke-fleet-rr"),
+                                          ("prefix", "smoke-fleet")):
+                        replicas = [await _sharded_replica(m) for m in meshes]
+                        server_settings.PROXY_ROUTING_POLICY = policy
+                        try:
+                            await _drive_fleet(
+                                fname, replicas[0][3], replicas[1][3]
+                            )
+                        finally:
+                            for _, rnr, arun, _p in replicas:
+                                rnr.shutdown()
+                                await arun.cleanup()
+                        engs = [r[0] for r in replicas]
+                        fleet_rates[policy] = _fleet_hit(engs)
+                        if policy == "prefix":
+                            # Affinity spread real work across BOTH shards.
+                            assert all(
+                                e.total_prefix_lookup_tokens > 0 for e in engs
+                            ), [e.stats() for e in engs]
+                finally:
+                    server_settings.PROXY_ROUTING_POLICY = saved_policy
+                assert fleet_rates["prefix"] > fleet_rates["round_robin"], (
+                    "cache-aware routing never beat round-robin: "
+                    f"{fleet_rates}"
+                )
+                dec = routing_service.state.decisions()
+                assert dec.get(("smoke-fleet", "prefix", "preferred"), 0) > 0, dec
+                resp = await api.client.get("/metrics")
+                routing_text = await resp.text()
+                routed = [
+                    ln for ln in routing_text.splitlines()
+                    if ln.startswith("dstack_tpu_proxy_routing_decisions_total{")
+                    and 'run="smoke-fleet"' in ln
+                    and 'policy="prefix"' in ln
+                    and 'outcome="preferred"' in ln
+                ]
+                assert routed, (
+                    "routing decision counter missing from /metrics"
+                )
+                fleet = {
+                    "hit_rate_prefix": round(fleet_rates["prefix"], 4),
+                    "hit_rate_rr": round(fleet_rates["round_robin"], 4),
+                }
+
                 # --- the autoscaler control loop -------------------------
                 await setup_mock_backend(api)
                 await api.post(
@@ -2139,6 +2510,7 @@ def smoke_serve() -> dict:
                     "unit": "sse_tokens",
                     "ttft_ms": round(q["p50"] * 1000, 1),
                     "cold_start": cold,
+                    "fleet": fleet,
                     **tier2,
                 }
         finally:
@@ -2147,6 +2519,8 @@ def smoke_serve() -> dict:
             await app_runner.cleanup()
             proxy_service.stats.reset()
             proxy_service.route_table.clear()
+            from dstack_tpu.server.services import routing as _routing
+            _routing.state.reset()
 
     result = asyncio.run(run())
     print(json.dumps(result))

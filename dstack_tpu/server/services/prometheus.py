@@ -403,6 +403,27 @@ async def render_metrics(db: Database) -> str:
     for key, (family, help_) in engine_families.items():
         sections.append(_fmt(family, help_, "gauge", engine_samples[key]))
 
+    # Cache-aware routing decisions (services/routing.py): counted per run
+    # name at decision time, so rendering needs no run-id join and the family
+    # is visible even for runs whose stats window has aged out.
+    from dstack_tpu.server.services import routing as routing_service
+
+    sections.append(
+        _fmt(
+            "dstack_tpu_proxy_routing_decisions_total",
+            "Replica routing decisions by run, policy, and outcome"
+            " (preferred=prefix-hash owner took it, spilled=owner over the"
+            " queue-depth bound, fallback=round-robin)",
+            "counter",
+            [
+                ({"run": run, "policy": policy, "outcome": outcome}, float(n))
+                for (run, policy, outcome), n in sorted(
+                    routing_service.state.decisions().items()
+                )
+            ],
+        )
+    )
+
     # Control-plane fault-tolerance surfaces: who owns which runs (lease
     # sharding across server replicas) and which external targets are
     # circuit-broken. Both families render even when empty so dashboards can

@@ -27,6 +27,7 @@ from dstack_tpu.server.db import Database, loads
 from dstack_tpu.server.services.jobs import job_jpd, job_jrd, job_spec as load_job_spec
 from dstack_tpu.server.services.locking import get_locker
 from dstack_tpu.server.services.runner import ssh as runner_ssh
+from dstack_tpu.server.services import routing
 
 logger = logging.getLogger(__name__)
 
@@ -429,6 +430,7 @@ def forget_run(run_id: str, run_name: Optional[str] = None) -> None:
     route_table.invalidate_run(run_id)
     route_table.forget_seq(run_id)
     _rr.pop(run_id, None)
+    routing.forget_run(run_id, run_name)
     stats.drop_run(run_id)
     rate_limiter.drop_scope(run_id)
     if run_name:
@@ -547,9 +549,17 @@ async def probe_service_replicas(db: Database, project_id: str, run_name: str) -
     if not replicas:
         return
 
-    async def _probe_one(jpd: JobProvisioningData, port: int) -> bool:
+    async def _probe_one(
+        jpd: JobProvisioningData, port: int
+    ) -> Tuple[bool, Optional[Tuple[str, int]]]:
+        # The resolved endpoint rides along with the verdict: a flip to
+        # not-ready must evict exactly that endpoint from the routing ring
+        # (None when resolution itself failed — then the whole ring resets).
+        resolved: Dict[str, Tuple[str, int]] = {}
+
         async def _connect_and_check() -> bool:
             host, eport = await replica_endpoint(jpd, port)
+            resolved["ep"] = (host, eport)
             reader, writer = await asyncio.open_connection(host, eport)
             try:
                 try:
@@ -565,14 +575,15 @@ async def probe_service_replicas(db: Database, project_id: str, run_name: str) -
                     pass
 
         try:
-            return await asyncio.wait_for(_connect_and_check(), timeout=5.0)
+            ready = await asyncio.wait_for(_connect_and_check(), timeout=5.0)
         except Exception:
-            return False  # tunnel failures, refused/timed-out connects alike
+            ready = False  # tunnel failures, refused/timed-out connects alike
+        return ready, resolved.get("ep")
 
     outcomes = await asyncio.gather(
         *(_probe_one(jpd, port) for _, jpd, _, port in replicas)
     )
-    for (row, _, _, _), ready in zip(replicas, outcomes):
+    for (row, _, _, _), (ready, endpoint) in zip(replicas, outcomes):
         async with get_locker().lock(f"run:{row['run_id']}"):
             fresh = await db.fetchone("SELECT * FROM jobs WHERE id = ?", (row["id"],))
             if fresh is None:
@@ -592,6 +603,14 @@ async def probe_service_replicas(db: Database, project_id: str, run_name: str) -
                     (jrd.model_dump_json(), fresh["id"]),
                 )
                 route_table.invalidate_run(row["run_id"])
+                if not ready:
+                    # Routing-ring hygiene: evict the dead replica's bucket
+                    # assignments now — prefix affinity must not keep hashing
+                    # hot prompts at it until the route TTL runs out.
+                    if endpoint is not None:
+                        routing.drop_endpoint(row["run_id"], endpoint)
+                    else:
+                        routing.invalidate_run(row["run_id"])
 
 
 async def replica_endpoint(jpd: JobProvisioningData, port: int) -> Tuple[str, int]:
@@ -645,15 +664,24 @@ async def proxy_request(
         )
     cursor = _rr.get(entry.run_id, 0)
     _rr[entry.run_id] = cursor + 1
+    # Routing key, computed once per request (services/routing.py): the hash
+    # of the prompt's leading tokens/bytes. None (no prompt / non-JSON body)
+    # routes round-robin via the cursor above. request.read() caches — the
+    # forward path reads the same buffered bytes, so this adds no extra copy.
+    if body is None:
+        body = await request.read()
+    pkey = routing.prefix_key(body)
 
     from dstack_tpu.core import faults
     from dstack_tpu.core.services.http_forward import forward
     from dstack_tpu.server.services import resilience
 
     def _pick(endpoints, tried) -> Optional[Tuple[str, int]]:
-        """Round-robin over untried endpoints, preferring ones whose circuit
-        is closed; if every candidate's breaker is open, use them anyway —
-        degraded service beats refusing outright."""
+        """Pick among untried endpoints, preferring ones whose circuit is
+        closed; if every candidate's breaker is open, use them anyway —
+        degraded service beats refusing outright. Which candidate wins is the
+        routing policy's call: prefix-hash affinity with load spill, or the
+        round-robin cursor (services/routing.py)."""
         candidates = [ep for ep in endpoints or [] if ep not in tried]
         if not candidates:
             return None
@@ -662,7 +690,10 @@ async def proxy_request(
             if not resilience.is_open(f"replica:{ep[0]}:{ep[1]}")
         ]
         pool = closed or candidates
-        return pool[cursor % len(pool)]
+        return routing.choose(
+            entry.run_id, run_name, pool, endpoints or [], pkey, cursor,
+            retrying=bool(tried),
+        )
 
     t0 = time.monotonic()
     started = False  # headers/chunks already relayed: retrying is impossible
@@ -678,7 +709,7 @@ async def proxy_request(
         tracing.observe(
             "dstack_tpu_service_ttft_seconds", elapsed, {"run": run_name}
         )
-        _record_queue_depth(entry.run_id, upstream.headers)
+        _record_queue_depth(entry.run_id, upstream.headers, endpoint=picked)
 
     stats.record_inflight(entry.run_id, +1)
     try:
@@ -734,7 +765,7 @@ async def proxy_request(
         tracing.observe(
             "dstack_tpu_service_request_latency_seconds", elapsed, {"run": run_name}
         )
-        _record_queue_depth(entry.run_id, resp.headers)
+        _record_queue_depth(entry.run_id, resp.headers, endpoint=picked)
     return resp
 
 
@@ -749,15 +780,21 @@ ENGINE_GAUGE_HEADERS = {
 }
 
 
-def _record_queue_depth(run_id: str, headers) -> None:
+def _record_queue_depth(run_id: str, headers, endpoint=None) -> None:
     """Serving replicas report engine backlog (and tier-2 engine gauges) on
-    every response; an absent or malformed header is simply not a sample."""
+    every response; an absent or malformed header is simply not a sample.
+    With ``endpoint``, the depth is also recorded per replica — the routing
+    policy's spill signal (services/routing.py)."""
     raw = headers.get(QUEUE_DEPTH_HEADER)
     if raw is not None:
         try:
-            stats.record_queue_depth(run_id, float(raw))
+            depth = float(raw)
         except (TypeError, ValueError):
-            pass
+            depth = None
+        if depth is not None:
+            stats.record_queue_depth(run_id, depth)
+            if endpoint is not None:
+                routing.state.record_queue_depth(run_id, endpoint, depth)
     for header, name in ENGINE_GAUGE_HEADERS.items():
         raw = headers.get(header)
         if raw is None:

@@ -1,0 +1,299 @@
+"""Cache-aware replica routing for the service proxy (the fleet-wide prefix
+cache).
+
+Serving replicas each hold a private KV prefix cache (workloads/serve.py
+``PrefixCache``); blind round-robin sprays requests sharing a prompt prefix
+across the fleet, so every replica pays the prefill for the same prefix and
+N caches hold N copies. This module makes the proxy's replica pick
+cache-aware:
+
+- **Prefix key**: hash the first ``prefix_block`` prompt tokens (or the same
+  count of raw prompt bytes pre-tokenization — the engine's tokenizer is
+  byte-level, so the spaces agree) out of the request body. Requests without
+  an extractable key — non-engine services, non-JSON bodies — fall back to
+  round-robin.
+- **Rendezvous (HRW) ring**: every (key, endpoint) pair is scored with a
+  keyed blake2b; the highest-scoring ready endpoint owns the bucket. HRW
+  gives minimal disruption by construction — a joining replica steals ~1/N
+  of the buckets, a leaving one redistributes only its own — with no token
+  ring to rebalance and no state to replicate.
+- **Sticky assignments**: each observed bucket's winner is memoized (bounded
+  LRU). Membership changes re-pin exactly the buckets whose recomputed
+  winner changed, which is what makes the ~1/N property observable — and
+  what the probe-flip hygiene hook (``drop_endpoint``) clears when a replica
+  goes not-ready, together with its ring slot.
+- **Load spill**: when the preferred replica's last-reported engine queue
+  depth (the ``X-Dstack-Queue-Depth`` header the proxy already records)
+  exceeds ``DSTACK_TPU_PROXY_SPILL_QUEUE_DEPTH``, the request spills to the
+  least-loaded ready replica — a hot prefix must not hotspot one replica
+  into timeout while its peers idle.
+
+Everything here is in-process memory keyed by run id — the proxy's
+zero-DB-queries-per-request invariant holds; a server restart merely starts
+with a cold ring (first requests re-pin buckets via HRW, deterministically).
+Decisions are counted per (run, policy, outcome) and rendered on /metrics as
+``dstack_tpu_proxy_routing_decisions_total``.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dstack_tpu.server import settings
+
+Endpoint = Tuple[str, int]
+
+POLICIES = ("prefix", "round_robin")
+# preferred = the prefix-hash owner took the request; spilled = owner was
+# over the queue-depth bound, least-loaded took it; fallback = round-robin
+# (configured policy, keyless request, or a retry past the owner).
+OUTCOMES = ("preferred", "spilled", "fallback")
+
+
+def active_policy() -> str:
+    """The configured routing policy, read per call so tests/bench can flip
+    ``settings.PROXY_ROUTING_POLICY`` at runtime."""
+    policy = settings.PROXY_ROUTING_POLICY
+    return policy if policy in POLICIES else "prefix"
+
+
+def prefix_key(body: Optional[bytes],
+               prefix_block: Optional[int] = None) -> Optional[bytes]:
+    """The routable prefix of a /generate-shaped JSON body, or None when the
+    request has no extractable prompt (route it round-robin).
+
+    Token lists hash the first ``prefix_block`` ids — the same space the
+    engine's PrefixCache blocks live in, so equal hash keys mean shareable KV.
+    Raw text prompts hash the same count of leading bytes (pre-tokenization;
+    the serve tokenizer is byte-level so the prefixes coincide)."""
+    if not body:
+        return None
+    try:
+        payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    n = prefix_block if prefix_block is not None else settings.PROXY_ROUTING_PREFIX_BLOCK
+    tokens = payload.get("prompt_tokens")
+    if isinstance(tokens, list) and tokens and all(
+        isinstance(t, int) and not isinstance(t, bool) for t in tokens
+    ):
+        return ("t:" + ",".join(str(t) for t in tokens[:n])).encode()
+    prompt = payload.get("prompt")
+    if isinstance(prompt, str) and prompt:
+        return b"s:" + prompt.encode("utf-8")[:n]
+    return None
+
+
+def _score(key: bytes, endpoint: Endpoint) -> int:
+    h = hashlib.blake2b(
+        key + b"|" + f"{endpoint[0]}:{endpoint[1]}".encode(), digest_size=8
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
+def rendezvous(key: bytes, endpoints: List[Endpoint]) -> Endpoint:
+    """Highest-random-weight owner of ``key`` among ``endpoints``."""
+    return max(endpoints, key=lambda ep: _score(key, ep))
+
+
+class PrefixRing:
+    """Per-run rendezvous ring + sticky bucket assignments (bounded LRU)."""
+
+    def __init__(self, max_assignments: Optional[int] = None) -> None:
+        self.endpoints: List[Endpoint] = []
+        self.assignments: "collections.OrderedDict[bytes, Endpoint]" = (
+            collections.OrderedDict()
+        )
+        self.max_assignments = (
+            max_assignments
+            if max_assignments is not None
+            else settings.PROXY_ROUTING_STICKY_MAX
+        )
+        self.moved = 0  # sticky buckets re-pinned by membership changes
+
+    def set_endpoints(self, endpoints: List[Endpoint]) -> None:
+        """Sync ring membership; re-pins only the sticky buckets whose HRW
+        winner changed (~1/N on a join, exactly the dead endpoint's share on
+        a leave)."""
+        eps = sorted(set(endpoints))
+        if eps == self.endpoints:
+            return
+        self.endpoints = eps
+        for key in list(self.assignments):
+            new = rendezvous(key, eps) if eps else None
+            if new != self.assignments[key]:
+                self.moved += 1
+                if new is None:
+                    del self.assignments[key]
+                else:
+                    self.assignments[key] = new
+
+    def drop_endpoint(self, endpoint: Endpoint) -> None:
+        if endpoint in self.endpoints:
+            self.set_endpoints([e for e in self.endpoints if e != endpoint])
+
+    def pick(self, key: bytes) -> Optional[Endpoint]:
+        if not self.endpoints:
+            return None
+        ep = self.assignments.get(key)
+        if ep is None:
+            ep = rendezvous(key, self.endpoints)
+        self.assignments[key] = ep
+        self.assignments.move_to_end(key)
+        while len(self.assignments) > self.max_assignments:
+            self.assignments.popitem(last=False)
+        return ep
+
+
+class RoutingState:
+    """All mutable routing state, per process (mirrors proxy.stats): rings,
+    per-endpoint queue-depth samples, and the decision counters /metrics
+    renders. Single-threaded event-loop access — no locks."""
+
+    def __init__(self) -> None:
+        self._rings: Dict[str, PrefixRing] = {}
+        # (run_id, endpoint) -> (ts, last reported engine queue depth).
+        self._depths: Dict[Tuple[str, Endpoint], Tuple[float, float]] = {}
+        # (run_name, policy, outcome) -> count. Keyed by run NAME because
+        # that is the /metrics label (run ids are internal).
+        self._decisions: Dict[Tuple[str, str, str], int] = {}
+
+    def ring(self, run_id: str) -> PrefixRing:
+        ring = self._rings.get(run_id)
+        if ring is None:
+            ring = self._rings[run_id] = PrefixRing()
+        return ring
+
+    # -- queue depth (per endpoint — the spill signal) ---------------------
+
+    def record_queue_depth(
+        self, run_id: str, endpoint: Endpoint, depth: float
+    ) -> None:
+        self._depths[(run_id, endpoint)] = (time.monotonic(), float(depth))
+
+    def endpoint_depth(
+        self, run_id: str, endpoint: Endpoint, window: float = 30.0
+    ) -> Optional[float]:
+        sample = self._depths.get((run_id, endpoint))
+        if sample is None or time.monotonic() - sample[0] > window:
+            return None
+        return sample[1]
+
+    def least_loaded(
+        self, run_id: str, endpoints: List[Endpoint]
+    ) -> Optional[Endpoint]:
+        """Endpoint with the lowest known queue depth; an endpoint that never
+        reported (fresh replica) counts as empty — spill should discover it."""
+        if not endpoints:
+            return None
+        return min(
+            endpoints, key=lambda ep: self.endpoint_depth(run_id, ep) or 0.0
+        )
+
+    # -- decision counters --------------------------------------------------
+
+    def record_decision(self, run_name: str, policy: str, outcome: str) -> None:
+        key = (run_name, policy, outcome)
+        self._decisions[key] = self._decisions.get(key, 0) + 1
+
+    def decisions(self) -> Dict[Tuple[str, str, str], int]:
+        return dict(self._decisions)
+
+    def decisions_for(self, run_name: str) -> Dict[Tuple[str, str], int]:
+        return {
+            (policy, outcome): n
+            for (run, policy, outcome), n in self._decisions.items()
+            if run == run_name
+        }
+
+    # -- hygiene ------------------------------------------------------------
+
+    def drop_endpoint(self, run_id: str, endpoint: Endpoint) -> None:
+        """Probe flipped a replica to not-ready: drop it from the ring AND
+        its sticky assignments now — waiting out the route TTL would keep
+        hashing hot prefixes at a dead replica."""
+        ring = self._rings.get(run_id)
+        if ring is not None:
+            ring.drop_endpoint(endpoint)
+        self._depths.pop((run_id, endpoint), None)
+
+    def invalidate_run(self, run_id: str) -> None:
+        """Membership changed but the endpoint is unresolvable (tunnel down):
+        reset the whole ring; the next request re-pins from live endpoints."""
+        self._rings.pop(run_id, None)
+        for key in [k for k in self._depths if k[0] == run_id]:
+            del self._depths[key]
+
+    def forget_run(self, run_id: str, run_name: Optional[str] = None) -> None:
+        self.invalidate_run(run_id)
+        if run_name:
+            for key in [k for k in self._decisions if k[0] == run_name]:
+                del self._decisions[key]
+
+    def reset(self) -> None:
+        self._rings.clear()
+        self._depths.clear()
+        self._decisions.clear()
+
+
+state = RoutingState()
+
+
+def choose(
+    run_id: str,
+    run_name: str,
+    pool: List[Endpoint],
+    all_endpoints: List[Endpoint],
+    key: Optional[bytes],
+    cursor: int,
+    retrying: bool = False,
+) -> Optional[Endpoint]:
+    """Pick one endpoint from ``pool`` (the proxy's untried,
+    breaker-preferred candidates) and record the decision.
+
+    ``all_endpoints`` is the run's full ready set — ring membership follows
+    it, not the shrinking retry pool, so one failed forward doesn't re-pin
+    every sticky bucket. Round-robin (``cursor``) is both the configured
+    alternative policy and the fallback for keyless requests, retries, and
+    owners that dropped out of the pool."""
+    if not pool:
+        return None
+    policy = active_policy()
+    if policy == "round_robin" or key is None:
+        state.record_decision(run_name, policy, "fallback")
+        return pool[cursor % len(pool)]
+
+    ring = state.ring(run_id)
+    ring.set_endpoints(all_endpoints)
+    preferred = ring.pick(key)
+    if preferred is None or retrying or preferred not in pool:
+        state.record_decision(run_name, policy, "fallback")
+        return pool[cursor % len(pool)]
+    depth = state.endpoint_depth(run_id, preferred)
+    if depth is not None and depth > settings.PROXY_SPILL_QUEUE_DEPTH:
+        spill = state.least_loaded(run_id, pool)
+        if spill is not None and spill != preferred:
+            state.record_decision(run_name, policy, "spilled")
+            return spill
+    state.record_decision(run_name, policy, "preferred")
+    return preferred
+
+
+# Module-level conveniences mirroring proxy.stats' style.
+
+def drop_endpoint(run_id: str, endpoint: Endpoint) -> None:
+    state.drop_endpoint(run_id, endpoint)
+
+
+def invalidate_run(run_id: str) -> None:
+    state.invalidate_run(run_id)
+
+
+def forget_run(run_id: str, run_name: Optional[str] = None) -> None:
+    state.forget_run(run_id, run_name)

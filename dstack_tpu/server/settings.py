@@ -74,6 +74,21 @@ PROXY_ROUTE_CACHE_TTL = float(os.getenv("DSTACK_TPU_PROXY_ROUTE_CACHE_TTL", "10"
 # DSTACK_TPU_PROXY_POOL_SIZE, read directly by core/services/http_forward
 # (core must not depend on server settings — the gateway appliance uses it too).
 
+# Cache-aware replica routing (services/routing.py). "prefix" hashes each
+# request's leading prompt tokens/bytes onto a rendezvous ring over the ready
+# replicas so shared prefixes land on the replica whose KV prefix cache is
+# already warm; "round_robin" restores the blind cursor. PREFIX_BLOCK is how
+# many leading tokens (or raw prompt bytes) form the routing key — align it
+# with the engine's --prefix-block so equal keys mean shareable KV blocks.
+# SPILL_QUEUE_DEPTH: when the prefix-preferred replica last reported an engine
+# queue depth above this bound, the request spills to the least-loaded ready
+# replica instead (cache affinity must not hotspot one replica). STICKY_MAX
+# bounds the per-run LRU of memoized bucket->replica assignments.
+PROXY_ROUTING_POLICY = os.getenv("DSTACK_TPU_PROXY_ROUTING_POLICY", "prefix")
+PROXY_ROUTING_PREFIX_BLOCK = int(os.getenv("DSTACK_TPU_PROXY_ROUTING_PREFIX_BLOCK", "64"))
+PROXY_SPILL_QUEUE_DEPTH = float(os.getenv("DSTACK_TPU_PROXY_SPILL_QUEUE_DEPTH", "8"))
+PROXY_ROUTING_STICKY_MAX = int(os.getenv("DSTACK_TPU_PROXY_ROUTING_STICKY_MAX", "4096"))
+
 # Scheduler FSM knobs.
 MAX_OFFERS_TRIED = int(os.getenv("DSTACK_TPU_MAX_OFFERS_TRIED", "5"))
 PROVISIONING_TIMEOUT = float(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))

@@ -352,11 +352,92 @@ class CheckpointManager:
                     f" — the model/optimizer config changed under the checkpoint"
                 )
 
-        host: List[Optional[np.ndarray]] = [
-            np.zeros(tuple(meta["shape"]), np.dtype(meta["dtype"])) for meta in leaves
+        host = self._load_host_arrays(path, leaves, set(range(len(leaves))))
+        restored = [
+            self._materialize(leaf, host[i])
+            for i, (key, leaf) in enumerate(entries)
         ]
-        covered = [0 for _ in leaves]
-        seen: List[set] = [set() for _ in leaves]
+        treedef = jax.tree_util.tree_structure(template)
+        return jax.tree_util.tree_unflatten(treedef, restored), manifest
+
+    def restore_subtree(self, template, step: Optional[int] = None,
+                        prefix: str = ""):
+        """Restore only the leaves of a checkpoint matching ``template``'s
+        keys — the train->serve handoff: a checkpoint holds a whole TrainState
+        but the engine wants just ``.params``, without paying to read (or
+        materialize) the optimizer moments.
+
+        Each template key is matched against the manifest as ``prefix + key``
+        first, then — when that misses — as a unique suffix, so a bare params
+        dict restores from both a params-only checkpoint and a full TrainState
+        one (``prefix=".params"``). An ambiguous suffix (the adam mu/nu trees
+        mirror the param keys exactly) raises with the candidate prefixes
+        rather than guessing. Only matched leaves' shard bytes are loaded.
+
+        Template leaves may be ``jax.ShapeDtypeStruct`` (optionally carrying a
+        ``NamedSharding``): no template arrays ever exist on device, each
+        restored host array is ``device_put`` straight into its serve-mesh
+        layout — the no-double-copy restore path.
+        Returns ``(tree, manifest)``."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no complete checkpoint in {self.directory}")
+        path = os.path.join(self.directory, _step_dir(step))
+        manifest = self.read_manifest(step)
+        leaves = manifest["leaves"]
+        saved_keys = [l["key"] for l in leaves]
+        by_key = {k: i for i, k in enumerate(saved_keys)}
+
+        entries = leaf_entries(template)
+        picked: List[int] = []
+        for key, leaf in entries:
+            i = by_key.get(prefix + key)
+            if i is None:
+                matches = [
+                    j for j, sk in enumerate(saved_keys) if sk.endswith(key)
+                ]
+                if not matches:
+                    raise ValueError(
+                        f"checkpoint {_step_dir(step)} has no leaf matching"
+                        f" {prefix + key!r} (or suffix {key!r})"
+                    )
+                if len(matches) > 1:
+                    prefixes = sorted(saved_keys[j][: -len(key)] for j in matches)
+                    raise ValueError(
+                        f"{key!r} is ambiguous in {_step_dir(step)}: matches"
+                        f" under prefixes {prefixes} — pass prefix= to pick one"
+                    )
+                i = matches[0]
+            meta = leaves[i]
+            shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+            if list(shape) != list(meta["shape"]):
+                raise ValueError(
+                    f"{key}: checkpoint shape {meta['shape']} != template"
+                    f" {list(shape)} — the model config changed under the"
+                    f" checkpoint"
+                )
+            picked.append(i)
+
+        host = self._load_host_arrays(path, leaves, set(picked))
+        restored = [
+            self._materialize(leaf, host[i])
+            for (key, leaf), i in zip(entries, picked)
+        ]
+        treedef = jax.tree_util.tree_structure(template)
+        return jax.tree_util.tree_unflatten(treedef, restored), manifest
+
+    @staticmethod
+    def _load_host_arrays(path: str, leaves: List[dict], wanted) -> Dict[int, np.ndarray]:
+        """Rebuild the global host array of every leaf index in ``wanted``
+        from the step directory's shard files; unwanted leaves' bytes are
+        skipped (npz members are lazily decompressed, so a subtree restore
+        reads only its own leaves). Raises on incomplete shard coverage."""
+        host: Dict[int, np.ndarray] = {
+            i: np.zeros(tuple(leaves[i]["shape"]), np.dtype(leaves[i]["dtype"]))
+            for i in wanted
+        }
+        covered = {i: 0 for i in wanted}
+        seen: Dict[int, set] = {i: set() for i in wanted}
         shard_files = sorted(
             os.path.join(path, n)
             for n in os.listdir(path)
@@ -367,8 +448,8 @@ class CheckpointManager:
                 for zkey in z.files:
                     leaf_s, _, idx_key = zkey.partition("@")
                     i = int(leaf_s)
-                    if idx_key in seen[i]:
-                        continue  # replicated across hosts — any copy will do
+                    if i not in host or idx_key in seen[i]:
+                        continue  # unwanted, or replicated across hosts
                     seen[i].add(idx_key)
                     index = _parse_index(idx_key)
                     piece = z[zkey]
@@ -392,33 +473,31 @@ class CheckpointManager:
                     else:
                         host[i] = piece.reshape(host[i].shape).astype(host[i].dtype)
                         covered[i] += int(piece.size)
-        for i, meta in enumerate(leaves):
+        for i in wanted:
+            meta = leaves[i]
             want = int(np.prod(meta["shape"])) if meta["shape"] else 1
             if covered[i] < want:
                 raise ValueError(
-                    f"{meta['key']}: shard files cover {covered[i]}/{want} elements"
-                    f" of {_step_dir(step)} — a host's shard file is missing"
+                    f"{meta['key']}: shard files cover {covered[i]}/{want}"
+                    f" elements — a host's shard file is missing"
                 )
+        return host
 
+    @staticmethod
+    def _materialize(leaf, arr: np.ndarray):
+        """Place one restored host array per its template leaf: device_put
+        into a NamedSharding (elastic re-shard — works for live jax.Arrays AND
+        ShapeDtypeStruct templates carrying a sharding), plain jnp for
+        unsharded device leaves (scalars stay UNcommitted, like fresh init —
+        a device_put would pin them to one device and clash with the sharded
+        params inside a jitted step), numpy passthrough otherwise."""
         from jax.sharding import NamedSharding
 
-        restored = []
-        for (key, leaf), arr in zip(entries, host):
-            if isinstance(leaf, jax.Array) and isinstance(
-                getattr(leaf, "sharding", None), NamedSharding
-            ):
-                restored.append(
-                    jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
-                )
-            elif isinstance(leaf, jax.Array):
-                # Scalars/unsharded leaves (optax counts, the step counter)
-                # stay UNcommitted, exactly like fresh init — a device_put
-                # here would pin them to one device and clash with the
-                # sharded params inside the jitted step.
-                import jax.numpy as jnp
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(arr.astype(leaf.dtype), sharding)
+        if isinstance(leaf, (jax.Array, jax.ShapeDtypeStruct)):
+            import jax.numpy as jnp
 
-                restored.append(jnp.asarray(arr, dtype=leaf.dtype))
-            else:
-                restored.append(arr)
-        treedef = jax.tree_util.tree_structure(template)
-        return jax.tree_util.tree_unflatten(treedef, restored), manifest
+            return jnp.asarray(arr, dtype=leaf.dtype)
+        return arr

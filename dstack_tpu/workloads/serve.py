@@ -65,6 +65,7 @@ import dataclasses
 import functools
 import json
 import logging
+import re
 import threading
 import time
 from typing import Callable, Deque, Dict, List, NamedTuple, Optional, Tuple
@@ -72,9 +73,11 @@ from typing import Callable, Deque, Dict, List, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.workloads import model as model_lib
 from dstack_tpu.workloads import quantize as quant_lib
+from dstack_tpu.workloads import sharding as sharding_lib
 from dstack_tpu.workloads.attention import (
     blockwise_attention,
     paged_chunk_attention,
@@ -106,19 +109,28 @@ def resolve_decode_impl(impl: str) -> str:
     return "pallas" if is_tpu_default_device() else "xla"
 
 
-def quantize_serve_params(params: dict) -> dict:
+def quantize_serve_params(params: dict, consume: bool = False) -> dict:
     """Weight-only int8 for serving: every projection weight becomes an int8
     tensor + per-output-channel fp32 scales (``<k>_q`` / ``<k>_s``), halving
     weight HBM vs bf16; embeddings and norms stay full-precision (the embed
-    is a gather, the norms are tiny)."""
+    is a gather, the norms are tiny).
+
+    With ``consume=True`` the input dict is drained as it is quantized: each
+    fp projection weight is popped (dropping its last reference, so the
+    device buffer frees) the moment its int8 twin exists — peak memory is the
+    fp tree plus ONE int8 copy, never both full trees. This is the restore
+    path's contract: a real checkpoint's weights quantize in place of the
+    just-restored fp leaves."""
     out = {
-        "embed": params["embed"],
-        "final_norm": params["final_norm"],
-        "attn_norm": params["attn_norm"],
-        "mlp_norm": params["mlp_norm"],
+        "embed": params.pop("embed") if consume else params["embed"],
+        "final_norm": params.pop("final_norm") if consume else params["final_norm"],
+        "attn_norm": params.pop("attn_norm") if consume else params["attn_norm"],
+        "mlp_norm": params.pop("mlp_norm") if consume else params["mlp_norm"],
     }
     for k in _WEIGHT_KEYS + ("lm_head",):
-        qw = quant_lib.quantize_weight(params[k])  # contraction = 2nd-to-last
+        w = params.pop(k) if consume else params[k]
+        qw = quant_lib.quantize_weight(w)  # contraction = 2nd-to-last
+        del w
         out[k + "_q"] = qw.values
         out[k + "_s"] = qw.scales
     return out
@@ -130,6 +142,69 @@ def _serve_layer_keys(quant: str):
     return tuple(
         f"{k}_{suffix}" for k in _WEIGHT_KEYS for suffix in ("q", "s")
     ) + _NORM_KEYS
+
+
+def parse_mesh_arg(spec: str) -> Optional[Mesh]:
+    """CLI serve-mesh spec -> Mesh: "tp4" (dd absorbs the rest of the slice),
+    "dd2xtp4" (explicit replica axis), "" / "none" -> meshless."""
+    if not spec or spec == "none":
+        return None
+    m = re.fullmatch(r"(?:dd(\d+)x)?tp(\d+)", spec)
+    if m is None:
+        raise ValueError(
+            f"bad mesh spec {spec!r}; expected tpN or ddMxtpN (e.g. tp4,"
+            f" dd2xtp4)"
+        )
+    dd = int(m.group(1)) if m.group(1) else None
+    return sharding_lib.make_serve_mesh(tp=int(m.group(2)), dd=dd)
+
+
+def load_serve_params(
+    checkpoint_dir: str,
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    quant: str = "none",
+    step: Optional[int] = None,
+) -> Tuple[dict, dict]:
+    """Restore real weights for the engine from a train checkpoint — the
+    elastic re-shard path of ``CheckpointManager`` pointed at serving:
+
+    - the template is ``jax.eval_shape`` over ``init_params`` (no synthetic
+      tree is ever initialized), each leaf a ShapeDtypeStruct carrying its
+      SERVE sharding — so a checkpoint saved on a dp/fsdp train mesh lands
+      directly in the tp(/dd) layout, one host->device transfer per leaf;
+    - only the ``.params`` subtree's shard bytes are read (a full TrainState
+      checkpoint's optimizer moments — 2x the param bytes — never leave
+      disk), via ``restore_subtree``'s prefix matching, which also accepts
+      params-only checkpoints;
+    - with ``quant="int8"``, ``quantize_serve_params(consume=True)`` drains
+      the fp tree as it quantizes: peak memory is the fp params plus one
+      int8 leaf, never two full trees.
+
+    Returns ``(params, manifest)`` — params in the layout ``ServeEngine``
+    expects for the given ``quant``."""
+    from dstack_tpu.workloads import checkpoint as checkpoint_lib
+
+    quant_lib.check_quant(quant)
+    if mesh is not None:
+        sharding_lib.validate_serve_mesh(cfg, mesh)
+    manager = checkpoint_lib.CheckpointManager(checkpoint_dir)
+    shapes = jax.eval_shape(
+        lambda k: model_lib.init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    shardings = (
+        sharding_lib.serve_param_sharding(mesh, "none") if mesh is not None else {}
+    )
+    template = {
+        k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=shardings.get(k))
+        for k, v in shapes.items()
+    }
+    params, manifest = manager.restore_subtree(
+        template, step=step, prefix=".params"
+    )
+    if quant == "int8":
+        params = quantize_serve_params(params, consume=True)
+    return params, manifest
 
 
 def _proj(x: jax.Array, layer: dict, key: str, adt, quant: str) -> jax.Array:
@@ -236,12 +311,30 @@ def _rope_single(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _serve_shardings(quant: str, mesh: Mesh):
+    """(param shardings, page-pool sharding, replicated) for a serve mesh —
+    what the jitted engine fns pin their in/out shardings to. Everything the
+    host builds per step (tokens, page tables, write maps) stays replicated;
+    only the weights and the KV pools shard."""
+    param_sh = sharding_lib.serve_param_sharding(mesh, quant)
+    page_sh = NamedSharding(mesh, sharding_lib.SERVE_PAGE_SPEC)
+    rep = NamedSharding(mesh, P())
+    return param_sh, page_sh, rep
+
+
 @functools.lru_cache(maxsize=None)
-def make_prefill_fn(cfg: LlamaConfig, quant: str = "none"):
+def make_prefill_fn(cfg: LlamaConfig, quant: str = "none",
+                    mesh: Optional[Mesh] = None):
     """jit'd (params, tokens, k_pages, v_pages, write_page, write_off, lens)
     -> (next_tokens, k_pages, v_pages). Memoized on the (frozen) config +
-    quant mode so every engine over the same model shares one jit cache —
-    bench variants don't re-compile per engine.
+    quant mode (+ mesh) so every engine over the same model shares one jit
+    cache — bench variants don't re-compile per engine.
+
+    With a serve ``mesh``, the same trace runs tp-sharded: projections and
+    attention heads split per SERVE_PARAM_SPECS, pages per SERVE_PAGE_SPEC
+    (head axis), host-side inputs replicated — GSPMD inserts the Megatron
+    pair of all-reduces (after wo and w_down) and the lm_head reduction; the
+    host-side scheduling code above never changes.
 
     tokens [B, T] right-padded prompts; write_page/write_off [B, T] map each
     token position into the page pool (pool-size index = dropped write, which
@@ -292,14 +385,22 @@ def make_prefill_fn(cfg: LlamaConfig, quant: str = "none"):
         logits = _logits(last, params, adt, quant)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
-    return jax.jit(prefill, donate_argnums=(2, 3))
+    if mesh is None:
+        return jax.jit(prefill, donate_argnums=(2, 3))
+    param_sh, page_sh, rep = _serve_shardings(quant, mesh)
+    return jax.jit(
+        prefill,
+        donate_argnums=(2, 3),
+        in_shardings=(param_sh, rep, page_sh, page_sh, rep, rep, rep),
+        out_shardings=(rep, page_sh, page_sh),
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def make_decode_fn(cfg: LlamaConfig, quant: str = "none",
-                   decode_impl: str = "xla"):
+                   decode_impl: str = "xla", mesh: Optional[Mesh] = None):
     """jit'd single-token decode over the paged cache (memoized on config +
-    quant + resolved decode_impl):
+    quant + resolved decode_impl + mesh):
     (params, last_tokens, positions, k_pages, v_pages, page_tables,
      write_page, write_off) -> (next_tokens, k_pages, v_pages).
 
@@ -353,7 +454,15 @@ def make_decode_fn(cfg: LlamaConfig, quant: str = "none",
         logits = _logits(x, params, adt, quant)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
-    return jax.jit(decode, donate_argnums=(3, 4))
+    if mesh is None:
+        return jax.jit(decode, donate_argnums=(3, 4))
+    param_sh, page_sh, rep = _serve_shardings(quant, mesh)
+    return jax.jit(
+        decode,
+        donate_argnums=(3, 4),
+        in_shardings=(param_sh, rep, rep, page_sh, page_sh, rep, rep, rep),
+        out_shardings=(rep, page_sh, page_sh),
+    )
 
 
 def _rope_chunk(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -371,7 +480,8 @@ def _rope_chunk(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 @functools.lru_cache(maxsize=None)
 def make_chunk_fn(cfg: LlamaConfig, quant: str = "none",
-                  decode_impl: str = "xla", emit: str = "last"):
+                  decode_impl: str = "xla", emit: str = "last",
+                  mesh: Optional[Mesh] = None):
     """jit'd multi-token step over the paged cache — the shared program behind
     chunked prefill, prefix-cache suffix prefill, AND speculative verify:
     (params, tokens, starts, valid, k_pages, v_pages, page_tables,
@@ -440,7 +550,15 @@ def make_chunk_fn(cfg: LlamaConfig, quant: str = "none",
             logits = _logits(x, params, adt, quant)  # [S, C, V]
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
-    return jax.jit(chunk_step, donate_argnums=(4, 5))
+    if mesh is None:
+        return jax.jit(chunk_step, donate_argnums=(4, 5))
+    param_sh, page_sh, rep = _serve_shardings(quant, mesh)
+    return jax.jit(
+        chunk_step,
+        donate_argnums=(4, 5),
+        in_shardings=(param_sh, rep, rep, rep, page_sh, page_sh, rep, rep, rep),
+        out_shardings=(rep, page_sh, page_sh),
+    )
 
 
 def propose_ngram_drafts(context: List[int], k: int, max_n: int = 3) -> List[int]:
@@ -661,9 +779,13 @@ class ServeEngine:
         engine_cfg: Optional[EngineConfig] = None,
         params: Optional[dict] = None,
         seed: int = 0,
+        mesh: Optional[Mesh] = None,
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
+        self.mesh = mesh
+        if mesh is not None:
+            sharding_lib.validate_serve_mesh(cfg, mesh)
         if self.ecfg.policy not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling policy {self.ecfg.policy!r}")
         if self.ecfg.decode_impl not in DECODE_IMPLS:
@@ -696,13 +818,30 @@ class ServeEngine:
         # inverting the memory win. Reference decoders keep their own tree.
         quant = self.ecfg.quant
         if quant == "int8":
-            self._serve_params = quantize_serve_params(self.params)
+            if self.params is not None and "lm_head_q" in self.params:
+                # Already in the weight-only layout (load_serve_params
+                # quantized leaf-by-leaf as it consumed the restored fp tree)
+                # — re-quantizing int8 values would be wrong AND the fp
+                # originals are gone by design.
+                self._serve_params = self.params
+            else:
+                self._serve_params = quantize_serve_params(self.params)
             self.params = None
         else:
             self._serve_params = self.params
+        if mesh is not None:
+            # Pin the weights to the serve layout up front: device_put is a
+            # no-op for leaves already laid out right (load_serve_params
+            # restores directly into these shardings), a one-time reshard for
+            # host/meshless trees.
+            shardings = sharding_lib.serve_param_sharding(mesh, quant)
+            self._serve_params = {
+                k: jax.device_put(v, shardings[k])
+                for k, v in self._serve_params.items()
+            }
         self.decode_impl = resolve_decode_impl(self.ecfg.decode_impl)
-        self._prefill_fn = make_prefill_fn(cfg, quant)
-        self._decode_fn = make_decode_fn(cfg, quant, self.decode_impl)
+        self._prefill_fn = make_prefill_fn(cfg, quant, mesh)
+        self._decode_fn = make_decode_fn(cfg, quant, self.decode_impl, mesh)
         # Tier-2 prefill (chunked and/or cache-hit suffix) replaces the
         # whole-prompt prefill path; with both features off the tier-1 path
         # runs unchanged.
@@ -710,9 +849,13 @@ class ServeEngine:
             self.ecfg.prefill_chunk > 0 or self.ecfg.prefix_cache
         )
         if self._tier2_prefill:
-            self._chunk_fn = make_chunk_fn(cfg, quant, self.decode_impl, "last")
+            self._chunk_fn = make_chunk_fn(
+                cfg, quant, self.decode_impl, "last", mesh
+            )
         if self.ecfg.spec_tokens > 0:
-            self._verify_fn = make_chunk_fn(cfg, quant, self.decode_impl, "all")
+            self._verify_fn = make_chunk_fn(
+                cfg, quant, self.decode_impl, "all", mesh
+            )
         self._cache = (
             PrefixCache(self.ecfg.page_size) if self.ecfg.prefix_cache else None
         )
@@ -723,8 +866,17 @@ class ServeEngine:
         self.table_width = -(-max_seq // page)  # pages per sequence, ceil
         shape = (cfg.n_layers, pool, page, cfg.n_kv_heads, cfg.head_dim)
         cache_dtype = jnp.dtype(cfg.dtype)
-        self.k_pages = jnp.zeros(shape, cache_dtype)
-        self.v_pages = jnp.zeros(shape, cache_dtype)
+        if mesh is not None:
+            page_sharding = NamedSharding(mesh, sharding_lib.SERVE_PAGE_SPEC)
+            self.k_pages = jax.device_put(
+                jnp.zeros(shape, cache_dtype), page_sharding
+            )
+            self.v_pages = jax.device_put(
+                jnp.zeros(shape, cache_dtype), page_sharding
+            )
+        else:
+            self.k_pages = jnp.zeros(shape, cache_dtype)
+            self.v_pages = jnp.zeros(shape, cache_dtype)
 
         self._free: List[int] = list(range(pool))
         mb = self.ecfg.max_batch
@@ -808,6 +960,14 @@ class ServeEngine:
         return n
 
     @property
+    def mesh_desc(self) -> str:
+        """"ddNxtpM" for a sharded engine, "" for the meshless one."""
+        if self.mesh is None:
+            return ""
+        shape = dict(self.mesh.shape)
+        return f"dd{shape.get('dd', 1)}xtp{shape.get('tp', 1)}"
+
+    @property
     def prefix_hit_rate(self) -> float:
         """Fraction of admitted prompt tokens served from the prefix cache."""
         return self.total_prefix_hit_tokens / max(
@@ -834,6 +994,7 @@ class ServeEngine:
             "policy": self.ecfg.policy,
             "decode_impl": self.decode_impl,
             "quant": self.ecfg.quant,
+            "mesh": self.mesh_desc,
             "prefill_chunk": self.ecfg.prefill_chunk,
             "prefix_cache": int(self.ecfg.prefix_cache),
             "spec_tokens": self.ecfg.spec_tokens,
@@ -1535,9 +1696,37 @@ def main() -> None:
                         help="speculative decode: n-gram draft tokens"
                              " verified per step (0 = off); output stays"
                              " token-identical to greedy")
+    parser.add_argument("--checkpoint-dir", default="", dest="checkpoint_dir",
+                        help="restore real weights from a train checkpoint"
+                             " (CheckpointManager layout; the .params subtree"
+                             " of a TrainState or a params-only tree) instead"
+                             " of serving synthetic init")
+    parser.add_argument("--checkpoint-step", type=int, default=None,
+                        dest="checkpoint_step",
+                        help="checkpoint step to restore (default: latest"
+                             " complete)")
+    parser.add_argument("--mesh", default="",
+                        help="serve mesh spec: tpN shards projections/heads"
+                             " over N chips (ddMxtpN adds an explicit replica"
+                             " axis; default meshless — one chip per replica)")
     args = parser.parse_args()
 
     cfg = get_config(args.config)
+    mesh = parse_mesh_arg(args.mesh)
+    params = None
+    restored = None
+    if args.checkpoint_dir:
+        params, restored = load_serve_params(
+            args.checkpoint_dir, cfg, mesh=mesh, quant=args.quant,
+            step=args.checkpoint_step,
+        )
+        print(
+            f"restored checkpoint step {restored['step']} from"
+            f" {args.checkpoint_dir}"
+            + (f" (saved on mesh {restored['mesh']})" if restored.get("mesh")
+               else ""),
+            flush=True,
+        )
     engine = ServeEngine(
         cfg,
         EngineConfig(
@@ -1552,6 +1741,8 @@ def main() -> None:
             prefix_cache=args.prefix_cache,
             spec_tokens=args.spec_tokens,
         ),
+        params=params,
+        mesh=mesh,
     )
     runner = EngineRunner(engine)
     runner.start()
@@ -1560,7 +1751,9 @@ def main() -> None:
         f"(pages={args.pages}x{args.page_size}, slots={args.max_batch}, "
         f"policy={args.policy}, decode={engine.decode_impl}, "
         f"quant={args.quant}, prefill_chunk={args.prefill_chunk}, "
-        f"prefix_cache={args.prefix_cache}, spec_tokens={args.spec_tokens})",
+        f"prefix_cache={args.prefix_cache}, spec_tokens={args.spec_tokens}, "
+        f"mesh={engine.mesh_desc or 'none'}, "
+        f"weights={'checkpoint' if args.checkpoint_dir else 'synthetic'})",
         flush=True,
     )
     try:

@@ -101,6 +101,121 @@ def param_sharding(mesh: Mesh) -> Dict[str, NamedSharding]:
     return {k: NamedSharding(mesh, spec) for k, spec in PARAM_SPECS.items()}
 
 
+# ---------------------------------------------------------------------------
+# Serving mesh: one replica spans a multi-chip slice (the Gemma-31B shape —
+# the model only fits sharded). Axes:
+#
+# - ``tp`` tensor parallel: attention/MLP projections and KV heads sharded —
+#          the Megatron layout (column-parallel up/gate/QKV, row-parallel
+#          down/wo with one all-reduce each), expressed as NamedShardings
+#          for GSPMD rather than explicit collectives.
+# - ``dd`` decode-data replica axis: pure replication (params AND the engine's
+#          host-driven batches — every spec below simply omits it). It exists
+#          so a serve mesh can absorb a whole slice (tp x dd = devices) and a
+#          checkpoint restores onto it unchanged; scheduling stays host-side.
+
+SERVE_MESH_AXES = ("dd", "tp")
+
+
+def make_serve_mesh(tp: int = 1, dd: Optional[int] = None, devices=None) -> Mesh:
+    """Build a (dd, tp) serving mesh; dd=None absorbs the remaining devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if dd is None:
+        if n % tp != 0:
+            raise ValueError(f"{n} devices not divisible by tp={tp}")
+        dd = n // tp
+    if dd * tp != n:
+        raise ValueError(f"serve mesh {dd}x{tp} != {n} devices")
+    arr = np.array(devices).reshape(dd, tp)
+    return Mesh(arr, SERVE_MESH_AXES)
+
+
+# Serve-side logical -> physical rules for the same stacked-layer tree.
+# Activations stay replicated between blocks; only the projections' wide axis
+# (and the attention heads living on it) shard over tp. The embed stays
+# replicated — it is a gather on the decode hot path, and a vocab-sharded
+# table would turn every step's first op into a collective; lm_head shards
+# its CONTRACTION dim so the final logits come out replicated (one
+# all-reduce) and the greedy argmax needs no cross-shard reduction.
+SERVE_PARAM_SPECS: Dict[str, P] = {
+    "embed": P(None, None),                 # [V, D] replicated (decode gather)
+    "wq": P(None, None, "tp"),              # [L, D, H*Dh] heads over tp
+    "wk": P(None, None, "tp"),              # [L, D, Hkv*Dh]
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),              # [L, H*Dh, D] row-parallel
+    "w_gate": P(None, None, "tp"),          # [L, D, F] hidden over tp
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),          # [L, F, D] row-parallel
+    "attn_norm": P(None, None),             # [L, D]
+    "mlp_norm": P(None, None),
+    "final_norm": P(None),                  # [D]
+    "lm_head": P("tp", None),               # [D, V] contraction over tp
+}
+
+# KV page pools [L, pool, page, Kh, Dh]: the head axis rides the same tp
+# split as the K/V projections that write it, so page writes and paged
+# attention reads are shard-local (no resharding on the decode hot path).
+SERVE_PAGE_SPEC = P(None, None, None, "tp", None)
+
+
+def serve_param_specs(quant: str = "none") -> Dict[str, P]:
+    """SERVE_PARAM_SPECS in the layout the engine actually holds: the fp tree,
+    or the ``quantize_serve_params`` layout (``<k>_q`` int8 values take the fp
+    weight's spec; ``<k>_s`` per-output-channel scales keep the OUTPUT axis
+    sharding — for row-parallel weights the contraction axis that tp splits is
+    reduced away in the scales, leaving them replicated)."""
+    if quant != "int8":
+        return dict(SERVE_PARAM_SPECS)
+    specs: Dict[str, P] = {
+        k: SERVE_PARAM_SPECS[k]
+        for k in ("embed", "final_norm", "attn_norm", "mlp_norm")
+    }
+    for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"):
+        spec = SERVE_PARAM_SPECS[k]
+        specs[k + "_q"] = spec
+        # scales [..., 1, N]: the contraction axis is a keepdims singleton, so
+        # its mesh axis (if any) must not appear; keep only the output axis.
+        parts = list(spec)
+        parts[-2] = None
+        specs[k + "_s"] = P(*parts)
+    return specs
+
+
+def serve_param_sharding(mesh: Mesh, quant: str = "none") -> Dict[str, NamedSharding]:
+    return {
+        k: NamedSharding(mesh, spec) for k, spec in serve_param_specs(quant).items()
+    }
+
+
+def validate_serve_mesh(cfg, mesh: Mesh) -> None:
+    """Loud pre-compile validation of a serving mesh against the model config:
+    tp must split whole heads (queries AND whole GQA KV groups), the MLP
+    hidden, and the lm_head contraction — an uneven split would make GSPMD
+    silently pad and reshard the decode hot path."""
+    axes = dict(mesh.shape)
+    unknown = set(axes) - {"dd", "tp"}
+    if unknown:
+        raise ValueError(
+            f"serve mesh has unknown axes {sorted(unknown)}; expected (dd, tp)"
+            f" — build it with sharding.make_serve_mesh"
+        )
+    tp = axes.get("tp", 1)
+    if tp <= 1:
+        return
+    for name, dim in (
+        ("n_heads", cfg.n_heads),
+        ("n_kv_heads", cfg.n_kv_heads),
+        ("d_ff", cfg.d_ff),
+        ("d_model", cfg.d_model),
+    ):
+        if dim % tp:
+            raise ValueError(
+                f"serve mesh tp={tp} must divide {name}={dim} (whole"
+                f" heads/channels per shard); adjust the mesh or the config"
+            )
+
+
 def shard_params(params: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:
     shardings = param_sharding(mesh)
     return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}

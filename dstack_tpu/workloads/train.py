@@ -165,9 +165,20 @@ def make_train_step(
     )
 
 
-jax.tree_util.register_pytree_node(
+# Keyed registration: checkpoint manifests record leaf paths via keystr, and
+# named fields (".params['embed']") are what lets a consumer restore a
+# SUBTREE — the serve engine pulls just ".params" out of a train checkpoint
+# (checkpoint.restore_subtree) without materializing the optimizer moments.
+jax.tree_util.register_pytree_with_keys(
     TrainState,
-    lambda s: ((s.params, s.opt_state, s.step), None),
+    lambda s: (
+        (
+            (jax.tree_util.GetAttrKey("params"), s.params),
+            (jax.tree_util.GetAttrKey("opt_state"), s.opt_state),
+            (jax.tree_util.GetAttrKey("step"), s.step),
+        ),
+        None,
+    ),
     lambda _, kids: TrainState(*kids),
 )
 

@@ -1,0 +1,550 @@
+"""Cache-aware replica routing (services/routing.py): prefix keys, the
+rendezvous ring's ~1/N join/leave stability, sticky-assignment hygiene on
+probe flips, queue-depth spill, and the decision counters on /metrics.
+
+The integration tests drive the REAL proxy (router -> route table -> routing
+policy -> pooled forward) against local JSON stub replicas, the same shape
+test_serving_fast_path.py uses — including the acceptance invariant that
+prefix routing adds ZERO DB queries to the steady-state request path."""
+
+import asyncio
+import json
+import re
+import socket
+
+import pytest
+
+from dstack_tpu.server import settings
+from dstack_tpu.server.services import proxy as proxy_service
+from dstack_tpu.server.services import routing
+from tests.common import api_server
+
+
+def k(i: int) -> bytes:
+    return f"t:key-{i}".encode()
+
+
+EP = [("10.0.0.1", 80), ("10.0.0.2", 80), ("10.0.0.3", 80)]
+
+
+class _Fixture:
+    """Pin the route cache TTL high, force the prefix policy, and reset all
+    proxy + routing state around each test."""
+
+    def __enter__(self):
+        self._ttl = settings.PROXY_ROUTE_CACHE_TTL
+        self._policy = settings.PROXY_ROUTING_POLICY
+        settings.PROXY_ROUTE_CACHE_TTL = 3600.0
+        settings.PROXY_ROUTING_POLICY = "prefix"
+        proxy_service.route_table.clear()
+        proxy_service.stats.reset()
+        proxy_service._rr.clear()
+        routing.state.reset()
+        return self
+
+    def __exit__(self, *exc):
+        settings.PROXY_ROUTE_CACHE_TTL = self._ttl
+        settings.PROXY_ROUTING_POLICY = self._policy
+        proxy_service.route_table.clear()
+        proxy_service.stats.reset()
+        proxy_service._rr.clear()
+        routing.state.reset()
+        return False
+
+
+class TestPrefixKey:
+    def test_token_prompts_share_key_past_the_window(self):
+        base = list(range(1, 70))
+        a = json.dumps({"prompt_tokens": base + [900]}).encode()
+        b = json.dumps({"prompt_tokens": base + [901, 902]}).encode()
+        # Defaults: 64-token window — the differing tails fall outside it.
+        assert routing.prefix_key(a) == routing.prefix_key(b) is not None
+
+    def test_token_divergence_inside_window_changes_key(self):
+        a = json.dumps({"prompt_tokens": [1, 2, 3]}).encode()
+        b = json.dumps({"prompt_tokens": [1, 2, 4]}).encode()
+        assert routing.prefix_key(a) != routing.prefix_key(b)
+
+    def test_string_prompts_hash_leading_bytes(self):
+        long = "x" * 200
+        a = json.dumps({"prompt": long + "tail-one"}).encode()
+        b = json.dumps({"prompt": long + "tail-two"}).encode()
+        assert routing.prefix_key(a) == routing.prefix_key(b) is not None
+        assert routing.prefix_key(
+            json.dumps({"prompt": "alpha"}).encode()
+        ) != routing.prefix_key(json.dumps({"prompt": "bravo"}).encode())
+
+    def test_unroutable_bodies_return_none(self):
+        for body in (
+            None,
+            b"",
+            b"not json",
+            b"[1,2,3]",
+            json.dumps({"max_tokens": 5}).encode(),
+            json.dumps({"prompt_tokens": []}).encode(),
+            json.dumps({"prompt_tokens": [1, "a"]}).encode(),
+            json.dumps({"prompt_tokens": [True, False]}).encode(),
+            json.dumps({"prompt": ""}).encode(),
+        ):
+            assert routing.prefix_key(body) is None, body
+
+    def test_explicit_window_override(self):
+        a = json.dumps({"prompt_tokens": [1, 2, 3]}).encode()
+        b = json.dumps({"prompt_tokens": [1, 2, 9]}).encode()
+        assert routing.prefix_key(a, prefix_block=2) == routing.prefix_key(
+            b, prefix_block=2
+        )
+
+
+class TestRendezvousRing:
+    def test_owner_is_deterministic_and_order_independent(self):
+        for i in range(50):
+            assert routing.rendezvous(k(i), EP) == routing.rendezvous(
+                k(i), list(reversed(EP))
+            )
+
+    def test_join_moves_about_one_over_n_buckets(self):
+        """Adding a 4th endpoint must re-pin roughly 1/4 of the sticky
+        buckets — and ONLY buckets the newcomer now wins."""
+        ring = routing.PrefixRing(max_assignments=10_000)
+        ring.set_endpoints(EP)
+        n = 400
+        before = {k(i): ring.pick(k(i)) for i in range(n)}
+        newcomer = ("10.0.0.4", 80)
+        ring.set_endpoints(EP + [newcomer])
+        moved = 0
+        for i in range(n):
+            after = ring.pick(k(i))
+            if after != before[k(i)]:
+                moved += 1
+                assert after == newcomer, (
+                    "a join re-pinned a bucket between OLD endpoints"
+                )
+        assert ring.moved == moved
+        # ~1/4 in expectation; generous bounds keep the test hash-stable.
+        assert 0.10 < moved / n < 0.45, f"join moved {moved}/{n} buckets"
+
+    def test_leave_redistributes_only_the_dead_endpoints_buckets(self):
+        ring = routing.PrefixRing(max_assignments=10_000)
+        ring.set_endpoints(EP)
+        n = 300
+        before = {k(i): ring.pick(k(i)) for i in range(n)}
+        dead = EP[1]
+        ring.drop_endpoint(dead)
+        for i in range(n):
+            after = ring.pick(k(i))
+            if before[k(i)] == dead:
+                assert after != dead
+            else:
+                assert after == before[k(i)], (
+                    "a leave re-pinned a surviving endpoint's bucket"
+                )
+
+    def test_sticky_assignments_are_lru_bounded(self):
+        ring = routing.PrefixRing(max_assignments=8)
+        ring.set_endpoints(EP)
+        for i in range(50):
+            ring.pick(k(i))
+        assert len(ring.assignments) == 8
+        # The most recent keys survived.
+        assert k(49) in ring.assignments and k(0) not in ring.assignments
+
+
+class TestChoose:
+    RUN = "run-x"
+    NAME = "x"
+
+    def setup_method(self):
+        routing.state.reset()
+        self._policy = settings.PROXY_ROUTING_POLICY
+        settings.PROXY_ROUTING_POLICY = "prefix"
+
+    def teardown_method(self):
+        settings.PROXY_ROUTING_POLICY = self._policy
+        routing.state.reset()
+
+    def test_preferred_owner_takes_keyed_requests(self):
+        key = k(1)
+        want = routing.rendezvous(key, EP)
+        for _ in range(5):
+            assert routing.choose(self.RUN, self.NAME, EP, EP, key, 0) == want
+        assert routing.state.decisions_for(self.NAME) == {
+            ("prefix", "preferred"): 5
+        }
+
+    def test_overloaded_owner_spills_to_least_loaded(self):
+        key = k(2)
+        owner = routing.rendezvous(key, EP)
+        others = [ep for ep in EP if ep != owner]
+        routing.state.record_queue_depth(
+            self.RUN, owner, settings.PROXY_SPILL_QUEUE_DEPTH + 1
+        )
+        routing.state.record_queue_depth(self.RUN, others[0], 2.0)
+        # others[1] never reported: counts as empty, so it wins the spill.
+        assert routing.choose(self.RUN, self.NAME, EP, EP, key, 0) == others[1]
+        # Depth AT the bound does not spill (strictly-greater semantics).
+        routing.state.record_queue_depth(
+            self.RUN, owner, settings.PROXY_SPILL_QUEUE_DEPTH
+        )
+        assert routing.choose(self.RUN, self.NAME, EP, EP, key, 0) == owner
+        assert routing.state.decisions_for(self.NAME) == {
+            ("prefix", "spilled"): 1,
+            ("prefix", "preferred"): 1,
+        }
+
+    def test_stale_depth_samples_never_spill(self, monkeypatch):
+        key = k(3)
+        owner = routing.rendezvous(key, EP)
+        routing.state.record_queue_depth(self.RUN, owner, 1e9)
+        real = routing.time.monotonic
+        monkeypatch.setattr(routing.time, "monotonic", lambda: real() + 31.0)
+        assert routing.choose(self.RUN, self.NAME, EP, EP, key, 0) == owner
+
+    def test_retry_and_keyless_and_rr_policy_fall_back_to_cursor(self):
+        key = k(4)
+        assert routing.choose(
+            self.RUN, self.NAME, EP, EP, key, 1, retrying=True
+        ) == EP[1]
+        assert routing.choose(self.RUN, self.NAME, EP, EP, None, 2) == EP[2]
+        settings.PROXY_ROUTING_POLICY = "round_robin"
+        assert routing.choose(self.RUN, self.NAME, EP, EP, key, 0) == EP[0]
+        assert routing.state.decisions_for(self.NAME) == {
+            ("prefix", "fallback"): 2,
+            ("round_robin", "fallback"): 1,
+        }
+
+    def test_owner_outside_retry_pool_falls_back(self):
+        key = k(5)
+        owner = routing.rendezvous(key, EP)
+        pool = [ep for ep in EP if ep != owner]
+        got = routing.choose(self.RUN, self.NAME, pool, EP, key, 0)
+        assert got in pool
+
+    def test_forget_run_sweeps_ring_depths_and_counters(self):
+        key = k(6)
+        routing.choose(self.RUN, self.NAME, EP, EP, key, 0)
+        routing.state.record_queue_depth(self.RUN, EP[0], 1.0)
+        routing.forget_run(self.RUN, self.NAME)
+        assert self.RUN not in routing.state._rings
+        assert not routing.state._depths
+        assert routing.state.decisions() == {}
+
+
+async def seed_service(db, run_name: str, *replica_ports: int):
+    """A ready service run with one running replica row per port (job_num 0
+    each — the shape list_service_replicas returns for scaled services)."""
+    proj = await db.fetchone("SELECT * FROM projects LIMIT 1")
+    conf = {"type": "service", "commands": ["serve"], "port": 8000,
+            "auth": False}
+    await db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at, status,"
+        " run_spec) VALUES (?, ?, ?, ?, '2026-01-01', 'running', ?)",
+        (f"run-{run_name}", proj["id"], proj["owner_id"], run_name,
+         json.dumps({"run_name": run_name, "configuration": conf})),
+    )
+    for i, port in enumerate(replica_ports):
+        job_spec = {
+            "job_name": f"{run_name}-0-{i}",
+            "image_name": "stub",
+            "requirements": {"resources": {}},
+            "service_port": 8000,
+        }
+        jpd = {
+            "backend": "local",
+            "instance_type": {"name": "local",
+                              "resources": {"cpus": 1, "memory_gb": 1, "disk_gb": 1}},
+            "instance_id": f"i-{run_name}-{i}",
+            "hostname": "127.0.0.1",
+            "region": "local",
+        }
+        jrd = {"ports_mapping": {"8000": port}, "probe_ready": True}
+        await db.execute(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, job_spec,"
+            " status, submitted_at, job_provisioning_data, job_runtime_data)"
+            " VALUES (?, ?, ?, ?, 0, ?, 'running', '2026-01-01', ?, ?)",
+            (f"job-{run_name}-{i}", proj["id"], f"run-{run_name}", run_name,
+             json.dumps(job_spec), json.dumps(jpd), json.dumps(jrd)),
+        )
+    return f"run-{run_name}", proj["id"]
+
+
+class _JsonReplica:
+    """Counting JSON stub replica that reports a configurable engine queue
+    depth — the spill signal — on every response."""
+
+    def __init__(self, depth: float = 0.0) -> None:
+        self.requests = 0
+        self.depth = depth
+        self.port = None
+        self._runner = None
+
+    async def start(self):
+        from aiohttp import web as aioweb
+
+        async def handle(request):
+            self.requests += 1
+            await request.read()
+            return aioweb.json_response(
+                {"ok": True},
+                headers={"X-Dstack-Queue-Depth": str(self.depth)},
+            )
+
+        app = aioweb.Application()
+        app.router.add_route("*", "/{tail:.*}", handle)
+        self._runner = aioweb.AppRunner(app)
+        await self._runner.setup()
+        site = aioweb.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+
+def _body(tokens):
+    return {"prompt_tokens": tokens, "max_tokens": 1, "stream": False}
+
+
+class TestProxyRouting:
+    async def test_shared_prefix_pins_one_replica(self):
+        """All requests sharing a prompt prefix land on ONE replica; a
+        different prefix may land elsewhere, and the decisions are counted."""
+        with _Fixture():
+            a, b = await _JsonReplica().start(), await _JsonReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "affine", a.port, b.port)
+                    url = "/proxy/services/main/affine/generate"
+                    shared = list(range(1, 70))
+                    for i in range(6):
+                        resp = await api.client.post(url, json=_body(shared + [200 + i]))
+                        assert resp.status == 200
+                    assert sorted([a.requests, b.requests]) == [0, 6], (
+                        f"shared prefix split across replicas: {a.requests}/{b.requests}"
+                    )
+                    assert routing.state.decisions_for("affine") == {
+                        ("prefix", "preferred"): 6
+                    }
+            finally:
+                await a.stop()
+                await b.stop()
+
+    async def test_zero_db_queries_with_prefix_routing(self):
+        """The PR's acceptance invariant: the cache-aware policy keeps the
+        steady-state data plane at ZERO DB queries per request."""
+        with _Fixture():
+            a, b = await _JsonReplica().start(), await _JsonReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "zerodb", a.port, b.port)
+                    url = "/proxy/services/main/zerodb/generate"
+                    resp = await api.client.post(url, json=_body([1, 2, 3]))
+                    assert resp.status == 200
+
+                    counts = {"queries": 0}
+                    orig_all, orig_one = api.db.fetchall, api.db.fetchone
+
+                    async def counted_all(*args, **kw):
+                        counts["queries"] += 1
+                        return await orig_all(*args, **kw)
+
+                    async def counted_one(*args, **kw):
+                        counts["queries"] += 1
+                        return await orig_one(*args, **kw)
+
+                    api.db.fetchall, api.db.fetchone = counted_all, counted_one
+                    try:
+                        for i in range(20):
+                            resp = await api.client.post(
+                                url, json=_body([i % 3, 5, 9])
+                            )
+                            assert resp.status == 200
+                    finally:
+                        api.db.fetchall, api.db.fetchone = orig_all, orig_one
+                    assert counts["queries"] == 0, (
+                        f"prefix routing hit the DB {counts['queries']} times"
+                    )
+            finally:
+                await a.stop()
+                await b.stop()
+
+    async def test_overloaded_replica_spills_through_the_proxy(self):
+        """End to end: the preferred replica advertises a queue depth over
+        the bound via its response header; the NEXT same-prefix request goes
+        to the other replica and the spill is counted."""
+        with _Fixture():
+            a = await _JsonReplica(depth=settings.PROXY_SPILL_QUEUE_DEPTH + 5).start()
+            b = await _JsonReplica(depth=settings.PROXY_SPILL_QUEUE_DEPTH + 5).start()
+            try:
+                async with api_server() as api:
+                    run_id, _ = await seed_service(api.db, "spilly", a.port, b.port)
+                    url = "/proxy/services/main/spilly/generate"
+                    shared = list(range(1, 70))
+                    resp = await api.client.post(url, json=_body(shared))
+                    assert resp.status == 200
+                    owner = a if a.requests else b
+                    other = b if a.requests else a
+                    # The owner just reported an over-bound depth; the peer
+                    # has never reported, so it counts as idle and attracts
+                    # the spill.
+                    resp = await api.client.post(url, json=_body(shared))
+                    assert resp.status == 200
+                    assert owner.requests == 1 and other.requests == 1
+                    dec = routing.state.decisions_for("spilly")
+                    assert dec[("prefix", "preferred")] == 1
+                    assert dec[("prefix", "spilled")] == 1
+            finally:
+                await a.stop()
+                await b.stop()
+
+    async def test_probe_flip_drops_endpoint_from_ring_and_sticky(self):
+        """A replica that stops answering its readiness probe is evicted from
+        the ring AND its sticky buckets immediately — not after the route
+        TTL — so hot prefixes re-pin to live replicas."""
+        with _Fixture():
+            live = await _JsonReplica().start()
+            # A port that is closed the moment we measure it: probe refused.
+            probe_sock = socket.socket()
+            probe_sock.bind(("127.0.0.1", 0))
+            dead_port = probe_sock.getsockname()[1]
+            probe_sock.close()
+            try:
+                async with api_server() as api:
+                    run_id, project_id = await seed_service(
+                        api.db, "flappy", live.port, dead_port
+                    )
+                    url = "/proxy/services/main/flappy/generate"
+                    # Build the ring over both endpoints (requests that hash
+                    # to the dead one 502-retry onto the live one).
+                    for i in range(8):
+                        resp = await api.client.post(
+                            url, json=_body([50 + i, 1, 2])
+                        )
+                        assert resp.status == 200
+                    ring = routing.state.ring(run_id)
+                    assert ("127.0.0.1", dead_port) in ring.endpoints
+
+                    await proxy_service.probe_service_replicas(
+                        api.db, project_id, "flappy"
+                    )
+                    assert ("127.0.0.1", dead_port) not in ring.endpoints
+                    assert all(
+                        ep != ("127.0.0.1", dead_port)
+                        for ep in ring.assignments.values()
+                    ), "sticky assignment still points at the not-ready replica"
+                    # Everything now routes to the live replica, first try.
+                    before = live.requests
+                    for i in range(4):
+                        resp = await api.client.post(
+                            url, json=_body([50 + i, 1, 2])
+                        )
+                        assert resp.status == 200
+                    assert live.requests == before + 4
+            finally:
+                await live.stop()
+
+    async def test_round_robin_policy_still_alternates(self):
+        """The configured round_robin policy (non-engine services) keeps the
+        pre-PR cursor behavior and is counted as fallback."""
+        with _Fixture():
+            settings.PROXY_ROUTING_POLICY = "round_robin"
+            a, b = await _JsonReplica().start(), await _JsonReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "rrsvc", a.port, b.port)
+                    url = "/proxy/services/main/rrsvc/generate"
+                    for _ in range(6):
+                        resp = await api.client.post(url, json=_body([1, 2]))
+                        assert resp.status == 200
+                    assert a.requests == 3 and b.requests == 3
+                    assert routing.state.decisions_for("rrsvc") == {
+                        ("round_robin", "fallback"): 6
+                    }
+            finally:
+                await a.stop()
+                await b.stop()
+
+
+SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+    r' (?P<value>-?(?:\d+(?:\.\d+)?(?:e[+-]?\d+)?|\+?Inf|NaN))$'
+)
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """Strict line-by-line Prometheus text-format parser: every non-comment
+    line must be a well-formed sample; HELP/TYPE must precede their family's
+    samples. Returns {family: {"type": ..., "samples": [(labels, value)]}}."""
+    families = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            families.setdefault(name, {"type": None, "samples": []})
+        elif line.startswith("# TYPE "):
+            _, _, name, type_ = line.split(" ", 3)
+            assert name in families, f"TYPE before HELP for {name}"
+            families[name]["type"] = type_
+        else:
+            m = SAMPLE_RE.match(line)
+            assert m, f"malformed exposition line: {line!r}"
+            name = m.group("name")
+            base = re.sub(r"_(bucket|sum|count)$", "", name)
+            family = families.get(name) or families.get(base)
+            assert family is not None, f"sample before HELP/TYPE: {line!r}"
+            labels = dict(LABEL_RE.findall(m.group("labels") or ""))
+            family["samples"].append((labels, m.group("value")))
+    return families
+
+
+class TestRoutingMetrics:
+    async def test_decision_counters_render_and_parse(self):
+        """The full /metrics exposition stays strictly parseable, and the new
+        family carries exactly the recorded (run, policy, outcome) counts."""
+        with _Fixture():
+            a, b = await _JsonReplica().start(), await _JsonReplica().start()
+            try:
+                async with api_server() as api:
+                    await seed_service(api.db, "metered", a.port, b.port)
+                    url = "/proxy/services/main/metered/generate"
+                    shared = list(range(1, 70))
+                    for i in range(5):
+                        resp = await api.client.post(
+                            url, json=_body(shared + [i])
+                        )
+                        assert resp.status == 200
+                    # One keyless request: counted as fallback.
+                    resp = await api.client.post(url, json={"max_tokens": 1})
+                    assert resp.status == 200
+
+                    resp = await api.client.get("/metrics")
+                    families = parse_exposition(await resp.text())
+                    fam = families["dstack_tpu_proxy_routing_decisions_total"]
+                    assert fam["type"] == "counter"
+                    got = {
+                        (ls["run"], ls["policy"], ls["outcome"]): float(v)
+                        for ls, v in fam["samples"]
+                        if ls.get("run") == "metered"
+                    }
+                    assert got == {
+                        ("metered", "prefix", "preferred"): 5.0,
+                        ("metered", "prefix", "fallback"): 1.0,
+                    }
+            finally:
+                await a.stop()
+                await b.stop()
+
+    async def test_family_renders_cold(self):
+        """HELP/TYPE are advertised before any decision is recorded, so
+        scrapers can discover the family from a cold server."""
+        with _Fixture():
+            async with api_server() as api:
+                resp = await api.client.get("/metrics")
+                families = parse_exposition(await resp.text())
+                fam = families["dstack_tpu_proxy_routing_decisions_total"]
+                assert fam["type"] == "counter"
+                assert fam["samples"] == []

@@ -1,0 +1,251 @@
+"""Multi-chip sharded serving (PR 16): mesh-aware prefill/decode and the
+checkpoint re-shard-on-restore path into the engine.
+
+The invariant everything leans on: tensor-parallel sharding is a PLACEMENT
+optimization — it must never change a single emitted token. Every sharded
+engine here is compared against the meshless engine at the SAME EngineConfig
+(itself pinned against a full-context greedy reference by
+test_serve_engine.py), in fp32 on CPU so argmax ties can't blur the
+comparison. The restore tests pin the other acceptance bar: a checkpoint
+saved on a dp/fsdp TRAIN mesh restores into the tp SERVE layout bit-exactly,
+including the weight-only int8 layout quantized after restore.
+
+Numerics on the virtual 8-device CPU mesh (conftest). TINY matches
+test_serve_engine/test_serve_tier2 so the meshless reference compilations
+are shared; the sharded fns compile once per (cfg, mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import serve as serve_lib
+from dstack_tpu.workloads import sharding as sharding_lib
+from dstack_tpu.workloads import train as train_lib
+from dstack_tpu.workloads.checkpoint import CheckpointManager
+from dstack_tpu.workloads.config import get_config
+from dstack_tpu.workloads.sharding import make_mesh, make_serve_mesh
+
+TINY = get_config(
+    "test", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+    vocab_size=251, max_seq_len=128, dtype="float32", param_dtype="float32",
+    remat=False,
+)
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16]]
+
+# 18 tokens = 2 full pages of 8 + a tail (test_serve_tier2's shared prefix):
+# long enough that prefix matching covers whole blocks.
+SHARED_PREFIX = [5, 9, 13, 2, 44, 17, 81, 3, 7, 7, 101, 55, 13, 24, 9, 16,
+                 31, 8]
+
+# The preemption geometry shared with test_serve_engine/test_serve_tier2:
+# pool sized so decode growth forces preemption of the youngest request.
+PREEMPT_POOL = dict(page_size=4, num_pages=7, max_batch=3, max_seq=96)
+PREEMPT_PROMPTS = [[i + 1, i + 2, i + 3, i + 4, i + 5] for i in (0, 10, 20)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model_lib.init_params(TINY, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def tp2_mesh():
+    # TINY has n_kv_heads=2: tp=2 is the widest tensor-parallel degree it
+    # validates at. One mesh object for the whole module so the jitted fns
+    # (memoized per (cfg, quant, impl, mesh)) compile exactly once.
+    return make_serve_mesh(2, devices=jax.devices()[:2])
+
+
+def make_engine(params, mesh=None, **overrides) -> serve_lib.ServeEngine:
+    kwargs = dict(page_size=8, num_pages=32, max_batch=4, max_seq=128)
+    kwargs.update(overrides)
+    return serve_lib.ServeEngine(
+        TINY, serve_lib.EngineConfig(**kwargs), params=params, mesh=mesh
+    )
+
+
+def drain(engine, limit=3000):
+    steps = 0
+    while engine.has_work():
+        engine.step()
+        steps += 1
+        assert steps < limit, "engine never drained"
+    return steps
+
+
+def run_pair(params, mesh, prompts, max_new, **cfg):
+    """Token streams from a sharded and a meshless engine at the same
+    EngineConfig, same submission order."""
+    out = []
+    for m in (mesh, None):
+        engine = make_engine(params, mesh=m, **cfg)
+        reqs = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+        drain(engine)
+        out.append([r.tokens for r in reqs])
+    return out
+
+
+class TestShardedEquivalence:
+    def test_weights_and_pages_actually_sharded(self, params, tp2_mesh):
+        """Not a replicated copy: column-parallel projections and the KV page
+        pool each live split across the pair of devices."""
+        engine = make_engine(params, mesh=tp2_mesh)
+        assert engine.mesh_desc == "dd1xtp2"
+        wq = engine._serve_params["wq"]
+        assert len(wq.sharding.device_set) == 2
+        shard_shape = wq.sharding.shard_shape(wq.shape)
+        assert shard_shape[-1] == wq.shape[-1] // 2  # heads split over tp
+        kp = engine.k_pages
+        assert kp.sharding.shard_shape(kp.shape)[3] == kp.shape[3] // 2
+        # Logits come back replicated: host-side argmax sees the full vocab.
+        assert engine._serve_params["embed"].sharding.is_fully_replicated
+
+    @pytest.mark.parametrize(
+        "prefix_cache,spec_tokens",
+        [(False, 0), (True, 0), (False, 2), (True, 2)],
+        ids=["tier1", "prefix", "spec", "prefix+spec"],
+    )
+    def test_token_identical_to_meshless(self, params, tp2_mesh, prefix_cache,
+                                         spec_tokens):
+        """The matrix: sharded == meshless across the tier-2 feature grid.
+        Shared-prefix prompts make the prefix-cache variants exercise real
+        cross-request hits on the sharded page pool."""
+        prompts = PROMPTS + [SHARED_PREFIX + [40 + i] for i in range(3)]
+        sharded, meshless = run_pair(
+            params, tp2_mesh, prompts, 8,
+            prefill_chunk=4, prefix_cache=prefix_cache,
+            spec_tokens=spec_tokens,
+        )
+        assert sharded == meshless
+
+    def test_token_identical_under_preemption(self, params, tp2_mesh):
+        """Preempt/resume refolds generated tokens into the prompt and
+        re-prefills — on the sharded engine that path must replay through the
+        sharded chunk fn to the same streams."""
+        sharded, meshless = run_pair(
+            params, tp2_mesh, PREEMPT_PROMPTS, 20,
+            prefill_chunk=4, prefix_cache=True, **PREEMPT_POOL
+        )
+        engine = make_engine(params, mesh=tp2_mesh, prefill_chunk=4,
+                             prefix_cache=True, **PREEMPT_POOL)
+        reqs = [engine.submit(p, max_new_tokens=20) for p in PREEMPT_PROMPTS]
+        drain(engine)
+        assert max(r.preemptions for r in reqs) >= 1, (
+            "pool was sized to force preemption"
+        )
+        assert sharded == meshless
+
+    def test_int8_token_identical_to_meshless_int8(self, params, tp2_mesh):
+        """Weight-only int8 on the sharded engine: quantized layout shards
+        over tp and still matches the meshless int8 engine token for token."""
+        sharded, meshless = run_pair(
+            params, tp2_mesh, PROMPTS, 6, quant="int8"
+        )
+        assert sharded == meshless
+        engine = make_engine(params, mesh=tp2_mesh, quant="int8")
+        wq_q = engine._serve_params["wq_q"]
+        assert wq_q.sharding.shard_shape(wq_q.shape)[-1] == wq_q.shape[-1] // 2
+
+
+# tp=4 needs every sharded axis divisible by 4 (validate_serve_mesh):
+# n_kv_heads=4 is the one knob TINY lacks.
+RESTORE_CFG = get_config(
+    "test", n_layers=2, d_model=64, n_heads=4, n_kv_heads=4, d_ff=128,
+    vocab_size=251, max_seq_len=32, dtype="float32", param_dtype="float32",
+    remat=False,
+)
+
+
+@pytest.fixture(scope="module")
+def saved_train_checkpoint(tmp_path_factory):
+    """One dp2/fsdp4 TrainState checkpoint shared by the restore tests."""
+    ckpt_dir = tmp_path_factory.mktemp("ckpt")
+    mesh = make_mesh(dp=2, fsdp=4, devices=jax.devices()[:8])
+    optimizer = train_lib.make_optimizer()
+    state = train_lib.init_train_state(
+        RESTORE_CFG, jax.random.PRNGKey(0), optimizer, mesh
+    )
+    mgr = CheckpointManager(str(ckpt_dir), process_index=0, process_count=1)
+    mgr.save(3, state, mesh_shape=dict(mesh.shape), block=True)
+    assert mgr.save_errors == 0, mgr.last_error
+    host = {k: np.asarray(v) for k, v in state.params.items()}
+    return str(ckpt_dir), host, dict(mesh.shape)
+
+
+class TestReshardOnRestore:
+    def test_dp2_fsdp4_to_tp4_bit_identical(self, saved_train_checkpoint):
+        """The tentpole acceptance bar: a train-mesh checkpoint lands in the
+        tp4 serve layout with every param leaf bit-identical — and only the
+        .params subtree was materialized (the template carries no optimizer
+        moments)."""
+        ckpt_dir, host, train_shape = saved_train_checkpoint
+        serve_mesh = make_serve_mesh(4, devices=jax.devices()[:4])
+        params, manifest = serve_lib.load_serve_params(
+            ckpt_dir, RESTORE_CFG, mesh=serve_mesh
+        )
+        assert manifest["mesh"] == train_shape
+        assert set(params) == set(host)
+        shardings = sharding_lib.serve_param_sharding(serve_mesh, "none")
+        for key, leaf in params.items():
+            assert np.array_equal(np.asarray(leaf), host[key]), (
+                f"{key} diverged across the reshard"
+            )
+            assert leaf.sharding == shardings[key], key
+        # Actually distributed, not 4 replicas: a column-parallel projection
+        # holds 1/4 of its last axis per device.
+        wq = params["wq"]
+        assert wq.sharding.shard_shape(wq.shape)[-1] == wq.shape[-1] // 4
+
+    def test_restore_then_int8_matches_meshless_quantization(
+        self, saved_train_checkpoint
+    ):
+        """quant="int8" on restore: the sharded quantized layout is leaf-wise
+        bit-identical to quantizing the original host tree, and the fp
+        projections are gone (the layout the engine adopts as-is)."""
+        ckpt_dir, host, _ = saved_train_checkpoint
+        serve_mesh = make_serve_mesh(4, devices=jax.devices()[:4])
+        params, _ = serve_lib.load_serve_params(
+            ckpt_dir, RESTORE_CFG, mesh=serve_mesh, quant="int8"
+        )
+        ref = serve_lib.quantize_serve_params(
+            {k: jax.numpy.asarray(v) for k, v in host.items()}
+        )
+        assert set(params) == set(ref)
+        assert "wq" not in params and "lm_head" not in params
+        for key in ref:
+            assert np.array_equal(np.asarray(params[key]), np.asarray(ref[key])), (
+                f"int8 leaf {key} diverged from meshless quantization"
+            )
+
+    def test_meshless_restore_matches_host_tree(self, saved_train_checkpoint):
+        """mesh=None (single-chip dev serving) reads the same bytes."""
+        ckpt_dir, host, _ = saved_train_checkpoint
+        params, _ = serve_lib.load_serve_params(ckpt_dir, RESTORE_CFG)
+        for key, leaf in params.items():
+            assert np.array_equal(np.asarray(leaf), host[key]), key
+
+    def test_restored_params_serve_identically(self, saved_train_checkpoint):
+        """End to end: an engine built from the tp4-restored params emits the
+        same tokens as one built from the original host tree, meshless."""
+        ckpt_dir, host, _ = saved_train_checkpoint
+        serve_mesh = make_serve_mesh(4, devices=jax.devices()[:4])
+        params, _ = serve_lib.load_serve_params(
+            ckpt_dir, RESTORE_CFG, mesh=serve_mesh
+        )
+        ecfg = serve_lib.EngineConfig(page_size=8, num_pages=16, max_batch=2,
+                                      max_seq=32)
+        sharded = serve_lib.ServeEngine(
+            RESTORE_CFG, ecfg, params=params, mesh=serve_mesh
+        )
+        meshless = serve_lib.ServeEngine(
+            RESTORE_CFG, ecfg,
+            params={k: jax.numpy.asarray(v) for k, v in host.items()},
+        )
+        streams = []
+        for engine in (sharded, meshless):
+            reqs = [engine.submit(p, max_new_tokens=4) for p in PROMPTS[:2]]
+            drain(engine)
+            streams.append([r.tokens for r in reqs])
+        assert streams[0] == streams[1]
