@@ -72,10 +72,12 @@ bench-serve:
 bench-routing:
 	JAX_PLATFORMS=cpu python -c "import json, bench; print(json.dumps(bench.bench_routing()))"
 
-# Kernel smoke: every in-repo Pallas kernel (flash fwd+bwd, paged decode),
-# the int8 quantized matmul, and the collective-matmul ring, in CPU interpret
-# mode — one JSON line with max error vs the XLA references; >1e-4 is a
-# non-zero exit. Run this before a TPU submit touching kernel code.
+# Kernel smoke: every in-repo Pallas kernel (flash + splash fwd+bwd, paged
+# decode), the int8/fp8 quantized matmuls, and both collective-matmul rings
+# (tp reduce-scatter + fsdp all-gather), in CPU interpret mode — one JSON
+# line with max error vs the XLA references. Exits non-zero past tolerance
+# (attention/collective >1e-4, int8 rel >5%, fp8 rel >10%). Run this before
+# a TPU submit touching kernel code.
 bench-kernels:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  python -c "import json, bench; print(json.dumps(bench.bench_kernels()))"

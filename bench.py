@@ -36,14 +36,17 @@ def _run_train_variant(
     mesh=None,
     batch_spec=None,
     cfg_overrides=None,
+    autotune=False,
 ) -> dict:
     """One variant of the train step: returns compile_s + p50/p90/median step
     seconds. prefetch=0 feeds one static device-resident batch (the legacy
     path); prefetch>0 streams fresh host batches through the data-pipeline
     prefetcher so the host->HBM transfer overlaps the previous step.
-    cfg_overrides (attn_impl/quant/tp_overlap — the PR 7 kernel levers) are
-    dataclass-replaced onto cfg so the sweep attributes each lever
-    separately."""
+    cfg_overrides (attn_impl/quant/tp_overlap/fsdp_overlap/attn_window — the
+    kernel levers) are dataclass-replaced onto cfg so the sweep attributes
+    each lever separately. autotune=True sweeps flash/splash block sizes for
+    this shape first (kernels/autotune.py) so the variant's compile picks up
+    the tuned winner — the --autotune CLI path, measured."""
     import dataclasses
     import statistics
 
@@ -54,6 +57,16 @@ def _run_train_variant(
 
     if cfg_overrides:
         cfg = dataclasses.replace(cfg, **cfg_overrides)
+    if autotune and cfg.attn_impl in ("flash", "splash"):
+        import jax.numpy as jnp
+
+        from dstack_tpu.workloads.kernels import autotune as autotune_lib
+
+        probe = jax.random.normal(
+            jax.random.PRNGKey(0), (1, seq, 1, cfg.head_dim), jnp.float32
+        )
+        autotune_lib.tune(cfg.attn_impl, probe, probe, probe,
+                          causal=True, window=cfg.attn_window)
     optimizer = train_lib.make_optimizer(mu_dtype="bfloat16")
     state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), optimizer, mesh)
     step_fn = train_lib.make_train_step(cfg, optimizer, mesh, grad_accum=grad_accum)
@@ -170,9 +183,12 @@ def _variant_plan(batch: int) -> list:
     CPU smoke — one list so the smoke always covers every variant the
     headline MFU can be attributed to. Pipeline variants (accum/prefetch,
     PR 4) plus the kernel/precision levers (PR 7): the in-repo flash kernel,
-    int8 quantized matmuls, and their combination. The tp_overlap collective-
-    matmul variant needs a tp>1 mesh and is planned separately
-    (_tp_variant_plan)."""
+    int8 quantized matmuls, and their combination; plus the raw-speed
+    round-two levers: fp8 matmuls (v5p+ MXUs; elsewhere the variant records
+    validate_config's rejection), block-sparse splash attention (dense-causal
+    and local-window), and autotuned flash block sizes. The tp_overlap /
+    fsdp_overlap collective-matmul variants need a multi-device mesh and are
+    planned separately (_tp_variant_plan / _fsdp_variant_plan)."""
     return [
         ("static", dict(batch=batch, grad_accum=1, prefetch=0)),
         ("prefetch2", dict(batch=batch, grad_accum=1, prefetch=2)),
@@ -184,6 +200,16 @@ def _variant_plan(batch: int) -> list:
         ("flash_int8", dict(batch=batch, grad_accum=1, prefetch=2,
                             cfg_overrides={"attn_impl": "flash",
                                            "quant": "int8"})),
+        ("fp8", dict(batch=batch, grad_accum=1, prefetch=2,
+                     cfg_overrides={"quant": "fp8"})),
+        ("splash", dict(batch=batch, grad_accum=1, prefetch=2,
+                        cfg_overrides={"attn_impl": "splash"})),
+        ("splash_window", dict(batch=batch, grad_accum=1, prefetch=2,
+                               cfg_overrides={"attn_impl": "splash",
+                                              "attn_window": 64})),
+        ("flash_autotuned", dict(batch=batch, grad_accum=1, prefetch=2,
+                                 cfg_overrides={"attn_impl": "flash"},
+                                 autotune=True)),
     ]
 
 
@@ -198,6 +224,19 @@ def _tp_variant_plan(batch: int) -> list:
         ("tp_overlap_int8", dict(batch=batch, grad_accum=1, prefetch=2,
                                  cfg_overrides={"tp_overlap": True,
                                                 "quant": "int8"})),
+    ]
+
+
+def _fsdp_variant_plan(batch: int) -> list:
+    """FSDP allgather-matmul ring variants; callers supply a dp*fsdp>1 mesh.
+    Attribution-only in bench_tpu_train (different device count than the
+    1-chip headline); the pipeline smoke runs them on its main mesh."""
+    return [
+        ("fsdp_overlap", dict(batch=batch, grad_accum=1, prefetch=2,
+                              cfg_overrides={"fsdp_overlap": True})),
+        ("fsdp_overlap_int8", dict(batch=batch, grad_accum=1, prefetch=2,
+                                   cfg_overrides={"fsdp_overlap": True,
+                                                  "quant": "int8"})),
     ]
 
 
@@ -244,6 +283,36 @@ def bench_tpu_train() -> dict:
             from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh
 
             mesh = make_mesh(dp=1, fsdp=1, tp=n_dev, sp=1)
+            with mesh:
+                v = _run_train_variant(
+                    cfg, seq=seq, mesh=mesh, batch_spec=BATCH_SPEC, **kw
+                )
+            v["devices"] = n_dev
+            v["tok_per_sec_per_chip"] = round(
+                v["batch"] * seq / v.pop("median_s") / n_dev, 1
+            )
+            variants[name] = v
+        except Exception as e:  # noqa: BLE001
+            variants[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # FSDP allgather-matmul attribution: needs a dp*fsdp>1 mesh, so it runs
+    # across ALL local chips on a pure-fsdp mesh — attribution-only, like the
+    # tp variants.
+    for name, kw in _fsdp_variant_plan(batch):
+        if n_dev < 2:
+            variants[name] = {
+                "skipped": f"needs >1 device for the fsdp ring (have {n_dev})"
+            }
+            continue
+        if cfg.d_model % n_dev:
+            variants[name] = {
+                "skipped": f"dp*fsdp={n_dev} does not divide d_model={cfg.d_model}"
+            }
+            continue
+        try:
+            from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh
+
+            mesh = make_mesh(dp=1, fsdp=n_dev, tp=1, sp=1)
             with mesh:
                 v = _run_train_variant(
                     cfg, seq=seq, mesh=mesh, batch_spec=BATCH_SPEC, **kw
@@ -324,12 +393,24 @@ def bench_train_pipeline() -> dict:
                 cfg, seq=seq, steps=steps, mesh=tp_mesh, batch_spec=BATCH_SPEC,
                 **kw
             )
+    # FSDP allgather-matmul variants on the MAIN dp2xfsdp4 mesh (dp*fsdp=8
+    # divides the test config's d_model) — proves the weight-shard ring end
+    # to end on CPU.
+    with mesh:
+        for name, kw in _fsdp_variant_plan(batch):
+            variants[name] = _run_train_variant(
+                cfg, seq=seq, steps=steps, mesh=mesh, batch_spec=BATCH_SPEC,
+                **kw
+            )
 
     rate = {k: v["batch"] * seq / v.pop("median_s") for k, v in variants.items()}
-    # tp variants ran under different sharding (tp=4 mesh) — attribution only,
-    # never the headline, matching bench_tpu_train's _tp_variant_plan contract.
-    tp_names = {name for name, _ in _tp_variant_plan(batch)}
-    best = max((k for k in rate if k not in tp_names), key=rate.get)
+    # tp/fsdp overlap variants are attribution-only — never the headline,
+    # matching bench_tpu_train's contract (tp runs under different sharding;
+    # fsdp keeps the rule for consistency even on the main mesh).
+    excluded = {name for name, _ in _tp_variant_plan(batch)} | {
+        name for name, _ in _fsdp_variant_plan(batch)
+    }
+    best = max((k for k in rate if k not in excluded), key=rate.get)
     return {
         "metric": "train_pipeline_smoke_tok_per_sec",
         "value": round(rate[best], 1),
@@ -643,7 +724,55 @@ def bench_scheduler() -> dict:
                     break
             return time.perf_counter() - t0
 
+    async def submit_assign_latency(nudge: bool, n: int = 10,
+                                    interval: float = 0.4) -> list:
+        """Submit->assign latency with the REAL periodic loop running: each
+        submit waits until its job leaves 'submitted'. With the wake nudge
+        (submit_run sets the loop's event) the pass starts immediately; with
+        the nudge disabled the job waits out the remainder of the poll
+        interval — the latency the nudge removes."""
+        from dstack_tpu.server import background as bg
+
+        FakeRunnerClient.reset()
+        tasks.get_runner_client = FakeRunnerClient.for_jpd
+        lats = []
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            sched = bg.BackgroundScheduler()
+            sched.add_periodic(
+                lambda: tasks.process_submitted_jobs(api.db, batch=25),
+                interval,
+                "process_submitted_jobs",
+            )
+            if not nudge:
+                # Pre-nudge behavior: the loop still polls on its interval but
+                # submit_run's wake() finds no event to set.
+                bg._WAKE_EVENTS.pop("process_submitted_jobs", None)
+            try:
+                for i in range(n):
+                    name = f"lat-{'n' if nudge else 'p'}-{i}"
+                    t0 = time.perf_counter()
+                    await api.post(
+                        "/api/project/main/runs/submit",
+                        tpu_task_spec(name, "v5e-8"),
+                    )
+                    while True:
+                        row = await api.db.fetchone(
+                            "SELECT status FROM jobs WHERE run_name = ?", (name,)
+                        )
+                        if row is not None and row["status"] != "submitted":
+                            break
+                        await asyncio.sleep(0.002)
+                    lats.append(time.perf_counter() - t0)
+            finally:
+                await sched.stop()
+        return lats
+
     dt = asyncio.run(run())
+    lat_nudge = asyncio.run(submit_assign_latency(nudge=True))
+    lat_poll = asyncio.run(submit_assign_latency(nudge=False))
+    import statistics
+
     rate = N * 60.0 / dt
     return {
         "metric": "runs_scheduled_to_done_per_min",
@@ -664,6 +793,13 @@ def bench_scheduler() -> dict:
                     ("provision", "dstack_tpu_run_provision_duration_seconds"),
                     ("pull", "dstack_tpu_run_pull_duration_seconds"),
                 )
+            },
+            # Submit->assign latency through the live periodic loop: "nudge"
+            # = submit_run wakes process_submitted_jobs (current behavior),
+            # "interval_poll" = the pre-nudge fixed-interval sleep.
+            "submit_to_assign_p50_ms": {
+                "nudge": round(statistics.median(lat_nudge) * 1000.0, 1),
+                "interval_poll": round(statistics.median(lat_poll) * 1000.0, 1),
             },
         },
     }
@@ -2148,6 +2284,46 @@ def bench_kernels() -> dict:
         "rel_err": round(rel, 5),
     }
 
+    # -- splash fwd + bwd vs masked reference (window + dense causal) ------
+    from dstack_tpu.workloads.kernels import splash_attention
+    from dstack_tpu.workloads.kernels.splash import splash_reference
+
+    t0 = time.perf_counter()
+    sp_fwd_err = 0.0
+    sp_bwd_err = 0.0
+    for window in (0, 48):
+        so = splash_attention(q, k, v, causal=True, window=window)
+        sr = splash_reference(q, k, v, causal=True, window=window)
+        sp_fwd_err = max(sp_fwd_err, float(jnp.max(jnp.abs(so - sr))))
+
+        def sloss(fn, w=window):
+            return lambda q, k, v: jnp.sum(
+                jnp.sin(fn(q, k, v, causal=True, window=w))
+            )
+
+        gs = jax.grad(sloss(splash_attention), argnums=(0, 1, 2))(q, k, v)
+        gm = jax.grad(sloss(splash_reference), argnums=(0, 1, 2))(q, k, v)
+        sp_bwd_err = max(
+            sp_bwd_err,
+            max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gs, gm)),
+        )
+    results["splash"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "fwd_max_err": sp_fwd_err,
+        "bwd_max_err": sp_bwd_err,
+    }
+
+    # -- fp8 matmul error bound --------------------------------------------
+    t0 = time.perf_counter()
+    yf8 = quant_lib.fp8_matmul(x, w)
+    fp8_rel = float(
+        jnp.linalg.norm(yf8 - yr) / jnp.maximum(jnp.linalg.norm(yr), 1e-9)
+    )
+    results["fp8_matmul"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "rel_err": round(fp8_rel, 5),
+    }
+
     # -- collective matmul == all-reduce matmul on an 8-device mesh --------
     mesh = make_mesh(dp=1, fsdp=2, tp=4, sp=1)
     xb = jax.random.normal(ks[2], (8, 16, 64))
@@ -2161,20 +2337,38 @@ def bench_kernels() -> dict:
         "max_err": cerr,
     }
 
+    # -- FSDP allgather matmul == gathered matmul on the same mesh ---------
+    from dstack_tpu.workloads.kernels import allgather_matmul
+
+    t0 = time.perf_counter()
+    with mesh:
+        ya = jax.jit(lambda a, b: allgather_matmul(a, b, mesh))(xb, wb)
+    aerr = float(jnp.max(jnp.abs(ya - jnp.einsum("btk,kn->btn", xb, wb))))
+    results["allgather_matmul"] = {
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "max_err": aerr,
+    }
+
     worst = max(
         results["flash"]["fwd_max_err"],
         results["flash"]["bwd_max_err"],
+        results["splash"]["fwd_max_err"],
+        results["splash"]["bwd_max_err"],
         results["paged_decode"]["max_err"],
         results["paged_chunk"]["max_err"],
         results["collective_matmul"]["max_err"],
+        results["allgather_matmul"]["max_err"],
     )
-    # int8 is lossy by design — gauged against its own rounding-noise bound
-    # (~1% on gaussian operands) rather than the exact-kernel 1e-4 floor.
+    # int8/fp8 are lossy by design — gauged against their own rounding-noise
+    # bounds on gaussian operands (~1% for int8's 256 levels; fp8-e4m3 keeps
+    # only a 3-bit mantissa, so ~4-5% after the dual per-channel quant)
+    # rather than the exact-kernel 1e-4 floor.
     int8_rel = results["int8_matmul"]["rel_err"]
-    if worst > 1e-4 or int8_rel > 0.05:
+    fp8_rel = results["fp8_matmul"]["rel_err"]
+    if worst > 1e-4 or int8_rel > 0.05 or fp8_rel > 0.1:
         raise RuntimeError(
-            f"kernel smoke out of bounds (exact>{1e-4} or int8_rel>0.05): "
-            f"{results}"
+            f"kernel smoke out of bounds (exact>{1e-4}, int8_rel>0.05, or "
+            f"fp8_rel>0.1): {results}"
         )
     return {
         "metric": "kernel_smoke_max_err",

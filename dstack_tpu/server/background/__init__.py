@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Awaitable, Callable, List
+from typing import Awaitable, Callable, Dict, List
 
 from aiohttp import web
 
@@ -13,15 +13,36 @@ from dstack_tpu.server import settings
 
 logger = logging.getLogger(__name__)
 
+# Wake events by loop name, registered by add_periodic. wake() sets one to cut
+# a loop's current sleep short — the submit->assign fast path: a freshly
+# submitted run is picked up by process_submitted_jobs on the next scheduler
+# tick instead of up to a full interval later. Module-level (not per
+# scheduler) so services code can nudge without holding the scheduler; the
+# live server runs one scheduler, and in tests the latest registration wins.
+_WAKE_EVENTS: Dict[str, asyncio.Event] = {}
+
+
+def wake(name: str) -> None:
+    """Nudge the named periodic loop to start its next pass now. No-op when
+    the loop isn't running (unit tests calling services directly, shutdown);
+    idempotent while a nudge is already pending (Event.set)."""
+    ev = _WAKE_EVENTS.get(name)
+    if ev is not None:
+        ev.set()
+
 
 class BackgroundScheduler:
     def __init__(self) -> None:
         self._tasks: List[asyncio.Task] = []
+        self._names: List[str] = []
 
     def add_periodic(
         self, fn: Callable[[], Awaitable[None]], interval: float, name: str
     ) -> None:
         from dstack_tpu.core import tracing
+
+        event = asyncio.Event()
+        _WAKE_EVENTS[name] = event
 
         async def loop() -> None:
             import time
@@ -33,27 +54,40 @@ class BackgroundScheduler:
                 # anchor is set BEFORE the pass runs, so a pass that overruns
                 # its interval shows up as lag on the next pass (an anchor
                 # taken after fn() would hide exactly the overload this gauge
-                # exists to catch).
+                # exists to catch). A wake() nudge starts a pass EARLY, which
+                # max(0, ...) reads as zero lag — on schedule, not behind it.
                 lag = max(0.0, now - expected) if expected is not None else 0.0
                 tracing.set_gauge(
                     "dstack_tpu_background_loop_lag_seconds", {"task": name}, lag
                 )
                 expected = now + interval
+                # Cleared before fn() runs: a nudge landing DURING the pass
+                # (a submit racing the DB query) leaves the event set, so the
+                # wait below returns immediately and the next pass serves it
+                # — no lost wakeup.
+                event.clear()
                 try:
                     await fn()
                 except asyncio.CancelledError:
                     raise
                 except Exception:
                     logger.exception("background task %s failed", name)
-                await asyncio.sleep(interval)
+                try:
+                    await asyncio.wait_for(event.wait(), timeout=interval)
+                except asyncio.TimeoutError:
+                    pass
 
         self._tasks.append(asyncio.create_task(loop(), name=f"bg:{name}"))
+        self._names.append(name)
 
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+        for name in self._names:
+            _WAKE_EVENTS.pop(name, None)
+        self._names.clear()
 
 
 def start_background_tasks(app: web.Application) -> BackgroundScheduler:

@@ -315,6 +315,8 @@ def attention_core(
     *,
     causal: bool = True,
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    window: int = 0,
+    doc_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The one attention dispatch the model/MoE/pipeline forwards share.
 
@@ -330,6 +332,11 @@ def attention_core(
       Falls back to blockwise when the sequence isn't block-divisible or tp
       doesn't divide the KV heads (config.validate_config raises loudly for
       CLI-requested combos; mid-model we degrade instead of crashing).
+    - ``splash``: the block-SPARSE kernel (kernels/splash.py): causal +
+      ``window`` local band + optional ``doc_ids`` same-document masks, with
+      fully-masked q/kv block pairs skipped in the grid. Degrades to the
+      masked materializing reference (``splash_reference``) on shapes the
+      kernel can't tile — the only core that honors window/doc masks.
     - ``flash_tpu``: the public kernel explicitly (meshless TPU only).
     - ``xla``/``blockwise``: the online-softmax scan; ``plain``: materialized
       scores.
@@ -342,6 +349,31 @@ def attention_core(
     impl = attn_impl
     if impl == "auto":
         impl = "flash_tpu" if (mesh is None and flash_available()) else "blockwise"
+    if impl == "splash":
+        from dstack_tpu.workloads.kernels import splash as splash_lib
+
+        t, s_len = q.shape[1], k.shape[1]
+        if (kernels.pick_flash_block(t) is None
+                or kernels.pick_flash_block(s_len) is None):
+            return splash_lib.splash_reference(
+                q, k, v, causal=causal, window=window, doc_ids=doc_ids
+            )
+        if mesh is not None:
+            tp = mesh.shape.get("tp", 1)
+            data = 1
+            for a in batch_axes:
+                data *= mesh.shape.get(a, 1)
+            if q.shape[0] % data or q.shape[2] % tp or k.shape[2] % tp:
+                return splash_lib.splash_reference(
+                    q, k, v, causal=causal, window=window, doc_ids=doc_ids
+                )
+            return splash_lib.splash_attention_sharded(
+                q, k, v, mesh, causal=causal, window=window, doc_ids=doc_ids,
+                batch_axes=batch_axes,
+            )
+        return splash_lib.splash_attention(
+            q, k, v, causal=causal, window=window, doc_ids=doc_ids
+        )
     if impl == "flash":
         t, s_len = q.shape[1], k.shape[1]
         if (kernels.pick_flash_block(t) is None

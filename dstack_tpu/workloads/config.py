@@ -29,17 +29,34 @@ class LlamaConfig:
     #   "flash"     — the in-repo Pallas kernel (kernels/flash.py): compiled
     #                 on TPU, interpreted on CPU so tests run the real kernel;
     #   "flash_tpu" — the public jax.experimental.pallas.ops TPU kernel;
+    #   "splash"    — block-SPARSE flash (kernels/splash.py): skips fully-
+    #                 masked q/kv block pairs (causal + attn_window local
+    #                 band + optional document masks) — the long-context
+    #                 kernel;
     #   "plain"     — materialize [T,S] scores (fastest for moderate T).
     # Ring attention over `sp` always uses the blockwise accumulator.
     attn_impl: str = "blockwise"
-    # Matmul precision: "none" (bf16/fp32 per dtype) or "int8" — dynamically
-    # quantized int8 dot with fp32 accumulation and straight-through gradients
+    # Local-attention window in tokens (0 = full causal): with attn_impl=
+    # splash each query attends to the last `attn_window` positions only and
+    # the kernel skips KV blocks outside the band — O(T·W) instead of
+    # O(T²/2) score work.
+    attn_window: int = 0
+    # Matmul precision: "none" (bf16/fp32 per dtype), "int8", or "fp8"
+    # (e4m3, v5p+ only — validate_config gates it) — dynamically quantized
+    # dot with fp32 accumulation and straight-through gradients
     # (workloads/quantize.py). Serving quantizes weights only.
     quant: str = "none"
     # Collective-matmul overlap for the TP down-projections: decompose the
     # local matmul into a ppermute ring so the tp all-reduce hides under MXU
     # compute (kernels/collective.py). No-op when tp == 1.
     tp_overlap: bool = False
+    # Collective-matmul overlap for the FSDP all-gather of column-parallel
+    # weights (wq/wk/wv/w_gate/w_up): rotate weight shards around the
+    # (dp, fsdp) ring, each hop's chunk matmul hiding the next transfer,
+    # instead of XLA's monolithic gather-on-use (kernels/collective.py
+    # allgather_matmul). No-op when dp*fsdp == 1. The lm_head is excluded:
+    # its [D, V] gather amortizes over one call per step, not per layer.
+    fsdp_overlap: bool = False
     # Cross-entropy: chunk the vocab projection over the sequence so [B,T,V] fp32
     # logits are never fully materialized (0 = off). Trades ~2*d*V flops/token of
     # recompute for ~2 * B*T*V*4 bytes of HBM.
@@ -110,7 +127,8 @@ def get_config(name: str, **overrides) -> LlamaConfig:
     return cfg
 
 
-ATTN_IMPLS = ("auto", "xla", "blockwise", "plain", "flash", "flash_tpu")
+ATTN_IMPLS = ("auto", "xla", "blockwise", "plain", "flash", "flash_tpu",
+              "splash")
 
 
 def validate_config(
@@ -132,6 +150,30 @@ def validate_config(
             f"unknown attn_impl {cfg.attn_impl!r}; expected one of {ATTN_IMPLS}"
         )
     check_quant(cfg.quant)
+    if cfg.quant == "fp8":
+        from dstack_tpu.workloads.kernels.platform import (
+            chip_generation,
+            supports_fp8,
+        )
+
+        gen = chip_generation()
+        if not supports_fp8(gen):
+            raise ValueError(
+                f"quant=fp8 needs a chip generation with a native fp8 MXU "
+                f"path (v5p+); this host is {gen} where fp8 operands upcast "
+                f"in hardware — no throughput win, only precision loss. Use "
+                f"quant=int8 here, or submit to a v5p/v6e pool"
+            )
+    if cfg.attn_window:
+        if cfg.attn_window < 0:
+            raise ValueError(f"attn_window must be >= 0, got {cfg.attn_window}")
+        if cfg.attn_impl != "splash":
+            raise ValueError(
+                f"attn_window={cfg.attn_window} only applies to attn_impl="
+                f"splash (the block-sparse kernel that skips out-of-window "
+                f"blocks); attn_impl={cfg.attn_impl!r} would silently ignore "
+                f"the window"
+            )
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     if cfg.attn_impl == "flash_tpu" and mesh is not None:
@@ -144,7 +186,7 @@ def validate_config(
             "under a device mesh; use attn_impl=flash (the in-repo sharded "
             "kernel) or attn_impl=auto"
         )
-    if cfg.attn_impl in ("flash", "flash_tpu"):
+    if cfg.attn_impl in ("flash", "flash_tpu", "splash"):
         if sp > 1:
             raise ValueError(
                 f"attn_impl={cfg.attn_impl!r} does not compose with sequence "
@@ -169,11 +211,23 @@ def validate_config(
                     f"sequence length; seq={seq} has no power-of-two block "
                     f"(pad the sequence or use attn_impl=xla)"
                 )
-        if cfg.attn_impl == "flash" and tp > 1 and cfg.n_kv_heads % tp:
+        if (cfg.attn_impl in ("flash", "splash") and tp > 1
+                and cfg.n_kv_heads % tp):
             raise ValueError(
-                f"attn_impl=flash shards heads over tp={tp}, which must "
-                f"divide n_kv_heads={cfg.n_kv_heads} (whole GQA groups per "
-                f"shard); adjust the mesh or use attn_impl=xla"
+                f"attn_impl={cfg.attn_impl} shards heads over tp={tp}, which "
+                f"must divide n_kv_heads={cfg.n_kv_heads} (whole GQA groups "
+                f"per shard); adjust the mesh or use attn_impl=xla"
+            )
+    if cfg.fsdp_overlap and mesh is not None:
+        from dstack_tpu.workloads.kernels.collective import can_fsdp_overlap
+
+        if not can_fsdp_overlap(mesh, cfg.d_model):
+            data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            raise ValueError(
+                f"fsdp_overlap rotates weight shards around the dp*fsdp="
+                f"{data} ring, which needs dp*fsdp > 1 and d_model="
+                f"{cfg.d_model} divisible by it; adjust the mesh or drop "
+                f"--fsdp-overlap"
             )
     if cfg.tp_overlap and tp > 1 and batch and seq:
         from dstack_tpu.workloads.kernels.collective import can_overlap

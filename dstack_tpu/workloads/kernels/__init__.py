@@ -10,13 +10,23 @@ Modules:
 
 - ``flash``      — block-tiled online-softmax flash attention, forward +
                    custom-VJP backward (training).
+- ``splash``     — block-SPARSE flash attention: causal + local-window +
+                   document masks become per-block loop bounds, so
+                   fully-masked q/kv block pairs are never visited.
 - ``paged``      — single-query paged-KV decode attention (serving).
-- ``collective`` — collective matmul: ``shard_map``-decomposed einsum that
-                   interleaves partial matmuls with ``ppermute`` ring steps so
-                   tensor-parallel ICI transfers hide under MXU compute.
+- ``collective`` — collective matmuls: ``shard_map``-decomposed einsums that
+                   interleave partial matmuls with ``ppermute`` ring steps so
+                   parallelism-induced ICI transfers hide under MXU compute
+                   (TP reduce-scatter ring + FSDP all-gather ring).
+- ``autotune``   — persisted (block_q, block_kv) winners per (kernel, chip
+                   generation, head_dim, seq), consulted by flash/splash.
+- ``platform``   — chip-generation detection and interpret-mode defaults.
 """
 
-from dstack_tpu.workloads.kernels.collective import collective_matmul
+from dstack_tpu.workloads.kernels.collective import (
+    allgather_matmul,
+    collective_matmul,
+)
 from dstack_tpu.workloads.kernels.flash import (
     flash_attention,
     flash_attention_sharded,
@@ -26,12 +36,19 @@ from dstack_tpu.workloads.kernels.paged import (
     paged_chunk_attention_pallas,
     paged_decode_attention_pallas,
 )
+from dstack_tpu.workloads.kernels.splash import (
+    splash_attention,
+    splash_attention_sharded,
+)
 
 __all__ = [
+    "allgather_matmul",
     "collective_matmul",
     "flash_attention",
     "flash_attention_sharded",
     "paged_chunk_attention_pallas",
     "paged_decode_attention_pallas",
     "pick_flash_block",
+    "splash_attention",
+    "splash_attention_sharded",
 ]

@@ -1,0 +1,238 @@
+"""Autotuned (block_q, block_kv) tile sizes for the flash/splash kernels.
+
+``pick_flash_block`` is a one-line heuristic (largest power-of-two divisor);
+the REAL best tile depends on chip generation (VMEM size, MXU shape),
+head_dim, and sequence length — the bench rounds showed 512-blocks beating
+the public kernel's defaults ~6x on v5e forward, and there is no reason to
+believe one size wins everywhere. This module closes the loop:
+
+- ``tune()`` sweeps candidate (block_q, block_kv) pairs by timing the actual
+  kernel (fwd+bwd, the train shape) and persists the winner;
+- winners live in a JSON cache keyed ``kernel|generation|head_dim|seq`` —
+  the generation is IN the key so a cache written on v5e can never poison a
+  v5p job sharing the same filesystem;
+- ``lookup()`` is consulted at trace time by ``flash_attention`` /
+  ``splash_attention`` when the caller didn't pin blocks: cache file first,
+  then shipped defaults (v5e/v5p, measured on the bench rounds), then the
+  caller's heuristic. A corrupt or unwritable cache silently degrades to the
+  shipped defaults — tuning is advisory, never load-bearing.
+
+The cache directory defaults to ``~/.cache/dstack-tpu/autotune`` and is
+overridable with ``DSTACK_TPU_AUTOTUNE_DIR`` (CI sandboxes, read-only
+images, per-job scratch). Writes are atomic (tmp + rename) so concurrent
+workers at worst lose a race, never corrupt the file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+ENV_DIR = "DSTACK_TPU_AUTOTUNE_DIR"
+CACHE_FILE = "blocks.json"
+
+# Shipped per-generation winners from the dev-chip bench rounds (BASELINE.md):
+# large blocks win on both v5e and v5p until head_dim=128 long-seq VMEM
+# pressure caps v5e at 256-wide KV tiles. Entries are starting points — a
+# local tune() overrides them via the cache file.
+SHIPPED_DEFAULTS: Dict[str, Tuple[int, int]] = {}
+for _kernel in ("flash", "splash"):
+    for _seq in (1024, 2048, 4096, 8192):
+        for _hd in (64, 128):
+            SHIPPED_DEFAULTS[f"{_kernel}|v5p|{_hd}|{_seq}"] = (512, 512)
+            SHIPPED_DEFAULTS[f"{_kernel}|v5e|{_hd}|{_seq}"] = (
+                (512, 512) if _hd <= 64 or _seq <= 2048 else (512, 256)
+            )
+
+# (path, mtime) -> parsed cache, so trace-time lookups don't re-read the file.
+_memo: Optional[Tuple[Tuple[str, float], Dict[str, Tuple[int, int]]]] = None
+
+
+def cache_dir() -> str:
+    return os.environ.get(ENV_DIR) or os.path.expanduser(
+        "~/.cache/dstack-tpu/autotune"
+    )
+
+
+def cache_path() -> str:
+    return os.path.join(cache_dir(), CACHE_FILE)
+
+
+def _key(kernel: str, gen: str, head_dim: int, seq: int) -> str:
+    return f"{kernel}|{gen}|{int(head_dim)}|{int(seq)}"
+
+
+def _valid_blocks(v) -> Optional[Tuple[int, int]]:
+    try:
+        bq, bk = int(v[0]), int(v[1])
+    except (TypeError, ValueError, IndexError):
+        return None
+    if bq <= 0 or bk <= 0 or len(v) != 2:
+        return None
+    return bq, bk
+
+
+def _load_cache() -> Dict[str, Tuple[int, int]]:
+    """Parsed cache file; {} on missing/corrupt (shipped defaults then win).
+    Memoized on (path, mtime) so the per-trace cost is one stat call."""
+    global _memo
+    path = cache_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    if _memo is not None and _memo[0] == (path, mtime):
+        return _memo[1]
+    entries: Dict[str, Tuple[int, int]] = {}
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        if isinstance(raw, dict):
+            for k, v in raw.items():
+                blocks = _valid_blocks(v)
+                if blocks is not None:
+                    entries[str(k)] = blocks
+    except Exception:
+        entries = {}
+    _memo = ((path, mtime), entries)
+    return entries
+
+
+def lookup(
+    kernel: str,
+    head_dim: int,
+    seq: int,
+    gen: Optional[str] = None,
+) -> Optional[Tuple[int, int]]:
+    """Best-known (block_q, block_kv) for this kernel/chip/shape, or None
+    (caller falls back to its heuristic). Tuned winners beat shipped
+    defaults; the generation is part of the key on both layers."""
+    if gen is None:
+        from dstack_tpu.workloads.kernels.platform import chip_generation
+
+        gen = chip_generation()
+    key = _key(kernel, gen, head_dim, seq)
+    cached = _load_cache().get(key)
+    if cached is not None:
+        return cached
+    return SHIPPED_DEFAULTS.get(key)
+
+
+def record(
+    kernel: str,
+    head_dim: int,
+    seq: int,
+    blocks: Tuple[int, int],
+    gen: Optional[str] = None,
+) -> bool:
+    """Persist a tuned winner (atomic merge-write). Returns False instead of
+    raising on any filesystem trouble — the cache is advisory."""
+    global _memo
+    if gen is None:
+        from dstack_tpu.workloads.kernels.platform import chip_generation
+
+        gen = chip_generation()
+    blocks = _valid_blocks(blocks)
+    if blocks is None:
+        return False
+    try:
+        os.makedirs(cache_dir(), exist_ok=True)
+        path = cache_path()
+        entries = {k: list(v) for k, v in _load_cache().items()}
+        entries[_key(kernel, gen, head_dim, seq)] = list(blocks)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(entries, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        _memo = None
+        return True
+    except OSError:
+        return False
+
+
+def candidate_blocks(seq_len: int, limit: int = 3) -> Tuple[int, ...]:
+    """The largest ``limit`` power-of-two blocks dividing ``seq_len`` — the
+    sweep space per side. Small blocks only matter for tiny test shapes."""
+    from dstack_tpu.workloads.kernels.flash import _BLOCKS
+
+    divs = tuple(b for b in _BLOCKS if seq_len >= b and seq_len % b == 0)
+    return divs[:limit]
+
+
+def tune(
+    kernel: str,  # "flash" | "splash"
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    window: int = 0,
+    doc_ids=None,
+    gen: Optional[str] = None,
+    interpret: Optional[bool] = None,
+    include_bwd: bool = True,
+    repeats: int = 2,
+    persist: bool = True,
+) -> Dict:
+    """Sweep (block_q, block_kv) candidates by timing the REAL kernel on the
+    given operands (fwd+bwd by default — the train shape), persist the winner
+    keyed (kernel, generation, head_dim, seq), and return the report:
+    ``{"blocks": (bq, bk), "gen": ..., "sweep": {"bqxbk": seconds}}``.
+
+    Runs OUTSIDE any trace (it times concrete executions) — call it once
+    before compile, like the bench's "autotuned" variant or train.py's
+    ``--autotune``."""
+    import jax
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads.kernels import flash as flash_lib
+    from dstack_tpu.workloads.kernels import splash as splash_lib
+
+    if gen is None:
+        from dstack_tpu.workloads.kernels.platform import chip_generation
+
+        gen = chip_generation()
+    t, d = q.shape[1], q.shape[3]
+    s_len = k.shape[1]
+    seq = max(t, s_len)
+
+    def make_fn(bq, bk):
+        def fwd(a, b, c):
+            if kernel == "splash":
+                return splash_lib.splash_attention(
+                    a, b, c, causal=causal, window=window, doc_ids=doc_ids,
+                    block_q=bq, block_k=bk, interpret=interpret,
+                )
+            return flash_lib.flash_attention(
+                a, b, c, causal=causal, block_q=bq, block_k=bk,
+                interpret=interpret,
+            )
+
+        if include_bwd:
+            return jax.jit(jax.grad(lambda a, b, c: jnp.sum(fwd(a, b, c))))
+        return jax.jit(fwd)
+
+    sweep: Dict[str, float] = {}
+    best: Optional[Tuple[int, int]] = None
+    best_t = float("inf")
+    for bq in candidate_blocks(t):
+        for bk in candidate_blocks(s_len):
+            fn = make_fn(bq, bk)
+            try:
+                jax.block_until_ready(fn(q, k, v))  # compile + warmup
+                t0 = time.perf_counter()
+                for _ in range(repeats):
+                    jax.block_until_ready(fn(q, k, v))
+                dt = (time.perf_counter() - t0) / repeats
+            except Exception:
+                continue
+            sweep[f"{bq}x{bk}"] = dt
+            if dt < best_t:
+                best_t, best = dt, (bq, bk)
+    report = {"kernel": kernel, "gen": gen, "head_dim": d, "seq": seq,
+              "blocks": best, "sweep": sweep}
+    if best is not None and persist:
+        record(kernel, d, seq, best, gen=gen)
+    return report

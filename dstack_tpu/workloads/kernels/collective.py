@@ -1,10 +1,13 @@
-"""Collective matmul: overlap tensor-parallel ICI transfers with compute.
+"""Collective matmul: overlap parallelism-induced ICI transfers with compute.
 
-The TP down-projections (``wo``: [H·Dh, D], ``w_down``: [F, D]) contract a
-tp-sharded axis: XLA computes the local partial matmul, then emits one big
-all-reduce the MXU sits idle behind. The collective-matmul decomposition (the
-TPU-concurrency paper's "move latency hiding into the program") splits the
-local matmul into ``tp`` row chunks and rides a ``ppermute`` ring:
+Two rings, one idea (the TPU-concurrency paper's "move latency hiding into
+the program"):
+
+**TP reduce-scatter ring** (``collective_matmul``). The TP down-projections
+(``wo``: [H·Dh, D], ``w_down``: [F, D]) contract a tp-sharded axis: XLA
+computes the local partial matmul, then emits one big all-reduce the MXU
+sits idle behind. The decomposition splits the local matmul into ``tp`` row
+chunks and rides a ``ppermute`` ring:
 
   step s: send the accumulating chunk to the next device (async ICI hop),
           compute the next partial chunk (MXU),
@@ -12,10 +15,21 @@ local matmul into ``tp`` row chunks and rides a ``ppermute`` ring:
 
 After tp-1 steps each device owns one fully-reduced output chunk (a
 reduce-scatter whose transfers hid under the partial matmuls), and one tiled
-all-gather rebuilds the replicated activation. Same math as
-matmul-then-all-reduce — the 8-device CPU-mesh test asserts equality — but on
-TPU the per-step ppermute (1/tp of the tensor, neighbor hop) overlaps with the
-next chunk's matmul under XLA's async collectives.
+all-gather rebuilds the replicated activation.
+
+**FSDP all-gather ring** (``allgather_matmul``). The column-parallel
+up-projections (``wq``/``wk``/``wv``/``w_gate``/``w_up``: [D, N], D sharded
+over (dp, fsdp)) are gathered ON USE under FSDP: XLA emits one monolithic
+all-gather of the whole [D, N] weight before the matmul can start. The ring
+form never materializes the gathered weight: each device walks the combined
+(dp, fsdp) ring rotating WEIGHT shards (1/(dp·fsdp) of the tensor per
+neighbor hop) while multiplying the matching K-slice of its local
+activations — each hop's chunk matmul hides the next hop's transfer, and
+peak weight memory stays one shard, not the full tensor.
+
+Both are the same math as the XLA path — the 8-device CPU-mesh tests assert
+equality to 1e-5, outputs and grads — but on TPU the per-step ppermute
+overlaps with the next chunk's matmul under XLA's async collectives.
 """
 
 from __future__ import annotations
@@ -127,5 +141,102 @@ def collective_matmul(
             acc, _ = jax.lax.scan(step, acc, jnp.arange(1, tp))
         full = jax.lax.all_gather(acc, axis, axis=0, tiled=True)  # [rows, N]
         return full.reshape(b, t, n)
+
+    return _ring(x, w)
+
+
+def can_fsdp_overlap(
+    mesh: Optional[Mesh],
+    k_dim: int,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+) -> bool:
+    """True when the all-gather ring decomposition applies to a column-
+    parallel weight with contraction dim ``k_dim``: more than one device on
+    the combined data axes, and ``k_dim`` splitting into whole shards."""
+    if mesh is None:
+        return False
+    data = 1
+    for a in batch_axes:
+        data *= mesh.shape.get(a, 1)
+    return data > 1 and k_dim % data == 0
+
+
+def allgather_matmul(
+    x: jax.Array,   # [B, T, K] — batch over (dp, fsdp), K replicated
+    w: jax.Array,   # [K, N]    — K sharded over (dp, fsdp), N over tp
+    mesh: Mesh,
+    *,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    out_axis: str = "tp",
+    matmul: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
+) -> jax.Array:
+    """y = x @ w for the FSDP column-parallel weights, with the gather-on-use
+    all-gather decomposed into a weight-shard ring; returns fp32 [B, T, N]
+    sharded like any activation (batch axes / sp / tp).
+
+    Ring invariant: after ``s`` neighbor hops device ``my`` holds weight
+    shard ``(my - s) % n`` (rows [(my-s)·K/n, (my-s+1)·K/n) of the full
+    weight), which it multiplies with the SAME K-slice of its local
+    activations — every device walks all ``n`` shards, so the sum over steps
+    is exactly ``x @ w``, with each hop's transfer hiding under the previous
+    chunk's matmul. Peak weight memory per device is one shard (1/n), not
+    the materialized [K, N] the monolithic gather needs.
+
+    ``matmul(x2d, w2d) -> f32`` computes each partial chunk (pass the
+    int8/fp8 STE dot to quantize the partials — scales are per-chunk, which
+    is per-channel on the chunk's contraction rows).
+
+    Caller contract: ``can_fsdp_overlap(mesh, K)`` — d_model divides dp·fsdp
+    and the data axes are non-trivial; fall back to the plain projection
+    otherwise (config.validate_config raises loudly for CLI-requested
+    combos)."""
+    mm = matmul or _default_matmul
+    sizes = [mesh.shape.get(a, 1) for a in batch_axes]
+    n = 1
+    for s in sizes:
+        n *= s
+    # One explicit reshard for any other layout on w (under train shardings
+    # this is a no-op: PARAM_SPECS already puts K over (dp, fsdp)).
+    w = jax.lax.with_sharding_constraint(
+        w, NamedSharding(mesh, P(tuple(batch_axes), out_axis))
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(tuple(batch_axes), "sp", None),
+                  P(tuple(batch_axes), out_axis)),
+        out_specs=P(tuple(batch_axes), "sp", out_axis),
+        check_rep=False,
+    )
+    def _ring(x_loc, w_loc):
+        b, t, k = x_loc.shape
+        n_loc = w_loc.shape[1]
+        kn = k // n
+        xf = x_loc.reshape(b * t, k)
+        # Combined row-major index over the data axes (matches how a
+        # ppermute over the axis-name tuple orders the collapsed axis).
+        my = jnp.zeros((), jnp.int32)
+        for a, s in zip(batch_axes, sizes):
+            my = my * s + jax.lax.axis_index(a)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def partial_chunk(c, w_cur):
+            xc = jax.lax.dynamic_slice_in_dim(xf, c * kn, kn, axis=1)
+            return mm(xc, w_cur)  # [rows, n_loc] f32
+
+        # Step 0 uses the resident shard (rows my·kn..); each subsequent hop
+        # brings shard (my - s) % n.
+        acc = partial_chunk(my, w_loc)
+
+        def step(carry, s):
+            acc, w_cur = carry
+            w_cur = jax.lax.ppermute(w_cur, tuple(batch_axes), perm)
+            acc = acc + partial_chunk((my - s) % n, w_cur)
+            return (acc, w_cur), None
+
+        if n > 1:
+            (acc, _), _ = jax.lax.scan(step, (acc, w_loc), jnp.arange(1, n))
+        return acc.reshape(b, t, n_loc)
 
     return _ring(x, w)

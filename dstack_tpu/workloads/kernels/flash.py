@@ -380,8 +380,21 @@ def flash_attention(
     ``pick_flash_block`` first."""
     b, t, h, d = q.shape
     s_len, kh = k.shape[1], k.shape[2]
-    bq = block_q or pick_flash_block(t)
-    bk = block_k or pick_flash_block(s_len)
+    bq, bk = block_q, block_k
+    if bq is None or bk is None:
+        # Autotune cache first (winners from kernels/autotune.py, keyed per
+        # chip generation), then the largest-divisor heuristic; a stale entry
+        # that doesn't divide THESE lengths is ignored, never an error.
+        from dstack_tpu.workloads.kernels import autotune
+
+        tuned = autotune.lookup("flash", d, max(t, s_len))
+        if tuned is not None:
+            if bq is None and t % tuned[0] == 0:
+                bq = tuned[0]
+            if bk is None and s_len % tuned[1] == 0:
+                bk = tuned[1]
+        bq = bq or pick_flash_block(t)
+        bk = bk or pick_flash_block(s_len)
     if bq is None or bk is None or t % bq or s_len % bk:
         raise ValueError(
             f"flash attention needs block-divisible sequence lengths; "

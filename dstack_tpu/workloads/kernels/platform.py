@@ -26,3 +26,50 @@ def use_interpret(interpret: Optional[bool]) -> bool:
     if interpret is not None:
         return interpret
     return not is_tpu_default_device()
+
+
+# Chip generations that ship native fp8 MXU paths (e4m3/e5m2). v4/v5e run
+# fp8 storage but upcast in the MXU — no throughput win, so quant=fp8 is
+# rejected there at validate_config time rather than silently degrading.
+FP8_GENERATIONS = ("v5p", "v6e", "v6p")
+
+
+def chip_generation(env: Optional[dict] = None) -> str:
+    """Best-effort TPU generation: "v4" / "v5e" / "v5p" / "v6e" / ... , "cpu"
+    when the computation lands off-TPU, "unknown" on an unrecognized TPU.
+
+    Sources, in order: the TPU_ACCELERATOR_TYPE env the GCE/GKE TPU runtime
+    sets ("v5p-16", "v5litepod-8", "v6e-8"), then the PJRT device kind
+    ("TPU v5p", "TPU v5 lite"). Off-TPU the answer is "cpu" — the autotune
+    cache key and the fp8 gate both branch on it."""
+    import os
+    import re
+
+    src = env if env is not None else os.environ
+    acc = str(src.get("TPU_ACCELERATOR_TYPE", ""))
+    if acc:
+        if acc.startswith("v5litepod"):
+            return "v5e"
+        m = re.match(r"(v\d+[a-z]*)", acc)
+        if m:
+            return m.group(1)
+    if not is_tpu_default_device():
+        return "cpu"
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return "unknown"
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return "v5e"
+    m = re.search(r"v(\d+)\s*([a-z]*)", kind)
+    if m:
+        return f"v{m.group(1)}{m.group(2)}"
+    return "unknown"
+
+
+def supports_fp8(gen: Optional[str] = None) -> bool:
+    """True when fp8 matmuls hit a native MXU path (v5p and newer), AND off-TPU
+    — CPU interpret/test runs emulate the identical numerics, so tier-1 tests
+    and the bench smoke exercise the fp8 code everywhere."""
+    gen = gen or chip_generation()
+    return gen in FP8_GENERATIONS or gen == "cpu"

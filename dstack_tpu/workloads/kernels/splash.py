@@ -1,0 +1,550 @@
+"""Splash attention: block-SPARSE flash — skip fully-masked q/kv block pairs.
+
+The long-context half of ROADMAP item 4. ``kernels/flash.py`` already keeps
+scores out of HBM, but a causal kernel still does T²/2 score work and a
+local-window mask (the dominant long-context recipe) leaves most of that as
+multiply-by-zero. This kernel turns the mask structure into LOOP BOUNDS:
+
+- each (batch·head, q-block) program computes its live KV-block interval
+  ``[lo, hi)`` from the causal frontier and the local window — blocks outside
+  it are never read, so a window-W config does O(T·W) work instead of
+  O(T²/2);
+- the backward dk/dv grid applies the transposed bounds with ``pl.when``
+  (q blocks outside a KV block's receptive band contribute nothing and skip
+  their matmuls);
+- document masks (``doc_ids [B, T]``: tokens attend only within their own
+  document, the packed-sequence training layout) are data-dependent, so they
+  stay ELEMENT masks inside live blocks — the online-softmax NEG_INF guard
+  already handles rows whose every key is masked.
+
+Layout, GQA handling, and the custom-VJP split (dq pass + resident-
+accumulator dk/dv pass) mirror ``flash.py`` — one (batch·head, q-block)
+program per grid cell on the [B·H, T, D] reshape, KV indexed at ``b //
+n_rep`` so repeated heads never touch HBM. ``interpret=True`` runs the
+identical code CPU-side; tier-1 tests assert fwd+grad parity against
+``splash_reference`` (the masked materializing reference) to 1e-4.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dstack_tpu.workloads.kernels.flash import pick_flash_block
+from dstack_tpu.workloads.kernels.platform import use_interpret as _use_interpret
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# reference (masked, materializing) — the parity target and the dispatcher's
+# fallback for shapes the kernel can't tile.
+
+
+def splash_reference(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, Kh, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: int = 0,
+    doc_ids: Optional[jax.Array] = None,  # [B, S] int32
+) -> jax.Array:
+    """Materialized attention under the splash mask (causal ∧ window ∧ same-
+    document); returns fp32 [B, T, H, D]. O(T·S) memory — correctness
+    reference and odd-shape fallback only."""
+    b, t, h, d = q.shape
+    s_len, kh = k.shape[1], k.shape[2]
+    n_rep = h // kh
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bthd,bshd->bhts", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.arange(t)[:, None]
+    kv_pos = jnp.arange(s_len)[None, :]
+    mask = jnp.ones((t, s_len), bool)
+    if causal:
+        mask &= kv_pos <= q_pos
+    if window:
+        mask &= kv_pos > q_pos - window
+    mask = jnp.broadcast_to(mask[None], (b, t, s_len))
+    if doc_ids is not None:
+        mask = mask & (doc_ids[:, :t, None] == doc_ids[:, None, :s_len])
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    # Rows with every key masked (leading positions of a window'd band, or a
+    # one-token document) must come out zero, not NaN.
+    any_live = jnp.any(mask, axis=-1)[:, None]  # [B, 1, T]
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(any_live[..., None], p, 0.0)
+    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# live KV interval: the block-skipping arithmetic, shared by fwd and dq.
+
+
+def _kv_bounds(iq, block_q, block_k, n_kv, causal, window):
+    """[lo, hi) KV-block interval for q block ``iq``: causal bounds hi by the
+    block's LAST query row, the window bounds lo by its FIRST. Both are exact
+    — a block outside [lo, hi) has no unmasked element."""
+    if causal:
+        hi = jnp.minimum((iq * block_q + block_q + block_k - 1) // block_k,
+                         n_kv)
+    else:
+        hi = n_kv
+    if window:
+        lo = jnp.maximum((iq * block_q - (window - 1)) // block_k, 0)
+    else:
+        lo = 0
+    return lo, hi
+
+
+def _element_mask(iq, jk, block_q, block_k, causal, window, docq, dock):
+    """[bq, bk] bool mask inside one live block (None = nothing masked)."""
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    kv_pos = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = None
+    if causal:
+        mask = kv_pos <= q_pos
+    if window:
+        wmask = kv_pos > q_pos - window
+        mask = wmask if mask is None else (mask & wmask)
+    if docq is not None:
+        dmask = docq[:, None] == dock[None, :]
+        mask = dmask if mask is None else (mask & dmask)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _splash_fwd_kernel(q_ref, k_ref, v_ref, docq_ref, dock_ref, o_ref,
+                       lse_ref, *, causal, window, has_docs, block_q, block_k,
+                       scale):
+    """One (batch·head, q-block) program. Refs: q [1, bq, D]; k/v [1, S, D];
+    docq [1, bq]; dock [1, S]; o [1, bq, D]; lse [1, bq]."""
+    iq = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32)
+    docq = docq_ref[0] if has_docs else None
+
+    n_kv = s_len // block_k
+    lo, hi = _kv_bounds(iq, block_q, block_k, n_kv, causal, window)
+
+    def body(jk, carry):
+        o, l, m = carry
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        dock = (dock_ref[0, pl.ds(jk * block_k, block_k)]
+                if has_docs else None)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = _element_mask(iq, jk, block_q, block_k, causal, window, docq,
+                             dock)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        # All-masked rows keep m_new == NEG_INF; clamp the reference point so
+        # exp(NEG_INF - NEG_INF) can't poison l (same guard as flash).
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - safe_m))
+        p = jnp.exp(s - safe_m)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o * corr + pv, l_new, m_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(lo, hi, body, (o0, l0, m0))
+
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (jnp.where(m == NEG_INF, NEG_INF, m) + jnp.log(l_safe))[:, 0]
+
+
+def _splash_fwd_3d(q3, k3, v3, docq2, dock2, causal, window, has_docs,
+                   block_q, block_k, interpret):
+    """q3 [BH, T, D], k3/v3 [BKh, S, D], docq2/dock2 [B, T]/[B, S] ->
+    (o [BH, T, D] f32, lse [BH, T] f32)."""
+    bh, t, d = q3.shape
+    bkh, s_len, _ = k3.shape
+    n_rep = bh // bkh
+    h = bh // docq2.shape[0]
+    scale = float(1.0 / (d ** 0.5))
+    grid = (bh, t // block_q)
+    kernel = functools.partial(
+        _splash_fwd_kernel, causal=causal, window=window, has_docs=has_docs,
+        block_q=block_q, block_k=block_k, scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, m=h: (b // m, i)),
+            pl.BlockSpec((1, s_len), lambda b, i, m=h: (b // m, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, docq2, dock2)
+
+
+# ---------------------------------------------------------------------------
+# backward: dq accumulates over the same live KV interval; dk/dv stream the
+# transposed band of q blocks into a resident accumulator (flash.py's grid),
+# with pl.when skipping q blocks outside the KV block's receptive band.
+
+
+def _splash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          docq_ref, dock_ref, dq_ref, *, causal, window,
+                          has_docs, block_q, block_k, scale):
+    iq = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    docq = docq_ref[0] if has_docs else None
+
+    n_kv = s_len // block_k
+    lo, hi = _kv_bounds(iq, block_q, block_k, n_kv, causal, window)
+
+    def body(jk, dq):
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        dock = (dock_ref[0, pl.ds(jk * block_k, block_k)]
+                if has_docs else None)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        # Fully-masked rows carry lse == NEG_INF; clamp the reference and
+        # zero p so their gradients stay 0 (flash.py's guard).
+        p = jnp.where(
+            lse == NEG_INF, 0.0,
+            jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse)),
+        )
+        mask = _element_mask(iq, jk, block_q, block_k, causal, window, docq,
+                             dock)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq_ref[0] = jax.lax.fori_loop(
+        lo, hi, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+
+
+def _splash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           docq_ref, dock_ref, dk_ref, dv_ref, *, causal,
+                           window, has_docs, block_q, block_k, scale, n_q):
+    """Grid (bkh, kv-block, n_rep·n_q): the (b, j) output block stays resident
+    while the innermost axis streams (repeat-head, q-block) pairs; pairs
+    outside the block's receptive band skip their matmuls entirely — the
+    backward-pass face of the same block sparsity."""
+    jk = pl.program_id(1)
+    qi = pl.program_id(2)
+    iq = jax.lax.rem(qi, n_q)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    def contrib():
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        docq = docq_ref[0] if has_docs else None
+        dock = dock_ref[0] if has_docs else None
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.where(
+            lse == NEG_INF, 0.0,
+            jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse)),
+        )
+        mask = _element_mask(iq, jk, block_q, block_k, causal, window, docq,
+                             dock)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv_ref[0] = dv_ref[0] + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_ref[0] = dk_ref[0] + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # Transposed band: causal kills q blocks strictly before the KV block;
+    # the window kills q blocks past the KV block's last reachable query
+    # (kv_pos + window - 1).
+    live = None
+    if causal:
+        live = iq >= (jk * block_k) // block_q
+    if window:
+        wlive = iq * block_q <= jk * block_k + block_k - 1 + window - 1
+        live = wlive if live is None else (live & wlive)
+    if live is None:
+        contrib()
+    else:
+        pl.when(live)(contrib)
+
+
+def _splash_bwd_3d(q3, k3, v3, o3, lse, do3, docq2, dock2, causal, window,
+                   has_docs, block_q, block_k, interpret):
+    bh, t, d = q3.shape
+    bkh, s_len, _ = k3.shape
+    n_rep = bh // bkh
+    h = bh // docq2.shape[0]
+    kh = bkh // docq2.shape[0]
+    scale = float(1.0 / (d ** 0.5))
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_splash_bwd_dq_kernel, causal=causal, window=window,
+                          has_docs=has_docs, block_q=block_q, block_k=block_k,
+                          scale=scale),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, s_len, d), lambda b, i, n=n_rep: (b // n, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, m=h: (b // m, i)),
+            pl.BlockSpec((1, s_len), lambda b, i, m=h: (b // m, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta, docq2, dock2)
+
+    n_q = t // block_q
+    q_map = lambda b, j, qi, n=n_rep, m=n_q: (b * n + qi // m, qi % m, 0)
+    stat_map = lambda b, j, qi, n=n_rep, m=n_q: (b * n + qi // m, qi % m)
+    # doc rows follow the batch of the streamed q (b·n_rep + qi//n_q maps to
+    # batch (b·n_rep + qi//n_q) // h) and of the resident KV block (b // kh).
+    docq_map = lambda b, j, qi, n=n_rep, m=n_q, hh=h: (
+        (b * n + qi // m) // hh, qi % m
+    )
+    dock_map = lambda b, j, qi, k=kh: (b // k, j)
+    dk, dv = pl.pallas_call(
+        functools.partial(_splash_bwd_dkv_kernel, causal=causal,
+                          window=window, has_docs=has_docs, block_q=block_q,
+                          block_k=block_k, scale=scale, n_q=n_q),
+        grid=(bkh, s_len // block_k, n_rep * n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q), stat_map),
+            pl.BlockSpec((1, block_q), stat_map),
+            pl.BlockSpec((1, block_q), docq_map),
+            pl.BlockSpec((1, block_k), dock_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, qi: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bkh, s_len, d), jnp.float32),
+            jax.ShapeDtypeStruct((bkh, s_len, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta, docq2, dock2)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper on the [BH, T, D] layout. The doc-id operands are
+# integer data, not differentiable state — their cotangents are float0.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _splash_3d(q3, k3, v3, docq2, dock2, causal, window, has_docs, block_q,
+               block_k, interpret):
+    o, _ = _splash_fwd_3d(q3, k3, v3, docq2, dock2, causal, window, has_docs,
+                          block_q, block_k, interpret)
+    return o
+
+
+def _splash_3d_fwd(q3, k3, v3, docq2, dock2, causal, window, has_docs,
+                   block_q, block_k, interpret):
+    o, lse = _splash_fwd_3d(q3, k3, v3, docq2, dock2, causal, window,
+                            has_docs, block_q, block_k, interpret)
+    return o, (q3, k3, v3, o, lse, docq2, dock2)
+
+
+def _splash_3d_bwd(causal, window, has_docs, block_q, block_k, interpret,
+                   res, do3):
+    q3, k3, v3, o3, lse, docq2, dock2 = res
+    dq, dk, dv = _splash_bwd_3d(
+        q3, k3, v3, o3, lse, do3, docq2, dock2, causal, window, has_docs,
+        block_q, block_k, interpret
+    )
+    zero_doc = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
+            zero_doc(docq2), zero_doc(dock2))
+
+
+_splash_3d.defvjp(_splash_3d_fwd, _splash_3d_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry points (attention.py layout: [B, T, H, D])
+
+
+def splash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, Kh, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: int = 0,
+    doc_ids: Optional[jax.Array] = None,  # [B, S] int32
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Block-sparse flash attention; returns fp32 [B, T, H, D].
+
+    ``window`` > 0 restricts each query to the last ``window`` positions
+    (inclusive of itself) and SKIPS KV blocks outside the band; ``doc_ids``
+    adds a same-document element mask. Raises ValueError when the sequence
+    lengths admit no block size — dispatchers that want a silent fallback
+    check ``pick_flash_block`` first (attention.attention_core degrades to
+    ``splash_reference``)."""
+    b, t, h, d = q.shape
+    s_len, kh = k.shape[1], k.shape[2]
+    if window and not causal:
+        raise ValueError("splash window masks are causal bands; "
+                         "window > 0 requires causal=True")
+    bq, bk = block_q, block_k
+    if bq is None or bk is None:
+        # Autotune cache first (winners from tune(), keyed per generation),
+        # then the heuristic; a stale entry that doesn't divide THESE lengths
+        # is ignored, never an error.
+        from dstack_tpu.workloads.kernels import autotune
+
+        tuned = autotune.lookup("splash", d, max(t, s_len))
+        if tuned is not None:
+            if bq is None and t % tuned[0] == 0:
+                bq = tuned[0]
+            if bk is None and s_len % tuned[1] == 0:
+                bk = tuned[1]
+        bq = bq or pick_flash_block(t)
+        bk = bk or pick_flash_block(s_len)
+    if bq is None or bk is None or t % bq or s_len % bk:
+        raise ValueError(
+            f"splash attention needs block-divisible sequence lengths; "
+            f"T={t} S={s_len} have no usable block (pass attn_impl=xla "
+            f"or pad the sequence)"
+        )
+    q3 = q.swapaxes(1, 2).reshape(b * h, t, d)
+    k3 = k.swapaxes(1, 2).reshape(b * kh, s_len, d)
+    v3 = v.swapaxes(1, 2).reshape(b * kh, s_len, d)
+    has_docs = doc_ids is not None
+    if has_docs:
+        docq2 = doc_ids[:, :t].astype(jnp.int32)
+        dock2 = doc_ids[:, :s_len].astype(jnp.int32)
+    else:
+        # Uniform zeros: the has_docs=False kernels never read these, but the
+        # operand shapes stay static for the custom VJP.
+        docq2 = jnp.zeros((b, t), jnp.int32)
+        dock2 = jnp.zeros((b, s_len), jnp.int32)
+    o3 = _splash_3d(q3, k3, v3, docq2, dock2, causal, int(window), has_docs,
+                    bq, bk, _use_interpret(interpret))
+    return o3.reshape(b, h, t, d).swapaxes(1, 2)
+
+
+def splash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    window: int = 0,
+    doc_ids: Optional[jax.Array] = None,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """splash_attention under a (dp, fsdp, tp) mesh via shard_map — same
+    contract as ``flash_attention_sharded`` (sp == 1, tp | n_kv_heads); the
+    doc-id plane shards over the batch axes alongside q/k/v."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(batch_axes, None, "tp", None)
+    doc_spec = P(batch_axes, None)
+    if doc_ids is None:
+        doc_ids = jnp.zeros(k.shape[:2], jnp.int32)
+        has_docs = False
+    else:
+        doc_ids = doc_ids.astype(jnp.int32)
+        has_docs = True
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec, doc_spec),
+        out_specs=spec, check_rep=False,
+    )
+    def _local(q_loc, k_loc, v_loc, doc_loc):
+        return splash_attention(
+            q_loc, k_loc, v_loc, causal=causal, window=window,
+            doc_ids=doc_loc if has_docs else None, interpret=interpret,
+        )
+
+    return _local(q, k, v, doc_ids)

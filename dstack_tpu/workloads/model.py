@@ -25,7 +25,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dstack_tpu.workloads import quantize as quant_lib
 from dstack_tpu.workloads.attention import attention_core
 from dstack_tpu.workloads.config import LlamaConfig
-from dstack_tpu.workloads.kernels.collective import can_overlap, collective_matmul
+from dstack_tpu.workloads.kernels.collective import (
+    allgather_matmul,
+    can_fsdp_overlap,
+    can_overlap,
+    collective_matmul,
+)
 
 Params = Dict[str, jax.Array]
 
@@ -56,10 +61,53 @@ def down_proj(
         and mesh.shape.get("tp", 1) > 1
         and can_overlap(mesh, x.shape[0], x.shape[1], batch_axes=batch_axes)
     ):
-        mm = quant_lib.int8_matmul_ste if cfg.quant == "int8" else None
         return collective_matmul(
-            x, w, mesh, batch_axes=batch_axes, matmul=mm
+            x, w, mesh, batch_axes=batch_axes, matmul=_quant_partial_mm(cfg)
         ).astype(x.dtype)
+    return dense_proj(x, w, cfg)
+
+
+def _quant_partial_mm(cfg: LlamaConfig):
+    """The per-chunk matmul for a collective ring under cfg.quant (None = fp
+    dot): STE dots so partials quantize with per-chunk scales and the ring
+    stays differentiable."""
+    if cfg.quant == "int8":
+        return quant_lib.int8_matmul_ste
+    if cfg.quant == "fp8":
+        return quant_lib.fp8_matmul_ste
+    return None
+
+
+def up_proj(
+    x: jax.Array,   # [B, T, D] — batch over (dp, fsdp), D replicated
+    w: jax.Array,   # [D, N]    — D fsdp-sharded, N tp-sharded
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh],
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+) -> jax.Array:
+    """The FSDP column-parallel up-projections (wq/wk/wv/w_gate/w_up):
+    contraction dim (d_model) sharded over (dp, fsdp), so XLA's plain path
+    all-gathers the whole [D, N] weight before the matmul can start. With
+    cfg.fsdp_overlap the all-gather ring (kernels/collective.py) rotates
+    weight shards around the data axes instead, each hop hiding under the
+    previous chunk's matmul; falls back to the plain path when shapes don't
+    divide (validate_config raises loudly for CLI-requested combos)."""
+    if cfg.fsdp_overlap and mesh is not None:
+        data = 1
+        for a in batch_axes:
+            data *= mesh.shape.get(a, 1)
+        sp = mesh.shape.get("sp", 1)
+        tp = mesh.shape.get("tp", 1)
+        if (
+            can_fsdp_overlap(mesh, x.shape[-1], batch_axes)
+            and x.shape[0] % data == 0
+            and x.shape[1] % sp == 0
+            and w.shape[-1] % tp == 0
+        ):
+            return allgather_matmul(
+                x, w, mesh, batch_axes=batch_axes,
+                matmul=_quant_partial_mm(cfg),
+            ).astype(x.dtype)
     return dense_proj(x, w, cfg)
 
 
@@ -192,9 +240,9 @@ def attention_sublayer(
     name = checkpoint_name
 
     h_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = name(dense_proj(h_in, layer["wq"], cfg), "proj")
-    k = name(dense_proj(h_in, layer["wk"], cfg), "proj")
-    v = name(dense_proj(h_in, layer["wv"], cfg), "proj")
+    q = name(up_proj(h_in, layer["wq"], cfg, mesh, batch_axes), "proj")
+    k = name(up_proj(h_in, layer["wk"], cfg, mesh, batch_axes), "proj")
+    v = name(up_proj(h_in, layer["wv"], cfg, mesh, batch_axes), "proj")
     q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
     k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
@@ -203,7 +251,8 @@ def attention_sublayer(
     v = act_constraint(v, P(batch_axes, "sp", "tp", None))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    o = attention_core(q, k, v, cfg.attn_impl, mesh, batch_axes=batch_axes)
+    o = attention_core(q, k, v, cfg.attn_impl, mesh, batch_axes=batch_axes,
+                       window=cfg.attn_window)
     o = name(o.astype(adt).reshape(b, t, cfg.n_heads * cfg.head_dim), "proj")
     attn_out = down_proj(o, layer["wo"], cfg, mesh, batch_axes).astype(adt)
     return x + act_constraint(attn_out, P(batch_axes, "sp", None))
@@ -228,8 +277,8 @@ def transformer_block(
     x = attention_sublayer(x, layer, cfg, positions, mesh, act_constraint)
 
     h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = name(dense_proj(h2, layer["w_gate"], cfg), "proj")
-    up = name(dense_proj(h2, layer["w_up"], cfg), "proj")
+    gate = name(up_proj(h2, layer["w_gate"], cfg, mesh), "proj")
+    up = name(up_proj(h2, layer["w_up"], cfg, mesh), "proj")
     hidden = jax.nn.silu(gate.astype(jnp.float32)).astype(adt) * up
     hidden = act_constraint(hidden, P(("dp", "fsdp"), "sp", "tp"))
     mlp_out = down_proj(hidden, layer["w_down"], cfg, mesh).astype(adt)

@@ -1,4 +1,4 @@
-"""Int8 quantized matmuls for train (STE) and serve (weight-only).
+"""Int8 + fp8 quantized matmuls for train (STE) and serve (weight-only).
 
 Two regimes, one scale scheme (per-channel absmax, symmetric, no zero point —
 the TPU-friendly layout: scales broadcast along lanes, the MXU runs the int8
@@ -20,6 +20,16 @@ dot natively with int32 accumulation):
   bandwidth-bound on weights and the fp activation path keeps greedy-decode
   drift minimal.
 
+**fp8 (e4m3/e5m2)** reuses the same per-channel absmax scheme: operands are
+scaled into the fp8 dtype's dynamic range and CAST (the cast is the rounding
+— fp8 is a float grid, not an integer one), the dot accumulates in fp32, and
+the scales factor back out exactly. On v5p+ the MXU runs the fp8 dot
+natively (~2x the bf16 rate); older generations upcast in hardware, so
+``quant=fp8`` is gated to v5p+ at ``config.validate_config`` time — CPU
+interpret/test runs are allowed everywhere (identical numerics, no
+throughput claim). e4m3 (max 448, 3 mantissa bits) is the default: matmul
+operands want precision over range; e5m2 exists for the gradient-like tails.
+
 Everything is expressed over the one matmul shape the model uses after
 ``lax.scan`` unstacks the layer axis: ``x[..., K] @ w[K, N]``.
 """
@@ -33,22 +43,32 @@ import jax.numpy as jnp
 
 INT8_MAX = 127.0
 
+# fp8 representable maxima (jnp.finfo): the absmax scale maps each channel's
+# peak onto these, so the cast never overflows to inf.
+FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+FP8_DTYPES = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
+FP8_DEFAULT_FORMAT = "e4m3"
+
 
 class QuantizedWeight(NamedTuple):
-    """int8 values + fp32 per-output-channel scales (shape [..., 1, N] so a
-    stacked [L, K, N] weight carries [L, 1, N] scales that slice cleanly
+    """int8/fp8 values + fp32 per-output-channel scales (shape [..., 1, N] so
+    a stacked [L, K, N] weight carries [L, 1, N] scales that slice cleanly
     under scan)."""
 
-    values: jax.Array  # int8
+    values: jax.Array  # int8 or float8_*
     scales: jax.Array  # float32
 
 
-def absmax_scales(x: jax.Array, axis: int) -> jax.Array:
-    """Symmetric per-channel scales over ``axis`` (fp32, keepdims). Zero
-    channels get scale 1 so dequantization never divides by zero."""
+def _absmax(x: jax.Array, axis: int, max_val: float) -> jax.Array:
     s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
-    s = s / INT8_MAX
+    s = s / max_val
     return jnp.where(s == 0.0, 1.0, s)
+
+
+def absmax_scales(x: jax.Array, axis: int) -> jax.Array:
+    """Symmetric per-channel int8 scales over ``axis`` (fp32, keepdims). Zero
+    channels get scale 1 so dequantization never divides by zero."""
+    return _absmax(x, axis, INT8_MAX)
 
 
 def quantize_int8(x: jax.Array, axis: int) -> Tuple[jax.Array, jax.Array]:
@@ -62,10 +82,27 @@ def dequantize(values: jax.Array, scales: jax.Array) -> jax.Array:
     return values.astype(jnp.float32) * scales
 
 
-def quantize_weight(w: jax.Array, axis: int = -2) -> QuantizedWeight:
+def quantize_fp8(
+    x: jax.Array, axis: int, fmt: str = FP8_DEFAULT_FORMAT
+) -> Tuple[jax.Array, jax.Array]:
+    """(fp8 values, fp32 keepdims scales). The cast IS the rounding: each
+    channel is scaled so its absmax lands on the format's representable max,
+    then cast to the fp8 dtype (round-to-nearest-even in hardware)."""
+    scales = _absmax(x, axis, FP8_MAX[fmt])
+    q = (x.astype(jnp.float32) / scales).astype(FP8_DTYPES[fmt])
+    return q, scales
+
+
+def quantize_weight(
+    w: jax.Array, axis: int = -2, mode: str = "int8"
+) -> QuantizedWeight:
     """Per-output-channel weight quantization; ``axis`` is the contraction
-    dim (default: second-to-last, i.e. K of [..., K, N])."""
-    values, scales = quantize_int8(w, axis)
+    dim (default: second-to-last, i.e. K of [..., K, N]). ``mode`` picks the
+    grid: "int8" (default) or "fp8" (e4m3 — serve weights want mantissa)."""
+    if mode == "fp8":
+        values, scales = quantize_fp8(w, axis)
+    else:
+        values, scales = quantize_int8(w, axis)
     return QuantizedWeight(values, scales)
 
 
@@ -116,12 +153,47 @@ def _ste_bwd(res, g):
 int8_matmul_ste.defvjp(_ste_fwd, _ste_bwd)
 
 
+def fp8_matmul(
+    x: jax.Array, w: jax.Array, fmt: str = FP8_DEFAULT_FORMAT
+) -> jax.Array:
+    """Dynamically-quantized fp8 ``x[..., K] @ w[K, N]`` -> fp32.
+
+    Same scale algebra as ``int8_matmul`` (activations per row, weights per
+    output channel); the dot runs on the fp8 operands with fp32 accumulation
+    — ``preferred_element_type`` routes it to the native fp8 MXU path on
+    v5p+, and CPU jaxlib emulates the identical numerics for tests."""
+    xq, xs = quantize_fp8(x, axis=-1, fmt=fmt)   # xs [..., 1]
+    wq, ws = quantize_fp8(w, axis=0, fmt=fmt)    # ws [1, N]
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return acc * xs * ws
+
+
+@jax.custom_vjp
+def fp8_matmul_ste(x: jax.Array, w: jax.Array) -> jax.Array:
+    """fp8_matmul with straight-through gradients (train path): forward in
+    e4m3, backward the EXACT fp gradients against the original operands —
+    the same contract as ``int8_matmul_ste``, shared ``_ste_bwd``."""
+    return fp8_matmul(x, w)
+
+
+def _fp8_ste_fwd(x, w):
+    return fp8_matmul(x, w), (x, w)
+
+
+fp8_matmul_ste.defvjp(_fp8_ste_fwd, _ste_bwd)
+
+
 def weight_only_matmul(
     x: jax.Array,          # [..., K] activation dtype
-    values: jax.Array,     # [K, N] int8
+    values: jax.Array,     # [K, N] int8 or fp8
     scales: jax.Array,     # [1, N] fp32
 ) -> jax.Array:
-    """Serve path: dequantize-on-use, fp32 accumulation; returns fp32."""
+    """Serve path: dequantize-on-use, fp32 accumulation; returns fp32.
+    Dtype-agnostic over the value grid — int8 and fp8 weights take the same
+    path (``values.astype`` is the dequantize-to-activation-dtype step)."""
     w = values.astype(x.dtype)
     acc = jax.lax.dot_general(
         x, w, (((x.ndim - 1,), (0,)), ((), ())),
@@ -148,6 +220,8 @@ def matmul(x: jax.Array, w: jax.Array, quant: str, adt=None) -> jax.Array:
     adt = adt or x.dtype
     if quant == "int8":
         return int8_matmul_ste(x, w).astype(adt)
+    if quant == "fp8":
+        return fp8_matmul_ste(x, w).astype(adt)
     out = jax.lax.dot_general(
         x, w.astype(adt), (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -155,7 +229,15 @@ def matmul(x: jax.Array, w: jax.Array, quant: str, adt=None) -> jax.Array:
     return out.astype(adt)
 
 
-QUANT_MODES = ("none", "int8")
+QUANT_MODES = ("none", "int8", "fp8")
+
+# The quant modes whose serve path pre-quantizes weights once at engine build
+# (quantize_serve_params) and dequantizes on use (weight_only_matmul).
+WEIGHT_ONLY_MODES = ("int8", "fp8")
+
+
+def is_weight_only(quant: str) -> bool:
+    return quant in WEIGHT_ONLY_MODES
 
 
 def check_quant(quant: str) -> None:

@@ -109,11 +109,16 @@ def resolve_decode_impl(impl: str) -> str:
     return "pallas" if is_tpu_default_device() else "xla"
 
 
-def quantize_serve_params(params: dict, consume: bool = False) -> dict:
-    """Weight-only int8 for serving: every projection weight becomes an int8
-    tensor + per-output-channel fp32 scales (``<k>_q`` / ``<k>_s``), halving
-    weight HBM vs bf16; embeddings and norms stay full-precision (the embed
-    is a gather, the norms are tiny).
+def quantize_serve_params(
+    params: dict, consume: bool = False, mode: str = "int8"
+) -> dict:
+    """Weight-only quantization for serving: every projection weight becomes
+    a quantized tensor + per-output-channel fp32 scales (``<k>_q`` /
+    ``<k>_s``), halving weight HBM vs bf16; embeddings and norms stay
+    full-precision (the embed is a gather, the norms are tiny). ``mode`` is
+    "int8" or "fp8" — both dequantize on use, so fp8 storage works on any
+    chip generation (it is HBM compression, not an fp8 matmul; the v5p+ gate
+    applies only to training's quant=fp8 MXU path).
 
     With ``consume=True`` the input dict is drained as it is quantized: each
     fp projection weight is popped (dropping its last reference, so the
@@ -129,7 +134,7 @@ def quantize_serve_params(params: dict, consume: bool = False) -> dict:
     }
     for k in _WEIGHT_KEYS + ("lm_head",):
         w = params.pop(k) if consume else params[k]
-        qw = quant_lib.quantize_weight(w)  # contraction = 2nd-to-last
+        qw = quant_lib.quantize_weight(w, mode=mode)  # contraction = 2nd-to-last
         del w
         out[k + "_q"] = qw.values
         out[k + "_s"] = qw.scales
@@ -137,7 +142,7 @@ def quantize_serve_params(params: dict, consume: bool = False) -> dict:
 
 
 def _serve_layer_keys(quant: str):
-    if quant != "int8":
+    if not quant_lib.is_weight_only(quant):
         return _LAYER_KEYS
     return tuple(
         f"{k}_{suffix}" for k in _WEIGHT_KEYS for suffix in ("q", "s")
@@ -177,9 +182,10 @@ def load_serve_params(
       checkpoint's optimizer moments — 2x the param bytes — never leave
       disk), via ``restore_subtree``'s prefix matching, which also accepts
       params-only checkpoints;
-    - with ``quant="int8"``, ``quantize_serve_params(consume=True)`` drains
-      the fp tree as it quantizes: peak memory is the fp params plus one
-      int8 leaf, never two full trees.
+    - with a weight-only ``quant`` ("int8" / "fp8"),
+      ``quantize_serve_params(consume=True)`` drains the fp tree as it
+      quantizes: peak memory is the fp params plus one quantized leaf,
+      never two full trees.
 
     Returns ``(params, manifest)`` — params in the layout ``ServeEngine``
     expects for the given ``quant``."""
@@ -202,14 +208,15 @@ def load_serve_params(
     params, manifest = manager.restore_subtree(
         template, step=step, prefix=".params"
     )
-    if quant == "int8":
-        params = quantize_serve_params(params, consume=True)
+    if quant_lib.is_weight_only(quant):
+        params = quantize_serve_params(params, consume=True, mode=quant)
     return params, manifest
 
 
 def _proj(x: jax.Array, layer: dict, key: str, adt, quant: str) -> jax.Array:
-    """x[..., K] @ layer[key] in adt: fp einsum, or weight-only int8."""
-    if quant == "int8":
+    """x[..., K] @ layer[key] in adt: fp einsum, or weight-only int8/fp8
+    (the dequant is dtype-agnostic: values.astype(x.dtype) * scales)."""
+    if quant_lib.is_weight_only(quant):
         return quant_lib.weight_only_matmul(
             x, layer[key + "_q"], layer[key + "_s"]
         ).astype(adt)
@@ -221,7 +228,7 @@ def _proj(x: jax.Array, layer: dict, key: str, adt, quant: str) -> jax.Array:
 
 
 def _logits(x: jax.Array, params: dict, adt, quant: str) -> jax.Array:
-    if quant == "int8":
+    if quant_lib.is_weight_only(quant):
         return quant_lib.weight_only_matmul(
             x, params["lm_head_q"], params["lm_head_s"]
         )
@@ -248,8 +255,9 @@ class EngineConfig:
     # Decode attention: "auto" = Pallas paged kernel on TPU / XLA gather on
     # CPU; "xla"/"pallas" force one (kernels/paged.py).
     decode_impl: str = "auto"
-    # "int8" = weight-only quantization (quantize_serve_params): projection
-    # weights stored int8 + per-channel scales, dequantized on use.
+    # "int8" / "fp8" = weight-only quantization (quantize_serve_params):
+    # projection weights stored int8 or fp8-e4m3 + per-channel scales,
+    # dequantized on use (works on any chip — storage only, no fp8 matmul).
     quant: str = "none"
     # Max prompt tokens prefilled per request per engine step (0 = whole
     # prompt in one batched prefill, the tier-1 behavior). With chunking, a
@@ -812,20 +820,21 @@ class ServeEngine:
         self.params = params if params is not None else model_lib.init_params(
             cfg, jax.random.PRNGKey(seed)
         )
-        # Weight-only int8: quantize once at engine build; the jitted fns see
-        # only the quantized layout. The fp originals are released — keeping
-        # them would hold bf16/fp32 weights in HBM *alongside* the int8 copy,
-        # inverting the memory win. Reference decoders keep their own tree.
+        # Weight-only int8/fp8: quantize once at engine build; the jitted fns
+        # see only the quantized layout. The fp originals are released —
+        # keeping them would hold bf16/fp32 weights in HBM *alongside* the
+        # quantized copy, inverting the memory win. Reference decoders keep
+        # their own tree.
         quant = self.ecfg.quant
-        if quant == "int8":
+        if quant_lib.is_weight_only(quant):
             if self.params is not None and "lm_head_q" in self.params:
                 # Already in the weight-only layout (load_serve_params
                 # quantized leaf-by-leaf as it consumed the restored fp tree)
-                # — re-quantizing int8 values would be wrong AND the fp
+                # — re-quantizing quantized values would be wrong AND the fp
                 # originals are gone by design.
                 self._serve_params = self.params
             else:
-                self._serve_params = quantize_serve_params(self.params)
+                self._serve_params = quantize_serve_params(self.params, mode=quant)
             self.params = None
         else:
             self._serve_params = self.params
@@ -1678,8 +1687,9 @@ def main() -> None:
                         choices=list(DECODE_IMPLS),
                         help="decode attention: auto = Pallas paged kernel on"
                              " TPU, XLA gather elsewhere")
-    parser.add_argument("--quant", default="none", choices=["none", "int8"],
-                        help="int8 = weight-only quantization (projection"
+    parser.add_argument("--quant", default="none",
+                        choices=["none", "int8", "fp8"],
+                        help="int8/fp8 = weight-only quantization (projection"
                              " weights stored int8 + per-channel scales —"
                              " half the weight HBM)")
     parser.add_argument("--prefill-chunk", type=int, default=0,

@@ -165,7 +165,9 @@ def serve_param_specs(quant: str = "none") -> Dict[str, P]:
     weight's spec; ``<k>_s`` per-output-channel scales keep the OUTPUT axis
     sharding — for row-parallel weights the contraction axis that tp splits is
     reduced away in the scales, leaving them replicated)."""
-    if quant != "int8":
+    from dstack_tpu.workloads import quantize as quant_lib
+
+    if not quant_lib.is_weight_only(quant):
         return dict(SERVE_PARAM_SPECS)
     specs: Dict[str, P] = {
         k: SERVE_PARAM_SPECS[k]

@@ -411,8 +411,9 @@ def make_checkpoint_hook(manager, every: int, total_steps: int, get_state,
 
 
 def apply_perf_overrides(cfg, args):
-    """--attn-impl / --quant / --tp-overlap -> config fields (shared by the
-    dense and MoE CLI branches; empty flag = keep the config default)."""
+    """--attn-impl / --quant / --tp-overlap / --fsdp-overlap / --attn-window
+    -> config fields (shared by the dense and MoE CLI branches; empty flag =
+    keep the config default)."""
     reps = {}
     if getattr(args, "attn_impl", ""):
         reps["attn_impl"] = args.attn_impl
@@ -420,6 +421,10 @@ def apply_perf_overrides(cfg, args):
         reps["quant"] = args.quant
     if getattr(args, "tp_overlap", False):
         reps["tp_overlap"] = True
+    if getattr(args, "fsdp_overlap", False):
+        reps["fsdp_overlap"] = True
+    if getattr(args, "attn_window", 0):
+        reps["attn_window"] = args.attn_window
     return dataclasses.replace(cfg, **reps) if reps else cfg
 
 
@@ -573,11 +578,20 @@ def main() -> None:
                         help="attention core: auto (public Pallas kernel on a"
                              " meshless TPU, blockwise else), xla/blockwise,"
                              " flash (in-repo Pallas kernel; interpreted off-"
-                             "TPU), flash_tpu, plain (config default if empty)")
-    parser.add_argument("--quant", default="", choices=["", "none", "int8"],
-                        help="matmul precision: int8 = dynamically-quantized"
-                             " dots with fp32 accumulation and straight-"
-                             "through gradients (config default if empty)")
+                             "TPU), flash_tpu, splash (block-sparse flash:"
+                             " causal/local-window/document masks skip dead"
+                             " blocks), plain (config default if empty)")
+    parser.add_argument("--attn-window", type=int, default=0,
+                        dest="attn_window",
+                        help="local-attention window W for --attn-impl splash:"
+                             " each query sees keys [i-W+1, i] (0 = dense"
+                             " causal)")
+    parser.add_argument("--quant", default="",
+                        choices=["", "none", "int8", "fp8"],
+                        help="matmul precision: int8/fp8 = dynamically-"
+                             "quantized dots with fp32 accumulation and"
+                             " straight-through gradients; fp8 (e4m3) needs a"
+                             " v5p+ MXU (config default if empty)")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel axis size (fsdp absorbs the"
                              " rest); >1 is what makes --tp-overlap and the"
@@ -586,6 +600,18 @@ def main() -> None:
                         help="collective-matmul ring for the TP down-"
                              "projections: ICI transfers hide under partial"
                              " matmuls (requires --tp > 1)")
+    parser.add_argument("--fsdp-overlap", action="store_true",
+                        dest="fsdp_overlap",
+                        help="all-gather ring for the FSDP column-parallel"
+                             " up-projections (wq/wk/wv/w_gate/w_up): weight"
+                             " shards rotate around dp*fsdp, each hop hiding"
+                             " under the previous chunk's matmul (requires"
+                             " dp*fsdp > 1 and d_model divisible by it)")
+    parser.add_argument("--autotune", action="store_true",
+                        help="sweep flash/splash (block_q, block_kv)"
+                             " candidates for this (chip, head_dim, seq)"
+                             " before training and persist the winner to the"
+                             " autotune cache (kernels/autotune.py)")
     parser.add_argument("--prefetch", type=int, default=2,
                         help="input prefetch depth: batches staged to HBM ahead"
                              " of the step (0 = synchronous feed)")
@@ -640,6 +666,23 @@ def main() -> None:
     # must die HERE, before a multi-minute compile silently takes the slow
     # path.
     validate_config(cfg, mesh, batch=batch // max(args.grad_accum, 1), seq=seq)
+
+    if args.autotune and cfg.attn_impl in ("flash", "splash"):
+        # Sweep before the train compile so flash/splash pick up the tuned
+        # (block_q, block_kv) for this exact (chip, head_dim, seq) — the
+        # winner persists to the autotune cache, so later runs skip the sweep.
+        from dstack_tpu.workloads.kernels import autotune as autotune_lib
+
+        probe = jax.random.normal(
+            jax.random.PRNGKey(0), (1, seq, 1, cfg.head_dim), jnp.float32
+        )
+        report = autotune_lib.tune(
+            cfg.attn_impl, probe, probe, probe,
+            causal=True, window=cfg.attn_window,
+        )
+        print(f"autotune: {report['kernel']} gen={report['gen']}"
+              f" head_dim={report['head_dim']} seq={report['seq']}"
+              f" -> blocks={report['blocks']}", flush=True)
 
     print(f"config={args.config} devices={len(devices)} mesh={dict(mesh.shape)} "
           f"batch={batch} seq={seq} grad_accum={args.grad_accum} "

@@ -59,6 +59,29 @@ OVERLAP_XLA_FLAGS: Dict[str, str] = {
     "--xla_tpu_decompose_einsum_reduce_scatter": "true",
 }
 
+# Generation-specific additions layered OVER the shared base at env-compose
+# time (the docker image bakes only the base — it doesn't know the chip; the
+# configurator/entrypoint do, via TPU_ACCELERATOR_TYPE). The branch point:
+# v5p-class training pods get more scoped vmem for collective double-
+# buffering; v6e (Trillium) additionally offloads gather/reduce collectives
+# to the SparseCores so the TensorCore schedule never stalls on them.
+# Unknown/absent generation = base set only, exactly the pre-branch behavior.
+GENERATION_XLA_FLAGS: Dict[str, Dict[str, str]] = {
+    "v5p": {
+        "--xla_tpu_scoped_vmem_limit_kib": "81920",
+    },
+    "v6e": {
+        "--xla_tpu_scoped_vmem_limit_kib": "98304",
+        "--xla_tpu_enable_sparse_core_collective_offload_all_gather": "true",
+        "--xla_tpu_enable_sparse_core_collective_offload_all_reduce": "true",
+    },
+    "v6p": {
+        "--xla_tpu_scoped_vmem_limit_kib": "98304",
+        "--xla_tpu_enable_sparse_core_collective_offload_all_gather": "true",
+        "--xla_tpu_enable_sparse_core_collective_offload_all_reduce": "true",
+    },
+}
+
 # libtpu init args (parsed by libtpu itself, not XLA): host-offloaded DMA
 # descriptors sized for multislice DCN transfers. Harmless on single slice.
 OVERLAP_LIBTPU_ARGS: Dict[str, str] = {
@@ -66,6 +89,28 @@ OVERLAP_LIBTPU_ARGS: Dict[str, str] = {
 }
 
 ENV_DISABLE = "DSTACK_TPU_OVERLAP_FLAGS"  # "0" opts a job out entirely
+
+
+def chip_generation_from_env(env: Mapping[str, str]) -> str:
+    """TPU_ACCELERATOR_TYPE ("v5p-16", "v5litepod-8", "v6e-8") -> generation
+    ("v5p" / "v5e" / "v6e"), "" when unset or unrecognized. The jax-free twin
+    of kernels.platform.chip_generation's env branch — this module is
+    imported by the server and must never touch jax."""
+    import re
+
+    acc = str(env.get("TPU_ACCELERATOR_TYPE", ""))
+    if acc.startswith("v5litepod"):
+        return "v5e"
+    m = re.match(r"(v\d+[a-z]*)", acc)
+    return m.group(1) if m else ""
+
+
+def generation_flags(gen: str = "") -> Dict[str, str]:
+    """The full XLA default set for one chip generation: shared base +
+    generation branch (unknown/"" = base only)."""
+    merged = dict(OVERLAP_XLA_FLAGS)
+    merged.update(GENERATION_XLA_FLAGS.get(gen, {}))
+    return merged
 
 
 def _parse(flags: str) -> Dict[str, Optional[str]]:
@@ -96,8 +141,9 @@ def overlap_env(existing: Optional[Mapping[str, str]] = None) -> Dict[str, str]:
     existing = existing or {}
     if str(existing.get(ENV_DISABLE, "")) == "0":
         return {}
+    defaults = generation_flags(chip_generation_from_env(existing))
     return {
-        "XLA_FLAGS": compose(existing.get("XLA_FLAGS", "")),
+        "XLA_FLAGS": compose(existing.get("XLA_FLAGS", ""), defaults),
         "LIBTPU_INIT_ARGS": compose(
             existing.get("LIBTPU_INIT_ARGS", ""), OVERLAP_LIBTPU_ARGS
         ),

@@ -5,13 +5,22 @@ mode on the virtual 8-device CPU mesh (conftest). The load-bearing claims:
 
 - the flash kernel matches ``blockwise_attention`` to <=1e-4, outputs AND
   gradients, causal and not, GQA included;
-- the collective-matmul ppermute ring equals all-gather-then-matmul;
-- int8 quantization is bounded-error forward and *exactly* fp backward (STE);
+- the splash block-sparse kernel matches the masked materializing reference
+  (causal / local-window / document masks), outputs AND gradients;
+- the collective-matmul ppermute ring equals all-gather-then-matmul, and the
+  FSDP all-gather ring (``allgather_matmul``) equals the plain einsum;
+- int8 AND fp8 quantization are bounded-error forward and *exactly* fp
+  backward (STE);
 - the serving engine is token-identical with either decode implementation,
-  preemption included.
+  preemption included;
+- the autotune block cache round-trips, keys by chip generation, and
+  degrades (never crashes) on corrupt or stale entries.
 """
 
 import dataclasses
+import json
+import os
+import pathlib
 import sys
 
 import jax
@@ -33,12 +42,19 @@ from dstack_tpu.workloads.attention import (
 )
 from dstack_tpu.workloads.config import get_config, validate_config
 from dstack_tpu.workloads.kernels import (
+    allgather_matmul,
     collective_matmul,
     flash_attention,
+    flash_attention_sharded,
     paged_decode_attention_pallas,
     pick_flash_block,
+    splash_attention,
+    splash_attention_sharded,
 )
-from dstack_tpu.workloads.kernels.collective import can_overlap
+from dstack_tpu.workloads.kernels import autotune as autotune_lib
+from dstack_tpu.workloads.kernels import platform as platform_lib
+from dstack_tpu.workloads.kernels.collective import can_fsdp_overlap, can_overlap
+from dstack_tpu.workloads.kernels.splash import splash_reference
 from dstack_tpu.workloads.sharding import (
     batch_sharding,
     make_mesh,
@@ -443,7 +459,7 @@ class TestServeQuant:
             )
         with pytest.raises(ValueError, match="quant"):
             serve_lib.ServeEngine(
-                TINY_SERVE, serve_lib.EngineConfig(quant="fp8"),
+                TINY_SERVE, serve_lib.EngineConfig(quant="fp4"),
                 params=serve_params,
             )
 
@@ -494,9 +510,9 @@ class TestValidation:
 
     def test_unknown_impls_raise(self):
         with pytest.raises(ValueError, match="attn_impl"):
-            validate_config(get_config("test", attn_impl="splash"), None)
+            validate_config(get_config("test", attn_impl="splashy"), None)
         with pytest.raises(ValueError, match="quant"):
-            validate_config(get_config("test", quant="fp8"), None)
+            validate_config(get_config("test", quant="fp4"), None)
 
     def test_valid_combo_passes(self):
         mesh = make_mesh(dp=1, fsdp=2, tp=4, sp=1)
@@ -513,6 +529,29 @@ class TestCLI:
             "train", "--config", "test", "--steps", "1", "--seq", "32",
             "--batch", "8", "--attn-impl", "flash", "--quant", "int8",
             "--prefetch", "0",
+        ])
+        train_lib.main()
+        out = capsys.readouterr().out
+        assert "compile+first-step" in out
+
+    def test_train_main_threads_splash_and_window(self, monkeypatch, capsys):
+        """--attn-impl splash --attn-window 16: the block-sparse kernel with
+        a live local-window bound inside a real jitted train step."""
+        monkeypatch.setattr(sys, "argv", [
+            "train", "--config", "test", "--steps", "1", "--seq", "32",
+            "--batch", "8", "--attn-impl", "splash", "--attn-window", "16",
+            "--prefetch", "0",
+        ])
+        train_lib.main()
+        out = capsys.readouterr().out
+        assert "compile+first-step" in out
+
+    def test_train_main_fsdp_overlap_runs_ring(self, monkeypatch, capsys):
+        """--fsdp-overlap on the default (dp, fsdp) mesh runs the allgather
+        ring inside the jitted step."""
+        monkeypatch.setattr(sys, "argv", [
+            "train", "--config", "test", "--steps", "1", "--seq", "32",
+            "--batch", "8", "--fsdp-overlap", "--prefetch", "0",
         ])
         train_lib.main()
         out = capsys.readouterr().out
@@ -571,3 +610,459 @@ class TestBenchPlan:
         plan = dict(bench._variant_plan(8))
         assert plan["flash"]["cfg_overrides"] == {"attn_impl": "flash"}
         assert plan["int8"]["cfg_overrides"] == {"quant": "int8"}
+
+    def test_variant_plan_covers_new_levers(self):
+        sys.path.insert(0, "/root/repo")
+        import bench
+
+        plan = dict(bench._variant_plan(8))
+        assert plan["fp8"]["cfg_overrides"] == {"quant": "fp8"}
+        assert plan["splash"]["cfg_overrides"] == {"attn_impl": "splash"}
+        assert plan["splash_window"]["cfg_overrides"] == {
+            "attn_impl": "splash", "attn_window": 64,
+        }
+        assert plan["flash_autotuned"]["autotune"] is True
+        fsdp = dict(bench._fsdp_variant_plan(8))
+        assert fsdp["fsdp_overlap"]["cfg_overrides"] == {"fsdp_overlap": True}
+        assert fsdp["fsdp_overlap_int8"]["cfg_overrides"] == {
+            "fsdp_overlap": True, "quant": "int8",
+        }
+
+
+class TestSplashKernel:
+    @pytest.mark.parametrize("causal,window", [(True, 0), (True, 48),
+                                               (False, 0)])
+    def test_fwd_matches_reference(self, causal, window):
+        q, k, v = qkv(jax.random.PRNGKey(10))
+        out = splash_attention(q, k, v, causal=causal, window=window)
+        ref = splash_reference(q, k, v, causal=causal, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+    def test_doc_mask_matches_reference(self):
+        q, k, v = qkv(jax.random.PRNGKey(11))
+        # Three packed documents of uneven length in a 128-token row.
+        doc_ids = jnp.concatenate([
+            jnp.zeros((2, 40), jnp.int32),
+            jnp.ones((2, 56), jnp.int32),
+            jnp.full((2, 32), 2, jnp.int32),
+        ], axis=1)
+        out = splash_attention(q, k, v, doc_ids=doc_ids)
+        ref = splash_reference(q, k, v, doc_ids=doc_ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+    def test_grads_match_reference(self):
+        """fwd AND bwd under the window band — the custom-VJP backward must
+        apply the identical block-sparse mask."""
+        q, k, v = qkv(jax.random.PRNGKey(12), t=64)
+
+        got = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.sin(
+                splash_attention(q, k, v, window=32))),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.sin(
+                splash_reference(q, k, v, window=32))),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=TOL,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_gqa_multiple_repeat_groups(self):
+        q, k, v = qkv(jax.random.PRNGKey(13), t=64, h=8, kh=2, d=8)
+        out = splash_attention(q, k, v, window=24)
+        ref = splash_reference(q, k, v, window=24)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+    def test_window_requires_causal(self):
+        q, k, v = qkv(jax.random.PRNGKey(14), t=64)
+        with pytest.raises(ValueError, match="causal"):
+            splash_attention(q, k, v, causal=False, window=16)
+
+    def test_attention_core_dispatches_splash(self):
+        q, k, v = qkv(jax.random.PRNGKey(15))
+        out = attention_core(q, k, v, "splash", None, window=48)
+        ref = splash_reference(q, k, v, window=48)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+    def test_attention_core_splash_falls_back_on_odd_seq(self):
+        # No block divides 63: the dispatcher degrades to the masked
+        # reference instead of crashing mid-model.
+        q, k, v = qkv(jax.random.PRNGKey(16), t=63)
+        out = attention_core(q, k, v, "splash", None, window=16)
+        ref = splash_reference(q, k, v, window=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+    def test_sharded_matches_unsharded(self):
+        mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2)
+        q, k, v = qkv(jax.random.PRNGKey(17), t=64, b=2)
+        doc_ids = jnp.concatenate([
+            jnp.zeros((2, 24), jnp.int32), jnp.ones((2, 40), jnp.int32)
+        ], axis=1)
+        with mesh:
+            got = jax.jit(lambda a, b, c, d: splash_attention_sharded(
+                a, b, c, mesh, window=32, doc_ids=d
+            ))(q, k, v, doc_ids)
+        ref = splash_attention(q, k, v, window=32, doc_ids=doc_ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL)
+
+    def test_flash_sharded_matches_unsharded(self):
+        # Same shard_map contract as splash: flash_attention_sharded is the
+        # batch/head-parallel wrapper attention_core uses under a mesh.
+        mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2)
+        q, k, v = qkv(jax.random.PRNGKey(18), t=64, b=2)
+        with mesh:
+            got = jax.jit(lambda a, b, c: flash_attention_sharded(
+                a, b, c, mesh
+            ))(q, k, v)
+        ref = flash_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL)
+
+    def test_validation_window_rules(self):
+        with pytest.raises(ValueError, match="attn_window"):
+            validate_config(
+                get_config("test", attn_impl="splash", attn_window=-1), None
+            )
+        with pytest.raises(ValueError, match="attn_window"):
+            validate_config(
+                get_config("test", attn_impl="flash", attn_window=64), None,
+                batch=8, seq=128,
+            )
+        validate_config(
+            get_config("test", attn_impl="splash", attn_window=64), None,
+            batch=8, seq=128,
+        )
+
+
+class TestFp8:
+    def test_quantize_fp8_dtypes_and_scales(self):
+        w = jax.random.normal(jax.random.PRNGKey(20), (64, 32))
+        q, s = quant_lib.quantize_fp8(w, axis=0)
+        assert q.dtype == jnp.float8_e4m3fn
+        assert s.dtype == jnp.float32 and s.shape == (1, 32)
+        q5, _ = quant_lib.quantize_fp8(w, axis=0, fmt="e5m2")
+        assert q5.dtype == jnp.float8_e5m2
+
+    def test_fp8_matmul_error_bound(self):
+        x = jax.random.normal(jax.random.PRNGKey(21), (64, 256))
+        w = jax.random.normal(jax.random.PRNGKey(22), (256, 128))
+        got = quant_lib.fp8_matmul(x, w)
+        ref = x @ w
+        rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+        # e4m3 has 3 mantissa bits: coarser than int8's per-channel grid.
+        assert rel < 0.1, rel
+
+    def test_ste_grads_are_exactly_fp(self):
+        """Same contract as int8: forward in e4m3, backward the EXACT fp
+        gradients against the original operands."""
+        x = jax.random.normal(jax.random.PRNGKey(23), (4, 8, 16))
+        w = jax.random.normal(jax.random.PRNGKey(24), (16, 12))
+
+        def loss_q(x, w):
+            return jnp.sum(jnp.sin(quant_lib.fp8_matmul_ste(x, w)))
+
+        gx, gw = jax.grad(loss_q, argnums=(0, 1))(x, w)
+        y = quant_lib.fp8_matmul(x, w)
+        g = jnp.cos(y)  # d/dy sum(sin(y))
+        want_gx = jnp.einsum("abn,kn->abk", g, w)
+        want_gw = jnp.einsum("abk,abn->kn", x, g)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(want_gx),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(want_gw),
+                                   atol=1e-5)
+
+    def test_weight_only_fp8_matmul_error_bound(self):
+        x = jax.random.normal(jax.random.PRNGKey(25), (4, 64))
+        w = jax.random.normal(jax.random.PRNGKey(26), (64, 32))
+        qw = quant_lib.quantize_weight(w, mode="fp8")
+        assert qw.values.dtype == jnp.float8_e4m3fn
+        got = quant_lib.weight_only_matmul(x, qw.values, qw.scales)
+        rel = float(jnp.linalg.norm(got - x @ w) / jnp.linalg.norm(x @ w))
+        assert rel < 0.05, rel
+
+    def test_supports_fp8_generations(self):
+        assert platform_lib.supports_fp8("v5p")
+        assert platform_lib.supports_fp8("v6e")
+        assert platform_lib.supports_fp8("cpu")  # tests emulate the numerics
+        assert not platform_lib.supports_fp8("v4")
+        assert not platform_lib.supports_fp8("v5e")
+
+    def test_chip_generation_parses_accelerator_type(self):
+        gen = platform_lib.chip_generation
+        assert gen({"TPU_ACCELERATOR_TYPE": "v5p-16"}) == "v5p"
+        assert gen({"TPU_ACCELERATOR_TYPE": "v5litepod-8"}) == "v5e"
+        assert gen({"TPU_ACCELERATOR_TYPE": "v6e-8"}) == "v6e"
+        assert gen({}) == "cpu"  # off-TPU test host
+
+    def test_validate_config_gates_fp8_by_generation(self, monkeypatch):
+        cfg = get_config("test", quant="fp8")
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+        with pytest.raises(ValueError, match="fp8"):
+            validate_config(cfg, None, batch=8, seq=32)
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+        validate_config(cfg, None, batch=8, seq=32)
+
+    def test_fp8_serve_param_layout(self, serve_params):
+        qp = serve_lib.quantize_serve_params(serve_params, mode="fp8")
+        for k in serve_lib._WEIGHT_KEYS:
+            assert qp[k + "_q"].dtype == jnp.float8_e4m3fn
+            assert qp[k + "_q"].shape == serve_params[k].shape
+            assert qp[k + "_s"].dtype == jnp.float32
+            assert k not in qp
+        assert qp["lm_head_q"].dtype == jnp.float8_e4m3fn
+
+    def test_fp8_engine_decodes_finitely_and_deterministically(
+        self, serve_params
+    ):
+        def run():
+            engine = serve_lib.ServeEngine(
+                TINY_SERVE,
+                serve_lib.EngineConfig(page_size=8, num_pages=32, max_batch=2,
+                                       max_seq=128, quant="fp8"),
+                params=serve_params,
+            )
+            req = engine.submit([3, 5, 7, 11], max_new_tokens=8)
+            run_engine(engine)
+            return req.tokens
+
+        a, b = run(), run()
+        assert a == b and len(a) == 8
+        assert all(0 <= t < TINY_SERVE.vocab_size for t in a)
+
+    def test_fp8_train_descends(self):
+        cfg = get_config("test", max_seq_len=32, quant="fp8",
+                         d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                         d_ff=256, vocab_size=512)
+        opt = train_lib.make_optimizer(learning_rate=1e-3)
+        state = train_lib.init_train_state(cfg, jax.random.PRNGKey(0), opt)
+        step = train_lib.make_train_step(cfg, opt)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size
+        )
+        losses = []
+        for _ in range(5):
+            state, m = step(state, tokens, tokens)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+
+class TestFsdpOverlap:
+    def _mesh(self):
+        return make_mesh(dp=2, fsdp=4, tp=1, sp=1)
+
+    def test_matches_einsum(self):
+        mesh = self._mesh()
+        x = jax.random.normal(jax.random.PRNGKey(30), (8, 16, 64))
+        w = jax.random.normal(jax.random.PRNGKey(31), (64, 32))
+        with mesh:
+            got = jax.jit(lambda a, b: allgather_matmul(a, b, mesh))(x, w)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(jnp.einsum("btk,kn->btn", x, w)),
+            atol=TOL,
+        )
+
+    def test_grads_match_einsum(self):
+        mesh = self._mesh()
+        x = jax.random.normal(jax.random.PRNGKey(32), (8, 8, 32))
+        w = jax.random.normal(jax.random.PRNGKey(33), (32, 16))
+        with mesh:
+            gx, gw = jax.jit(jax.grad(
+                lambda a, b: jnp.sum(jnp.sin(allgather_matmul(a, b, mesh))),
+                argnums=(0, 1),
+            ))(x, w)
+        rx, rw = jax.grad(
+            lambda a, b: jnp.sum(jnp.sin(jnp.einsum("btk,kn->btn", a, b))),
+            argnums=(0, 1),
+        )(x, w)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=TOL)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=TOL)
+
+    def test_int8_partials(self):
+        mesh = self._mesh()
+        x = jax.random.normal(jax.random.PRNGKey(34), (8, 8, 64))
+        w = jax.random.normal(jax.random.PRNGKey(35), (64, 32))
+        with mesh:
+            got = jax.jit(lambda a, b: allgather_matmul(
+                a, b, mesh, matmul=quant_lib.int8_matmul_ste
+            ))(x, w)
+        ref = jnp.einsum("btk,kn->btn", x, w)
+        rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+        assert rel < 0.05, rel
+
+    def test_can_fsdp_overlap_divisibility(self):
+        mesh = self._mesh()  # dp*fsdp = 8
+        assert can_fsdp_overlap(mesh, 64)
+        assert not can_fsdp_overlap(mesh, 60)  # 60 % 8 != 0
+        assert not can_fsdp_overlap(None, 64)
+        flat = make_mesh(dp=1, fsdp=1, tp=8, sp=1)  # no data axes to ring
+        assert not can_fsdp_overlap(flat, 64)
+
+    def test_model_forward_fsdp_overlap_matches(self):
+        mesh = self._mesh()
+        cfg_o = get_config("test", max_seq_len=32, fsdp_overlap=True,
+                           dtype="float32")
+        cfg_p = get_config("test", max_seq_len=32, dtype="float32")
+        params = model_lib.init_params(cfg_p, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg_p.vocab_size
+        )
+        with mesh:
+            sp = shard_params(params, mesh)
+            toks = jax.device_put(tokens, batch_sharding(mesh))
+            lo = jax.jit(lambda p, t: model_lib.forward(p, t, cfg_o, mesh))(sp, toks)
+            lp = jax.jit(lambda p, t: model_lib.forward(p, t, cfg_p, mesh))(sp, toks)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(lp), atol=1e-3)
+
+    def test_train_step_with_fsdp_overlap_descends(self):
+        mesh = self._mesh()
+        cfg = get_config("test", max_seq_len=32, fsdp_overlap=True,
+                         dtype="float32")
+        opt = train_lib.make_optimizer()
+        with mesh:
+            state = train_lib.init_train_state(
+                cfg, jax.random.PRNGKey(0), opt, mesh
+            )
+            step = train_lib.make_train_step(cfg, opt, mesh)
+            tokens = jax.device_put(
+                jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                   cfg.vocab_size),
+                batch_sharding(mesh),
+            )
+            losses = []
+            for _ in range(3):
+                state, m = step(state, tokens, tokens)
+                losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_validate_config_fsdp_overlap_divisibility(self):
+        mesh = self._mesh()
+        cfg = get_config("test", fsdp_overlap=True, d_model=60, n_heads=4,
+                         n_kv_heads=2)
+        with pytest.raises(ValueError, match="fsdp_overlap"):
+            validate_config(cfg, mesh, batch=8, seq=32)
+        validate_config(get_config("test", fsdp_overlap=True), mesh,
+                        batch=8, seq=32)
+
+
+class TestAutotune:
+    @pytest.fixture(autouse=True)
+    def _isolated_cache(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(autotune_lib.ENV_DIR, str(tmp_path))
+        monkeypatch.setattr(autotune_lib, "_memo", None)
+        yield
+
+    def test_env_dir_override(self, tmp_path):
+        assert autotune_lib.cache_dir() == str(tmp_path)
+        assert autotune_lib.cache_path().startswith(str(tmp_path))
+
+    def test_record_lookup_roundtrip(self):
+        assert autotune_lib.record("flash", 32, 256, (64, 64), gen="v5e")
+        assert autotune_lib.lookup("flash", 32, 256, gen="v5e") == (64, 64)
+        # Persisted, not just memoized: a cold reload sees the same entry.
+        autotune_lib._memo = None
+        assert autotune_lib.lookup("flash", 32, 256, gen="v5e") == (64, 64)
+
+    def test_generation_is_part_of_the_key(self):
+        autotune_lib.record("flash", 32, 256, (64, 64), gen="v5e")
+        # A v5e-tuned entry must never leak into a v5p (or cpu) lookup.
+        assert autotune_lib.lookup("flash", 32, 256, gen="v5p") is None
+        assert autotune_lib.lookup("flash", 32, 256, gen="cpu") is None
+        # Shipped defaults are ALSO per-generation.
+        assert autotune_lib.lookup("flash", 128, 4096, gen="v5p") == (512, 512)
+        assert autotune_lib.lookup("flash", 128, 4096, gen="v5e") == (512, 256)
+
+    def test_corrupt_cache_falls_back_to_shipped_defaults(self):
+        os.makedirs(autotune_lib.cache_dir(), exist_ok=True)
+        with open(autotune_lib.cache_path(), "w") as f:
+            f.write("{not json")
+        assert autotune_lib.lookup("flash", 64, 2048, gen="v5p") == (512, 512)
+        # And recording over the corrupt file heals it.
+        assert autotune_lib.record("splash", 64, 1024, (128, 128), gen="v5p")
+        assert autotune_lib.lookup("splash", 64, 1024, gen="v5p") == (128, 128)
+
+    def test_malformed_entries_are_dropped_not_fatal(self):
+        os.makedirs(autotune_lib.cache_dir(), exist_ok=True)
+        with open(autotune_lib.cache_path(), "w") as f:
+            json.dump({
+                "flash|cpu|16|128": [0, 64],        # non-positive
+                "flash|cpu|16|64": "big",           # wrong type
+                "splash|cpu|16|128": [32, 32, 32],  # wrong arity
+                "flash|cpu|32|256": [64, 64],       # the one valid entry
+            }, f)
+        assert autotune_lib.lookup("flash", 16, 128, gen="cpu") is None
+        assert autotune_lib.lookup("flash", 16, 64, gen="cpu") is None
+        assert autotune_lib.lookup("splash", 16, 128, gen="cpu") is None
+        assert autotune_lib.lookup("flash", 32, 256, gen="cpu") == (64, 64)
+
+    def test_stale_nondividing_entry_is_ignored_by_kernels(self):
+        # A winner tuned for another shape whose blocks don't divide THESE
+        # lengths must not break the kernel — heuristic wins silently.
+        autotune_lib.record("flash", 16, 128, (96, 96), gen="cpu")
+        autotune_lib.record("splash", 16, 128, (96, 96), gen="cpu")
+        q, k, v = qkv(jax.random.PRNGKey(40))
+        out = flash_attention(q, k, v)
+        ref = blockwise_attention(q, k, v, block_size=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+        out_s = splash_attention(q, k, v, window=48)
+        ref_s = splash_reference(q, k, v, window=48)
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(ref_s),
+                                   atol=TOL)
+
+    def test_tuned_blocks_are_picked_up(self):
+        # The cache entry for exactly this (kernel, cpu, head_dim, seq) wins
+        # over the heuristic — same numerics, different tiling.
+        autotune_lib.record("flash", 16, 128, (32, 32), gen="cpu")
+        q, k, v = qkv(jax.random.PRNGKey(41))
+        out = flash_attention(q, k, v)
+        ref = flash_attention(q, k, v, block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+    def test_tune_sweeps_persists_and_reports(self):
+        q, k, v = qkv(jax.random.PRNGKey(42), t=32, h=1, kh=1, b=1)
+        report = autotune_lib.tune(
+            "flash", q, k, v, gen="cpu", include_bwd=False, repeats=1
+        )
+        assert report["kernel"] == "flash" and report["gen"] == "cpu"
+        assert report["blocks"] is not None
+        assert report["sweep"]  # every candidate timed
+        assert autotune_lib.lookup("flash", 16, 32, gen="cpu") == tuple(
+            report["blocks"]
+        )
+        with open(autotune_lib.cache_path()) as f:
+            assert "flash|cpu|16|32" in json.load(f)
+
+
+class TestKernelExportsCovered:
+    def test_every_kernel_export_has_a_parity_test(self):
+        """Lint gate (not numerics): every public kernel in
+        ``kernels.__all__`` must be referenced by name somewhere in the
+        interpret-mode test suite, so a new export can't ship untested."""
+        from dstack_tpu.workloads import kernels
+
+        tests_dir = pathlib.Path(__file__).parent
+        src = "\n".join(
+            p.read_text() for p in sorted(tests_dir.glob("test_*.py"))
+        )
+        missing = [name for name in kernels.__all__ if name not in src]
+        assert not missing, (
+            f"kernels.__all__ entries with no test reference: {missing}"
+        )
+
+
+class TestAutotuneCLI:
+    def test_train_main_autotune_runs_sweep(self, monkeypatch, tmp_path,
+                                            capsys):
+        monkeypatch.setenv(autotune_lib.ENV_DIR, str(tmp_path))
+        monkeypatch.setattr(autotune_lib, "_memo", None)
+        monkeypatch.setattr(sys, "argv", [
+            "train", "--config", "test", "--steps", "1", "--seq", "32",
+            "--batch", "8", "--attn-impl", "flash", "--autotune",
+            "--prefetch", "0",
+        ])
+        train_lib.main()
+        out = capsys.readouterr().out
+        assert "autotune: flash" in out
+        assert os.path.exists(autotune_lib.cache_path())

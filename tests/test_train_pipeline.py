@@ -355,9 +355,51 @@ class TestXlaFlags:
         assert applied["XLA_FLAGS"].startswith("--xla_dump_to=/tmp/hlo")
         assert "--xla_tpu_enable_latency_hiding_scheduler=true" in applied["XLA_FLAGS"]
 
+    def test_chip_generation_from_env(self):
+        gen = xla_flags.chip_generation_from_env
+        assert gen({"TPU_ACCELERATOR_TYPE": "v5p-16"}) == "v5p"
+        assert gen({"TPU_ACCELERATOR_TYPE": "v5litepod-8"}) == "v5e"
+        assert gen({"TPU_ACCELERATOR_TYPE": "v6e-8"}) == "v6e"
+        assert gen({"TPU_ACCELERATOR_TYPE": "weird-999"}) == ""
+        assert gen({}) == ""
+
+    def test_generation_flags_merge_over_base(self):
+        v5p = xla_flags.generation_flags("v5p")
+        # Base set intact, plus the generation branch.
+        for name, val in xla_flags.OVERLAP_XLA_FLAGS.items():
+            assert v5p[name] == val
+        assert v5p["--xla_tpu_scoped_vmem_limit_kib"] == "81920"
+        v6e = xla_flags.generation_flags("v6e")
+        assert v6e["--xla_tpu_scoped_vmem_limit_kib"] == "98304"
+        assert (v6e["--xla_tpu_enable_sparse_core_collective_offload_all_gather"]
+                == "true")
+        # Unknown generation = exactly the base set (pre-branch behavior).
+        assert xla_flags.generation_flags("") == dict(xla_flags.OVERLAP_XLA_FLAGS)
+        assert xla_flags.generation_flags("v4") == dict(xla_flags.OVERLAP_XLA_FLAGS)
+
+    def test_overlap_env_branches_on_accelerator_type(self):
+        env = xla_flags.overlap_env({"TPU_ACCELERATOR_TYPE": "v5p-16"})
+        assert "--xla_tpu_scoped_vmem_limit_kib=81920" in env["XLA_FLAGS"]
+        env = xla_flags.overlap_env({"TPU_ACCELERATOR_TYPE": "v6e-8"})
+        assert ("--xla_tpu_enable_sparse_core_collective_offload_all_reduce"
+                "=true") in env["XLA_FLAGS"]
+        # No generation info: base-only, no vmem override.
+        env = xla_flags.overlap_env({})
+        assert "--xla_tpu_scoped_vmem_limit_kib" not in env["XLA_FLAGS"]
+
+    def test_user_flag_beats_generation_default(self):
+        env = xla_flags.overlap_env({
+            "TPU_ACCELERATOR_TYPE": "v5p-16",
+            "XLA_FLAGS": "--xla_tpu_scoped_vmem_limit_kib=65536",
+        })
+        assert env["XLA_FLAGS"].count("--xla_tpu_scoped_vmem_limit_kib") == 1
+        assert "--xla_tpu_scoped_vmem_limit_kib=65536" in env["XLA_FLAGS"]
+
     def test_docker_image_env_matches_module(self):
         """docker/tpu bakes the same defaults the module composes — the image
-        and the configurator must never drift apart."""
+        and the configurator must never drift apart. The generation branches
+        are deliberately NOT baked: the image doesn't know the chip; the
+        configurator/entrypoint add them at env-compose time."""
         text = (REPO / "docker" / "tpu" / "Dockerfile").read_text()
         baked = {}
         for var in ("XLA_FLAGS", "LIBTPU_INIT_ARGS"):
