@@ -1750,6 +1750,11 @@ def _run_serve_variant(cfg, params, schedule, **engine_kwargs) -> dict:
     warm = engine.submit([1, 2, 3], max_new_tokens=2)
     while not warm.done:
         engine.step()
+    # Fresh flight recorder sized to retain EVERY request of the schedule (the
+    # default ring is sized for production debugging, not benchmarking), and
+    # free of the warm-up request, so the stage-breakdown percentiles below
+    # cover exactly the measured run.
+    engine.flight = serve_lib.FlightRecorder(capacity=len(schedule) + 8)
 
     arrivals = {}      # req_id -> arrival time
     token_times = {}   # req_id -> [emission times]
@@ -1785,12 +1790,35 @@ def _run_serve_variant(cfg, params, schedule, **engine_kwargs) -> dict:
     )
     total_tokens = sum(len(t) for t in token_times.values())
     assert all(r.done for r in reqs.values()), "engine left requests unfinished"
+
+    # Stage attribution from the engine's flight recorder (ISSUE 18): where
+    # each request's wall time went — admission-queue wait vs prefill vs
+    # decode — so a routing/policy A/B can see WHICH stage moved, not just
+    # that the TTFT tail did.
+    def _stage_pcts(key: str) -> tuple:
+        vals = sorted(t.get(key, 0.0) for t in engine.flight.snapshot())
+        if not vals:
+            return 0.0, 0.0
+        return (
+            round(nearest_rank(vals, 0.50) * 1000, 2),
+            round(nearest_rank(vals, 0.99) * 1000, 2),
+        )
+
+    queue_p50, queue_p99 = _stage_pcts("queue_wait_s")
+    prefill_p50, prefill_p99 = _stage_pcts("prefill_s")
+    decode_p50, decode_p99 = _stage_pcts("decode_s")
     return {
         "tokens_per_sec": round(total_tokens / max(t_end - first_arrival, 1e-9), 1),
         "ttft_p50_ms": round(nearest_rank(ttfts, 0.50) * 1000, 1),
         "ttft_p99_ms": round(nearest_rank(ttfts, 0.99) * 1000, 1),
         "itl_p50_ms": round(nearest_rank(itls, 0.50) * 1000, 2),
         "itl_p99_ms": round(nearest_rank(itls, 0.99) * 1000, 2),
+        "queue_wait_p50_ms": queue_p50,
+        "queue_wait_p99_ms": queue_p99,
+        "prefill_p50_ms": prefill_p50,
+        "prefill_p99_ms": prefill_p99,
+        "decode_p50_ms": decode_p50,
+        "decode_p99_ms": decode_p99,
         "steps": engine.total_steps,
         "preemptions": engine.total_preemptions,
         "requests": len(schedule),
@@ -2171,6 +2199,18 @@ def bench_serve() -> dict:
             "ttft_p99_ms": cont["ttft_p99_ms"],
             "itl_p50_ms": cont["itl_p50_ms"],
             "itl_p99_ms": cont["itl_p99_ms"],
+            # Stage attribution (ISSUE 18): where request wall time went in
+            # the median continuous round — the measurement substrate for the
+            # routing A/B ("did the TTFT tail move because queueing shrank,
+            # or because prefill got cheaper?").
+            "stage_breakdown": {
+                "queue_wait_p50_ms": cont["queue_wait_p50_ms"],
+                "queue_wait_p99_ms": cont["queue_wait_p99_ms"],
+                "prefill_p50_ms": cont["prefill_p50_ms"],
+                "prefill_p99_ms": cont["prefill_p99_ms"],
+                "decode_p50_ms": cont["decode_p50_ms"],
+                "decode_p99_ms": cont["decode_p99_ms"],
+            },
             "per_round_ratio": [round(r, 2) for r in ratios],
             "decode_itl": decode_itl,
             "prefix_hit_rate": prefix_cache.get("prefix_hit_rate", 0.0),

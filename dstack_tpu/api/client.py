@@ -145,6 +145,24 @@ class RunsApi:
             self._c._p("/runs/get_metrics"), {"run_name": run_name, "limit": limit}
         )
 
+    def get_traces(
+        self,
+        run_name: str,
+        request_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        limit: int = 20,
+    ) -> dict:
+        """Flight-recorder traces merged across the service's replicas:
+        {"run_name", "status", "replicas_queried", "errors", "traces": [...]}.
+        Narrow with request_id (engine req id) or trace_id (the
+        X-Dstack-Trace-Id a response carried)."""
+        body: dict = {"run_name": run_name, "limit": limit}
+        if request_id is not None:
+            body["request_id"] = request_id
+        if trace_id is not None:
+            body["trace_id"] = trace_id
+        return self._c.post(self._c._p("/runs/get_traces"), body)
+
     def profile(self, run_name: str, seconds: float = 5.0) -> dict:
         """Trigger an on-demand profiler capture in the run's live workload;
         returns the agent ack ({"id", "artifact_dir", ...}). Completion shows

@@ -680,7 +680,7 @@ def cmd_top(args) -> None:
     def render() -> None:
         runs = [r for r in client.runs.list() if not r.status.is_finished()]
         headers = ["RUN", "STATUS", "HOST", "STEP", "STEP TIME", "COLL WAIT",
-                   "MFU", "TOK/S", "GOODPUT", "SKEW", "FLAG"]
+                   "MFU", "TOK/S", "GOODPUT", "SKEW", "TTFT", "ITL", "FLAG"]
         rows = []
         for r in runs:
             try:
@@ -688,7 +688,7 @@ def cmd_top(args) -> None:
             except DstackTpuError:
                 wl = None
             if not wl:
-                rows.append([r.run_name, r.status.value] + ["-"] * 9)
+                rows.append([r.run_name, r.status.value] + ["-"] * 11)
                 continue
             latest = wl.get("latest") or {}
             ledger = wl.get("goodput") or {}
@@ -697,6 +697,17 @@ def cmd_top(args) -> None:
             )
             skew = wl.get("skew") or {}
             skew_s = f"{skew['ratio']:.2f}x" if skew.get("ratio") is not None else "-"
+            # Serving latency (engine flight-recorder summary, rendered
+            # p50/p99): only service runs emit these; training rows show "-".
+            engine = wl.get("engine") or {}
+            ttft_s = (
+                f"{engine['ttft_p50_ms']:.0f}/{engine['ttft_p99_ms']:.0f}ms"
+                if engine.get("ttft_p50_ms") is not None else "-"
+            )
+            itl_s = (
+                f"{engine['itl_p50_ms']:.0f}/{engine['itl_p99_ms']:.0f}ms"
+                if engine.get("itl_p50_ms") is not None else "-"
+            )
             hosts = wl.get("hosts") or []
             if not hosts:
                 mfu = latest.get("mfu")
@@ -709,7 +720,7 @@ def cmd_top(args) -> None:
                         f"{mfu * 100:.1f}%" if mfu is not None else "-",
                         f"{latest['tokens_per_sec']:,.0f}"
                         if latest.get("tokens_per_sec") is not None else "-",
-                        goodput, skew_s, "",
+                        goodput, skew_s, ttft_s, itl_s, "",
                     ]
                 )
                 continue
@@ -730,6 +741,8 @@ def cmd_top(args) -> None:
                         ("-" if i == 0 else ""),
                         goodput if i == 0 else "",
                         skew_s if i == 0 else "",
+                        ttft_s if i == 0 else "",
+                        itl_s if i == 0 else "",
                         "STRAGGLER" if h.get("straggler") else "",
                     ]
                 )
@@ -741,6 +754,113 @@ def cmd_top(args) -> None:
             print("no live runs", flush=True)
 
     _watch_loop(render, not args.once, args.interval)
+
+
+def _fmt_ms(seconds) -> str:
+    if seconds is None:
+        return "-"
+    return f"{seconds * 1000:.1f}ms" if seconds < 1 else f"{seconds:.2f}s"
+
+
+def _render_trace_timeline(t: dict) -> None:
+    """ASCII span timeline for one flight-recorder record: where the
+    request's wall time went, stage by stage, on one scale."""
+    total = t.get("total_s") or 0.0
+    stages = [
+        ("queue", t.get("queue_wait_s") or 0.0),
+        ("prefill", t.get("prefill_s") or 0.0),
+        ("decode", t.get("decode_s") or 0.0),
+    ]
+    print(
+        f"request {t.get('req_id', '-')}  trace {t.get('trace_id') or '-'}"
+        f"  replica {t.get('replica', '-')}"
+        + ("  [SLOW]" if t.get("slow") else "")
+    )
+    print(
+        f"  prompt {t.get('prompt_tokens', '-')} tok"
+        f" (cached {t.get('cached_tokens', 0)}),"
+        f" generated {t.get('tokens', '-')} tok,"
+        f" preemptions {t.get('preemptions', 0)},"
+        f" spec accepted {t.get('spec_accepted', 0)}/{t.get('spec_proposed', 0)}"
+    )
+    width = 40
+    offset = 0.0
+    for name, dur in stages:
+        if total > 0:
+            lead = int(round(offset / total * width))
+            bar = max(int(round(dur / total * width)), 1 if dur > 0 else 0)
+        else:
+            lead = bar = 0
+        print(f"  {name:<8} {' ' * lead}{'█' * bar:<{width - lead}} {_fmt_ms(dur)}")
+        offset += dur
+    print(f"  {'total':<8} {'─' * width} {_fmt_ms(total)}"
+          f"  (ttft {_fmt_ms(t.get('ttft_s'))})")
+
+
+def cmd_trace(args) -> None:
+    """Per-request flight-recorder view (`dstack-tpu trace <run>`): the last
+    N completed requests across the service's replicas, and a stage-by-stage
+    span timeline for a specific request (--request engine id, or --trace the
+    X-Dstack-Trace-Id a client response carried)."""
+    client = _client()
+    data = client.runs.get_traces(
+        args.run_name,
+        request_id=args.request,
+        trace_id=args.trace,
+        limit=args.limit,
+    )
+    if args.json:
+        import json as json_lib
+
+        print(json_lib.dumps(data), flush=True)
+        return
+    for err in data.get("errors") or []:
+        print(f"warning: replica {err.get('replica')}: {err.get('error')}")
+    traces = data.get("traces") or []
+    if not traces:
+        where = (
+            f" matching {args.request or args.trace}"
+            if (args.request or args.trace) else ""
+        )
+        print(
+            f"no recorded request traces{where}"
+            f" ({data.get('replicas_queried', 0)} replicas queried;"
+            " the flight recorder only holds completed requests)"
+        )
+        return
+    if args.request or args.trace:
+        # Narrowed query: full span timeline per match (usually exactly one).
+        for t in traces:
+            _render_trace_timeline(t)
+            print()
+        return
+    rows = [
+        [
+            t.get("req_id", "-"),
+            (t.get("trace_id") or "-")[:16],
+            str(t.get("replica", "-")),
+            _fmt_ms(t.get("queue_wait_s")),
+            _fmt_ms(t.get("prefill_s")),
+            _fmt_ms(t.get("ttft_s")),
+            _fmt_ms(t.get("decode_s")),
+            _fmt_ms(t.get("total_s")),
+            str(t.get("tokens", "-")),
+            "SLOW" if t.get("slow") else "",
+        ]
+        for t in traces
+    ]
+    print(
+        _table(
+            ["REQUEST", "TRACE", "REPLICA", "QUEUE", "PREFILL", "TTFT",
+             "DECODE", "TOTAL", "TOK", "FLAG"],
+            rows,
+        ),
+        flush=True,
+    )
+    print(
+        "\nrun `dstack-tpu trace "
+        f"{args.run_name} --request <REQUEST>` for a span timeline"
+    )
 
 
 def cmd_offer(args) -> None:
@@ -767,8 +887,8 @@ def cmd_offer(args) -> None:
 
 
 _SUBCOMMANDS = (
-    "server config init apply attach metrics events ps top stop delete logs offer fleet"
-    " gateway volume secret backend instance project profile stats completion"
+    "server config init apply attach metrics events ps top trace stop delete logs offer"
+    " fleet gateway volume secret backend instance project profile stats completion"
 )
 
 
@@ -988,6 +1108,21 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--once", action="store_true",
                    help="render one frame and exit (no refresh loop)")
     s.set_defaults(func=cmd_top)
+
+    s = sub.add_parser(
+        "trace",
+        help="per-request serving traces from the replicas' flight recorders"
+             " (stage timeline: queue wait, prefill, TTFT, decode)",
+    )
+    s.add_argument("run_name")
+    s.add_argument("--request", help="narrow to one engine request id")
+    s.add_argument("--trace",
+                   help="narrow to one trace id (the X-Dstack-Trace-Id header"
+                        " a proxied response carried)")
+    s.add_argument("--limit", type=int, default=20)
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output (merged trace records)")
+    s.set_defaults(func=cmd_trace)
 
     s = sub.add_parser("stop", help="stop runs")
     s.add_argument("runs", nargs="+")

@@ -110,6 +110,7 @@ async def forward(
     timeout_total: float = DEFAULT_TIMEOUT_TOTAL,
     body: bytes = None,
     on_first_chunk: Optional[Callable[[aiohttp.ClientResponse], None]] = None,
+    extra_headers: Optional[dict] = None,
 ) -> web.StreamResponse:
     """Forward `request` to http://host:port/<tail> (+query), streaming back.
 
@@ -117,11 +118,19 @@ async def forward(
     from upstream (buffered known-length responses never call it): for SSE
     token streams that instant is time-to-first-token — the latency signal a
     held-open stream's total duration would poison. The callback gets the
-    upstream response (headers readable) and must not raise or block."""
+    upstream response (headers readable) and must not raise or block.
+
+    ``extra_headers`` are injected into the UPSTREAM request (overriding any
+    same-named client header) — the proxy uses this to stamp its trace id on
+    every forwarded request. Upstream response headers flow back to the client
+    untouched (minus hop-by-hop), so a replica echoing the trace header is
+    visible end to end."""
     url = f"http://{host}:{port}/{tail.lstrip('/')}"
     if request.query_string:
         url += f"?{request.query_string}"
     headers = {k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS}
+    if extra_headers:
+        headers.update(extra_headers)
     if body is None:
         body = await request.read()
     timeout = (

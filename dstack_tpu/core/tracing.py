@@ -40,6 +40,11 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
 )
 
+# The header carrying a trace id across process hops: runner client -> agent
+# (already), and service proxy -> serving replica (ISSUE 18). One constant so
+# every hop agrees on the spelling.
+TRACE_HEADER = "X-Dstack-Trace-Id"
+
 _trace_id: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
     "dstack_tpu_trace_id", default=None
 )
@@ -62,6 +67,32 @@ def new_trace() -> str:
     _trace_id.set(tid)
     _span_id.set(None)
     return tid
+
+
+def set_trace_id(trace_id: str) -> str:
+    """Adopt an externally-minted trace id (e.g. the proxy's
+    ``X-Dstack-Trace-Id`` header arriving at a serving replica) as the current
+    context's trace, so spans and logs on this side join the caller's trace."""
+    _trace_id.set(trace_id)
+    _span_id.set(None)
+    return trace_id
+
+
+def wrap_with_context(fn):
+    """Capture the CALLER's contextvars (trace/span ids included) and return a
+    callable running ``fn`` inside that snapshot.
+
+    ``contextvars`` don't cross thread boundaries: a ``threading.Thread``
+    target starts from an empty context, so a trace id bound before spawning
+    an engine worker thread silently vanishes inside it. Wrap the thread
+    target with this at construction time — the snapshot is taken HERE, not at
+    call time — and the spawned thread observes the spawner's trace."""
+    ctx = contextvars.copy_context()
+
+    def _in_context(*args, **kwargs):
+        return ctx.run(fn, *args, **kwargs)
+
+    return _in_context
 
 
 def current_trace_id() -> Optional[str]:
@@ -194,6 +225,49 @@ def reset() -> None:
     with _lock:
         _histograms.clear()
         _gauges.clear()
+
+
+def _esc_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_exposition(help_map: Optional[Dict[str, str]] = None) -> str:
+    """Prometheus text exposition of every registered histogram — the
+    replica-local ``/metrics`` surface (a serving engine runs in its own
+    process; the control plane's ``server/services/prometheus.py`` can't see
+    this registry). Families named in ``help_map`` are advertised (HELP/TYPE)
+    even before the first observation; format matches the server renderer, so
+    the same strict parser validates both."""
+    names = list(help_map or {})
+    for name in histogram_names():
+        if name not in names:
+            names.append(name)
+    lines: List[str] = []
+    for name in names:
+        help_ = (help_map or {}).get(name, f"Span duration for {name}")
+        lines.append(f"# HELP {name} " + help_.replace("\\", "\\\\").replace("\n", "\\n"))
+        lines.append(f"# TYPE {name} histogram")
+        snap = histogram_snapshot(name)
+        if snap is None:
+            continue
+        buckets, series = snap
+        for labels, cumulative, total, count in series:
+            for le, c in zip([f"{b:g}" for b in buckets] + ["+Inf"], cumulative):
+                inner = ",".join(
+                    f'{k}="{_esc_label(v)}"'
+                    for k, v in sorted({**labels, "le": le}.items())
+                )
+                lines.append(f"{name}_bucket{{{inner}}} {c:g}")
+            if labels:
+                inner = ",".join(
+                    f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items())
+                )
+                lines.append(f"{name}_sum{{{inner}}} {total:g}")
+                lines.append(f"{name}_count{{{inner}}} {count:g}")
+            else:
+                lines.append(f"{name}_sum {total:g}")
+                lines.append(f"{name}_count {count:g}")
+    return "\n".join(lines) + "\n"
 
 
 @contextlib.contextmanager

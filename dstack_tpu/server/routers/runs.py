@@ -188,6 +188,37 @@ async def get_run_metrics(request: web.Request) -> web.Response:
     )
 
 
+@routes.post("/api/project/{project_name}/runs/get_traces")
+async def get_run_traces(request: web.Request) -> web.Response:
+    """Fleet-wide flight-recorder readout for a service run: every running
+    replica's GET /debug/traces merged newest-first — the API behind
+    `dstack-tpu trace <run>`. Optional request_id / trace_id narrow to one
+    request (e.g. the X-Dstack-Trace-Id a slow client response carried)."""
+    _, project_row = await auth_project(request)
+    body = await body_dict(request)
+    db = request.app["db"]
+    from dstack_tpu.core.errors import ResourceNotExistsError
+    from dstack_tpu.server.services import proxy as proxy_service
+
+    run_name = body.get("run_name")
+    row = await db.fetchone(
+        "SELECT id, run_name, status FROM runs WHERE project_id = ? AND run_name = ?"
+        " AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"run {run_name} not found")
+    result = await proxy_service.collect_service_traces(
+        db,
+        project_row["id"],
+        row["run_name"],
+        request_id=body.get("request_id") or None,
+        trace_id=body.get("trace_id") or None,
+        limit=int(body.get("limit") or 20),
+    )
+    return web.json_response({"status": row["status"], **result})
+
+
 @routes.post("/api/project/{project_name}/runs/profile")
 async def profile_run(request: web.Request) -> web.Response:
     """Trigger an on-demand profiler capture in a run's live workload

@@ -72,6 +72,18 @@ _HISTOGRAM_HELP = {
     "dstack_tpu_ssh_tunnel_open_seconds": "SSH tunnel establishment time",
     "dstack_tpu_run_step_seconds": "Workload-reported training step wall time by run",
     "dstack_tpu_run_recovery_seconds": "Preemption rescue time-to-recover (failure detected -> gang-retried replica running) by run",
+    "dstack_tpu_service_ttft_seconds": "Proxy-observed time to first streamed chunk (TTFT) by run",
+    # Serving-engine request-lifecycle families (workloads/serve.py
+    # SERVE_HISTOGRAM_HELP — kept in sync by tests/test_metrics_lint.py; not
+    # imported, the serve module pulls JAX). Observed in this process when an
+    # engine runs in-process (tests, smoke); real replicas also expose them on
+    # their own GET /metrics.
+    "dstack_tpu_serve_queue_wait_seconds": "Engine admission-queue wait (request enqueued -> slot admitted) by replica",
+    "dstack_tpu_serve_prefill_seconds": "Prefill span (first prefill chunk launched -> first token) by replica",
+    "dstack_tpu_serve_ttft_seconds": "Engine-side time-to-first-token (enqueued -> first token) by replica",
+    "dstack_tpu_serve_itl_seconds": "Inter-token latency between consecutive generated tokens by replica",
+    "dstack_tpu_serve_decode_tokens_per_s": "Per-request decode throughput (generated tokens over the decode span) by replica",
+    "dstack_tpu_serve_step_stage_seconds": "Engine step time split by stage (admit/prefill/decode) by replica",
 }
 
 
@@ -377,6 +389,39 @@ async def render_metrics(db: Database) -> str:
             "Proxied service RPS over the trailing minute",
             "gauge",
             svc_rps,
+        )
+    )
+
+    # The proxy's sliding-window views, previously internal-only deques the
+    # autoscaler read: max queue depth in the trailing window and the windowed
+    # latency quantiles. The histogram families carry the full cumulative
+    # distribution; these gauges are the autoscaler's actual decision inputs,
+    # exported so a scale decision is explainable from /metrics alone.
+    svc_qd, svc_lat_q = [], []
+    for run_id, run_name in run_names.items():
+        labels = {"run": run_name}
+        depth = proxy_service.stats.queue_depth(run_id)
+        if depth is not None:
+            svc_qd.append((labels, float(depth)))
+        quantiles = proxy_service.stats.latency_quantiles(run_id)
+        if quantiles and quantiles.get("count"):
+            for q in ("p50", "p90"):
+                if quantiles.get(q) is not None:
+                    svc_lat_q.append(({**labels, "quantile": q}, float(quantiles[q])))
+    sections.append(
+        _fmt(
+            "dstack_tpu_service_queue_depth",
+            "Max replica-reported engine queue depth over the trailing window, by run",
+            "gauge",
+            svc_qd,
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_service_latency_window_seconds",
+            "Proxied request latency quantiles over the trailing window, by run",
+            "gauge",
+            svc_lat_q,
         )
     )
 

@@ -533,6 +533,66 @@ async def list_service_replicas(
     return out
 
 
+async def collect_service_traces(
+    db: Database,
+    project_id: str,
+    run_name: str,
+    request_id: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    limit: int = 20,
+) -> dict:
+    """Fan the flight-recorder query (GET /debug/traces) across every running
+    replica of a service and merge the results newest-first. A replica that
+    fails to answer is reported, not fatal — the debug surface must work
+    mid-incident, exactly when some replica is likely sick."""
+    import aiohttp
+
+    from dstack_tpu.core.services.http_forward import get_session
+
+    replicas = await list_service_replicas(db, project_id, run_name)
+    params = {"limit": str(max(int(limit), 1))}
+    if request_id:
+        params["request"] = request_id
+    if trace_id:
+        params["trace"] = trace_id
+
+    async def _fetch_one(jpd: JobProvisioningData, port: int) -> dict:
+        try:
+            host, eport = await replica_endpoint(jpd, port)
+            url = f"http://{host}:{eport}/debug/traces"
+            timeout = aiohttp.ClientTimeout(total=5.0)
+            async with get_session().get(url, params=params, timeout=timeout) as r:
+                if r.status != 200:
+                    return {"error": f"HTTP {r.status}", "traces": []}
+                return await r.json()
+        except (aiohttp.ClientError, OSError, asyncio.TimeoutError, ValueError) as e:
+            return {"error": str(e) or type(e).__name__, "traces": []}
+
+    results = await asyncio.gather(
+        *(_fetch_one(jpd, port) for _, jpd, _, port in replicas)
+    )
+    traces: List[dict] = []
+    errors: List[dict] = []
+    for (row, jpd, _, _), payload in zip(replicas, results):
+        replica_num = load_job_spec(row).replica_num
+        if payload.get("error"):
+            errors.append({"replica": replica_num, "error": payload["error"]})
+            continue
+        for t in payload.get("traces", []):
+            t = dict(t)
+            t.setdefault("replica", str(payload.get("replica", replica_num)))
+            traces.append(t)
+    # Newest-first across the fleet; finished_at is wall-clock, good enough to
+    # interleave replicas (per-replica order is already newest-first).
+    traces.sort(key=lambda t: t.get("finished_at", 0.0), reverse=True)
+    return {
+        "run_name": run_name,
+        "replicas_queried": len(replicas),
+        "errors": errors,
+        "traces": traces[: max(int(limit), 1)],
+    }
+
+
 async def probe_service_replicas(db: Database, project_id: str, run_name: str) -> None:
     """Readiness probe per replica socket; outcome lands in
     job_runtime_data.probe_ready (reference service probes/nginx health checks).
@@ -695,6 +755,14 @@ async def proxy_request(
             retrying=bool(tried),
         )
 
+    # One trace id per proxied request, honored end to end: reuse the client's
+    # header when present (a caller correlating across services), otherwise
+    # mint one. The same id is stamped on the upstream request (the replica's
+    # flight recorder keys its entry by it) and echoed back to the client, so
+    # `dstack-tpu trace <run>` can go from a slow proxy-side latency straight
+    # to the engine stage that caused it.
+    trace_id = request.headers.get(tracing.TRACE_HEADER) or tracing.new_trace()
+
     t0 = time.monotonic()
     started = False  # headers/chunks already relayed: retrying is impossible
 
@@ -732,6 +800,7 @@ async def proxy_request(
                 resp = await forward(
                     request, host, local_port, tail, body=body,
                     on_first_chunk=_on_first_chunk,
+                    extra_headers={tracing.TRACE_HEADER: trace_id},
                 )
                 resilience.record_success(target)
                 break
@@ -766,6 +835,11 @@ async def proxy_request(
             "dstack_tpu_service_request_latency_seconds", elapsed, {"run": run_name}
         )
         _record_queue_depth(entry.run_id, resp.headers, endpoint=picked)
+    # Replicas running the dstack serve app echo the trace header themselves
+    # (it flows back through forward's header copy); for non-dstack upstreams
+    # stamp it here so the client always learns the id its request ran under.
+    if tracing.TRACE_HEADER not in resp.headers:
+        resp.headers[tracing.TRACE_HEADER] = trace_id
     return resp
 
 

@@ -65,6 +65,7 @@ import dataclasses
 import functools
 import json
 import logging
+import os
 import re
 import threading
 import time
@@ -75,6 +76,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dstack_tpu.core import tracing
 from dstack_tpu.workloads import model as model_lib
 from dstack_tpu.workloads import quantize as quant_lib
 from dstack_tpu.workloads import sharding as sharding_lib
@@ -299,6 +301,24 @@ class GenRequest:
     pos: int = 0
     # Prompt tokens served from the prefix cache at last admission (stats).
     cached_tokens: int = 0
+    # -- request-level observability (ISSUE 18) ---------------------------
+    # Host-side lifecycle stamps (time.monotonic), set once each: admission
+    # into a slot, first prefill chunk launched, first generated token (TTFT),
+    # and completion. Preemption re-admissions do NOT restamp — queue wait and
+    # prefill attribute to the request's first pass; re-prefill cost shows up
+    # in `preemptions` and the decode span instead. All of this is host-only
+    # bookkeeping: the device sees the exact same program either way.
+    trace_id: Optional[str] = None   # proxy-issued X-Dstack-Trace-Id
+    admitted_t: float = 0.0
+    prefill_start_t: float = 0.0
+    first_token_t: float = 0.0
+    finished_t: float = 0.0
+    # Per-token emission stamps (ITL samples); bounded by max_new_tokens.
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    # Per-request speculative-decode accounting (engine totals aggregate
+    # these; the flight recorder reports them per trace).
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     # Speculative-decode proposer state, built lazily on the first draft:
     # the full emitted stream (prompt + generated — invariant under
     # preemption refolds, which only move tokens between the two lists) and
@@ -771,6 +791,123 @@ def _bucket(n: int, lo: int = 8) -> int:
     return b
 
 
+# ---------------------------------------------------------------------------
+# Request-level serving observability (ISSUE 18): stage histograms + a
+# flight recorder of completed request traces. Everything here is host-side
+# bookkeeping around the jitted calls — the device program is untouched, so
+# the instrumented engine is token-identical to the uninstrumented one by
+# construction (and tests/test_serve_observability.py asserts it).
+
+
+# Histogram families the engine observes (labeled by replica; the step-stage
+# family adds a `stage` label). Advertised cold on both the replica-local
+# /metrics (create_serve_app) and the control plane's exposition
+# (server/services/prometheus.py _HISTOGRAM_HELP).
+SERVE_HISTOGRAM_HELP = {
+    "dstack_tpu_serve_queue_wait_seconds":
+        "Engine admission-queue wait (request enqueued -> slot admitted) by replica",
+    "dstack_tpu_serve_prefill_seconds":
+        "Prefill span (first prefill chunk launched -> first token) by replica",
+    "dstack_tpu_serve_ttft_seconds":
+        "Engine-side time-to-first-token (enqueued -> first token) by replica",
+    "dstack_tpu_serve_itl_seconds":
+        "Inter-token latency between consecutive generated tokens by replica",
+    "dstack_tpu_serve_decode_tokens_per_s":
+        "Per-request decode throughput (generated tokens over the decode span) by replica",
+    "dstack_tpu_serve_step_stage_seconds":
+        "Engine step time split by stage (admit/prefill/decode) by replica",
+}
+
+
+def _replica_label() -> str:
+    """Stable identity of this serving replica for metric labels: the
+    orchestrator's replica env when running under the agent, host rank as a
+    fallback, "0" for bare/test engines."""
+    return (
+        os.environ.get("DSTACK_TPU_REPLICA")
+        or os.environ.get("DSTACK_NODE_RANK")
+        or "0"
+    )
+
+
+class FlightRecorder:
+    """Bounded ring buffer of completed request traces (the per-request
+    "flight recorder"): the last N completions, plus a separate same-sized
+    ring for requests slower than a threshold so a burst of fast requests
+    can't evict the slow trace an operator is hunting. Queryable via the
+    replica's GET /debug/traces and fleet-wide through the control plane
+    (`dstack-tpu trace <run>`)."""
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        slow_threshold: Optional[float] = None,
+    ) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get("DSTACK_TPU_FLIGHT_RECORDER_SIZE", "128"))
+        if slow_threshold is None:
+            slow_threshold = float(
+                os.environ.get("DSTACK_TPU_FLIGHT_SLOW_SECONDS", "2.0")
+            )
+        self.capacity = max(int(capacity), 1)
+        self.slow_threshold = float(slow_threshold)
+        self._recent: Deque[dict] = collections.deque(maxlen=self.capacity)
+        self._slow: Deque[dict] = collections.deque(maxlen=self.capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def record(self, trace: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            trace = dict(trace, seq=self._seq)
+            trace["slow"] = trace.get("total_s", 0.0) >= self.slow_threshold
+            self._recent.append(trace)
+            if trace["slow"]:
+                self._slow.append(trace)
+
+    def snapshot(
+        self,
+        request_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> List[dict]:
+        """Newest-first merged view (recent ring + retained slow traces,
+        deduplicated), optionally filtered by request id or trace id."""
+        with self._lock:
+            merged = {t["seq"]: t for t in self._slow}
+            merged.update({t["seq"]: t for t in self._recent})
+        out = [merged[s] for s in sorted(merged, reverse=True)]
+        if request_id is not None:
+            out = [t for t in out if t.get("req_id") == request_id]
+        if trace_id is not None:
+            out = [t for t in out if t.get("trace_id") == trace_id]
+        if limit is not None:
+            out = out[: max(int(limit), 0)]
+        return out
+
+    def latency_summary(self) -> dict:
+        """TTFT/ITL p50/p99 (ms) over the recent ring — the engine telemetry
+        point's serving-latency fields (`dstack-tpu top` columns)."""
+        with self._lock:
+            records = list(self._recent)
+        ttfts = sorted(
+            t["ttft_s"] for t in records if t.get("ttft_s") is not None
+        )
+        itls = sorted(
+            ms / 1000.0 for t in records for ms in (t.get("itl_ms") or ())
+        )
+        from dstack_tpu.utils.common import nearest_rank
+
+        out: dict = {}
+        if ttfts:
+            out["ttft_p50_ms"] = round(nearest_rank(ttfts, 0.50) * 1000, 2)
+            out["ttft_p99_ms"] = round(nearest_rank(ttfts, 0.99) * 1000, 2)
+        if itls:
+            out["itl_p50_ms"] = round(nearest_rank(itls, 0.50) * 1000, 2)
+            out["itl_p99_ms"] = round(nearest_rank(itls, 0.99) * 1000, 2)
+        return out
+
+
 class ServeEngine:
     """Host-side continuous-batching loop over the jitted prefill/decode fns.
 
@@ -898,6 +1035,10 @@ class ServeEngine:
         self.pending: Deque[GenRequest] = collections.deque()
         self._lock = threading.Lock()
         self._req_counter = 0
+        # Observability: the metric label identifying this replica, and the
+        # ring buffer of completed request traces (GET /debug/traces).
+        self.replica = _replica_label()
+        self.flight = FlightRecorder()
         # Cumulative counters for /stats and bench extras.
         self.total_steps = 0
         self.total_tokens = 0
@@ -916,6 +1057,7 @@ class ServeEngine:
         max_new_tokens: Optional[int] = None,
         eos_id: Optional[int] = None,
         req_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> GenRequest:
         if not prompt_tokens:
             raise ValueError("empty prompt")
@@ -938,6 +1080,7 @@ class ServeEngine:
                 max_new_tokens=max_new,
                 eos_id=eos_id if eos_id is not None else self.ecfg.eos_id,
                 submitted_t=time.monotonic(),
+                trace_id=trace_id or tracing.current_trace_id(),
             )
             self.pending.append(req)
         return req
@@ -1021,12 +1164,33 @@ class ServeEngine:
         draft+verify with spec_tokens). Returns the tokens emitted this step,
         in emission order."""
         events: List[TokenEvent] = []
+        # Step-stage attribution (host wall time; the np.asarray conversions
+        # inside each _run_* force a device sync, so these spans are honest).
+        # Idle stages are not observed — an all-decode steady state must not
+        # bury the prefill distribution under zero-length samples.
+        labels = {"replica": self.replica}
+        t0 = time.monotonic()
         admitted = self._admit()
+        t_admit = time.monotonic()
+        if admitted:
+            tracing.observe(
+                "dstack_tpu_serve_step_stage_seconds", t_admit - t0,
+                {**labels, "stage": "admit"},
+            )
+        prefilled = False
         if not self._tier2_prefill:
             if admitted:
                 self._run_prefill(admitted, events)
+                prefilled = True
         elif any(self._prefilling(s) for s in range(self.ecfg.max_batch)):
             self._run_chunk_prefill(events)
+            prefilled = True
+        t_prefill = time.monotonic()
+        if prefilled:
+            tracing.observe(
+                "dstack_tpu_serve_step_stage_seconds", t_prefill - t_admit,
+                {**labels, "stage": "prefill"},
+            )
         decoding = [
             s for s, r in enumerate(self.slots)
             if r is not None and not self._prefilling(s)
@@ -1036,6 +1200,10 @@ class ServeEngine:
                 self._run_spec_decode(decoding, events)
             else:
                 self._run_decode(decoding, events)
+            tracing.observe(
+                "dstack_tpu_serve_step_stage_seconds",
+                time.monotonic() - t_prefill, {**labels, "stage": "decode"},
+            )
         self.total_steps += 1
         return events
 
@@ -1104,6 +1272,15 @@ class ServeEngine:
                 # the pool is under pressure and the gauge matters most.
                 self.total_prefix_lookup_tokens += len(req.prompt)
                 self.total_prefix_hit_tokens += matched
+            if req.admitted_t == 0.0:
+                # First admission only: a preemption re-admission is decode
+                # backpressure, not queue wait.
+                req.admitted_t = time.monotonic()
+                tracing.observe(
+                    "dstack_tpu_serve_queue_wait_seconds",
+                    req.admitted_t - req.submitted_t,
+                    {"replica": self.replica},
+                )
             self.slots[slot] = req
             admitted.append((slot, req))
         return admitted
@@ -1113,6 +1290,10 @@ class ServeEngine:
     ) -> None:
         page = self.ecfg.page_size
         pool = self.ecfg.num_pages
+        now = time.monotonic()
+        for _, req in admitted:
+            if req.prefill_start_t == 0.0:
+                req.prefill_start_t = now
         t_pad = _bucket(max(len(req.prompt) for _, req in admitted))
         b_pad = _bucket(len(admitted), lo=1)
         tokens = np.zeros((b_pad, t_pad), np.int32)
@@ -1163,8 +1344,11 @@ class ServeEngine:
         write_page = np.full((s_pad, chunk), pool, np.int32)
         write_off = np.zeros((s_pad, chunk), np.int32)
         tables = np.zeros((s_pad, self.table_width), np.int32)
+        now = time.monotonic()
         for i, slot in enumerate(slots):
             req = self.slots[slot]
+            if req.prefill_start_t == 0.0:
+                req.prefill_start_t = now  # first chunk of this request
             n = min(chunk, remaining[slot])
             tokens[i, :n] = req.prompt[req.pos:req.pos + n]
             starts[i] = req.pos
@@ -1307,6 +1491,8 @@ class ServeEngine:
             emitted = row_drafts[:accepted] + [int(out_tokens[slot, accepted])]
             self.total_spec_proposed += n - 1
             self.total_spec_accepted += accepted
+            req.spec_proposed += n - 1
+            req.spec_accepted += accepted
             # The accepted context tokens' K/V (row positions 0..accepted)
             # just landed; the new emitted tail token is not yet written.
             self.seq_lens[slot] += accepted + 1
@@ -1378,6 +1564,26 @@ class ServeEngine:
         self, slot: int, req: GenRequest, token: int, events: List[TokenEvent]
     ) -> None:
         req.tokens.append(token)
+        now = time.monotonic()
+        req.token_times.append(now)
+        labels = {"replica": self.replica}
+        if len(req.tokens) == 1:
+            # First generated token = prefill done: TTFT and the prefill span
+            # land here (a chunked prefill's span covers all its chunks).
+            req.first_token_t = now
+            tracing.observe(
+                "dstack_tpu_serve_ttft_seconds", now - req.submitted_t, labels
+            )
+            if req.prefill_start_t:
+                tracing.observe(
+                    "dstack_tpu_serve_prefill_seconds",
+                    now - req.prefill_start_t, labels,
+                )
+        else:
+            tracing.observe(
+                "dstack_tpu_serve_itl_seconds",
+                now - req.token_times[-2], labels,
+            )
         if req.spec_ctx is not None:
             req.spec_ctx.append(token)
             _ngram_record(req.spec_ctx, len(req.spec_ctx) - 1, req.spec_index)
@@ -1389,10 +1595,49 @@ class ServeEngine:
         events.append(TokenEvent(req.req_id, token, len(req.tokens) - 1, done))
         if done:
             req.done = True
+            req.finished_t = now
             self.total_finished += 1
+            decode_s = now - req.first_token_t
+            if len(req.tokens) > 1 and decode_s > 0:
+                tracing.observe(
+                    "dstack_tpu_serve_decode_tokens_per_s",
+                    (len(req.tokens) - 1) / decode_s, labels,
+                )
+            self.flight.record(self._request_trace(req))
             self._release_slot(slot)
         else:
             self.last_tokens[slot] = token
+
+    def _request_trace(self, req: GenRequest) -> dict:
+        """The flight-recorder record for a completed request: stage spans as
+        relative durations (monotonic stamps mean nothing across processes),
+        per-token gaps, and the per-request cache/spec attribution."""
+        ttft = req.first_token_t - req.submitted_t
+        return {
+            "req_id": req.req_id,
+            "trace_id": req.trace_id,
+            "replica": self.replica,
+            "finished_at": time.time(),
+            "queue_wait_s": round(req.admitted_t - req.submitted_t, 6),
+            "prefill_s": round(
+                req.first_token_t - req.prefill_start_t, 6
+            ) if req.prefill_start_t else 0.0,
+            "ttft_s": round(ttft, 6),
+            "decode_s": round(req.finished_t - req.first_token_t, 6),
+            "total_s": round(req.finished_t - req.submitted_t, 6),
+            # Original prompt length: preemption refolds append generated
+            # tokens to `prompt`, but exactly `absorbed` of them.
+            "prompt_tokens": len(req.prompt) - req.absorbed,
+            "cached_tokens": req.cached_tokens,
+            "tokens": len(req.tokens),
+            "preemptions": req.preemptions,
+            "spec_proposed": req.spec_proposed,
+            "spec_accepted": req.spec_accepted,
+            "itl_ms": [
+                round((b - a) * 1000, 3)
+                for a, b in zip(req.token_times, req.token_times[1:])
+            ],
+        }
 
     def _release_slot(self, slot: int) -> None:
         if self._cache is not None:
@@ -1479,12 +1724,17 @@ class EngineRunner(threading.Thread):
                 "run_start", workload="serve",
                 max_batch=engine.ecfg.max_batch, policy=engine.ecfg.policy,
             )
+        # contextvars don't cross thread boundaries: capture the constructing
+        # context (trace id included) so the step loop's spans and logs join
+        # the trace that started the engine instead of an anonymous one.
+        self._step_loop_in_ctx = tracing.wrap_with_context(self._step_loop)
 
     def submit(
         self,
         prompt_tokens: List[int],
         max_new_tokens: Optional[int],
         on_event: Callable[[TokenEvent], None],
+        trace_id: Optional[str] = None,
     ) -> GenRequest:
         """Register a per-token callback (invoked on the ENGINE thread; wrap
         with loop.call_soon_threadsafe for asyncio consumers) and enqueue.
@@ -1496,7 +1746,9 @@ class EngineRunner(threading.Thread):
             req_id = f"http-{self._sub_counter}"
             self._subs[req_id] = on_event
         try:
-            req = self.engine.submit(prompt_tokens, max_new_tokens, req_id=req_id)
+            req = self.engine.submit(
+                prompt_tokens, max_new_tokens, req_id=req_id, trace_id=trace_id
+            )
         except Exception:
             with self._subs_lock:
                 self._subs.pop(req_id, None)
@@ -1526,6 +1778,9 @@ class EngineRunner(threading.Thread):
                     preemptions=s["preemptions"],
                     prefix_hit_rate=s["prefix_hit_rate"],
                     spec_accept_rate=s["spec_accept_rate"],
+                    # Serving-latency quantiles over the flight-recorder
+                    # window — `dstack-tpu top`'s TTFT/ITL columns.
+                    **self.engine.flight.latency_summary(),
                 )
         for ev in events:
             with self._subs_lock:
@@ -1539,6 +1794,9 @@ class EngineRunner(threading.Thread):
                     logger.exception("token subscriber failed")
 
     def run(self) -> None:
+        self._step_loop_in_ctx()
+
+    def _step_loop(self) -> None:
         while not self._stop.is_set():
             if not self.engine.has_work():
                 self._wake.wait(self.idle_wait)
@@ -1597,6 +1855,15 @@ def create_serve_app(runner: EngineRunner):
             raise web.HTTPBadRequest(text="max_tokens must be a positive integer")
         stream = bool(body.get("stream", True))
 
+        # Adopt the caller's trace (the proxy stamps X-Dstack-Trace-Id on every
+        # forwarded request) or mint one, so the engine's flight-recorder entry
+        # for this request is joinable to the proxy-side latency record.
+        trace_id = request.headers.get(tracing.TRACE_HEADER)
+        if trace_id:
+            tracing.set_trace_id(trace_id)
+        else:
+            trace_id = tracing.new_trace()
+
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
@@ -1604,7 +1871,7 @@ def create_serve_app(runner: EngineRunner):
             loop.call_soon_threadsafe(queue.put_nowait, ev)
 
         try:
-            runner.submit(tokens, max_new, on_event)
+            req = runner.submit(tokens, max_new, on_event, trace_id=trace_id)
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
 
@@ -1616,14 +1883,20 @@ def create_serve_app(runner: EngineRunner):
                 if ev.done:
                     break
             return web.json_response(
-                {"tokens": out, "text": "".join(decode_token(t) for t in out)},
-                headers=qd_headers(),
+                {
+                    "tokens": out,
+                    "text": "".join(decode_token(t) for t in out),
+                    "request_id": req.req_id,
+                    "trace_id": trace_id,
+                },
+                headers={**qd_headers(), tracing.TRACE_HEADER: trace_id},
             )
 
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-store",
+                tracing.TRACE_HEADER: trace_id,
                 **qd_headers(),
             }
         )
@@ -1647,10 +1920,45 @@ def create_serve_app(runner: EngineRunner):
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"}, headers=qd_headers())
 
+    async def debug_traces(request: web.Request) -> web.Response:
+        """Flight-recorder readout: last-N completed request traces plus the
+        slow-request ring, filterable by request or trace id. The proxy fans
+        this out fleet-wide (services/proxy.py collect_service_traces)."""
+        limit_q = request.query.get("limit")
+        try:
+            limit = int(limit_q) if limit_q else None
+        except ValueError:
+            raise web.HTTPBadRequest(text="limit must be an integer")
+        traces = engine.flight.snapshot(
+            request_id=request.query.get("request") or None,
+            trace_id=request.query.get("trace") or None,
+            limit=limit,
+        )
+        return web.json_response(
+            {
+                "replica": engine.replica,
+                "capacity": engine.flight.capacity,
+                "slow_threshold_s": engine.flight.slow_threshold,
+                "traces": traces,
+            },
+            headers=qd_headers(),
+        )
+
+    async def metrics(request: web.Request) -> web.Response:
+        # Replica-local Prometheus surface: the engine runs in its own process,
+        # so the control plane's /metrics can't see this registry directly.
+        return web.Response(
+            text=tracing.render_exposition(SERVE_HISTOGRAM_HELP),
+            content_type="text/plain",
+            headers=qd_headers(),
+        )
+
     app = web.Application()
     app.router.add_post("/generate", generate)
     app.router.add_get("/stats", stats)
     app.router.add_get("/health", health)
+    app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_get("/metrics", metrics)
     return app
 
 

@@ -1,0 +1,89 @@
+"""Metrics-exposition lint (ISSUE 18 satellite): scrape the control plane's
+GET /metrics from a real test server, strict-parse it, and assert every
+``dstack_tpu_*`` series name emitted anywhere in the package appears in the
+docs metric reference (docs/guides/observability.md).
+
+The docs-coverage half is the rename tripwire: a metric silently renamed in
+code but not in the guide (or a new family added without documentation) fails
+here, not in a user's broken dashboard."""
+
+import re
+from pathlib import Path
+
+from dstack_tpu.server.services.prometheus import _HISTOGRAM_HELP
+from tests.common import api_server
+from tests.test_run_events import parse_exposition
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "dstack_tpu"
+DOCS = REPO / "docs" / "guides" / "observability.md"
+
+# Identifiers matching the metric-name pattern that are NOT metric families.
+NON_METRIC_NAMES = {
+    "dstack_tpu_trace_id",  # contextvar names (core/tracing.py)
+    "dstack_tpu_span_id",
+    "dstack_tpu_replica_id",  # contextvar (server/services/leases.py)
+}
+
+
+def _codebase_metric_names() -> set:
+    """Every dstack_tpu_* family name referenced in package source. Names are
+    snake_case with >= 2 words after the prefix (filters comment placeholders
+    like ``dstack_tpu_service_<name>``, whose capture stops at ``<``)."""
+    names = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        for m in re.finditer(
+            r"dstack_tpu_[a-z0-9_]*[a-z0-9]", path.read_text(encoding="utf-8")
+        ):
+            name = m.group(0)
+            if name in NON_METRIC_NAMES or name.count("_") < 3:
+                continue
+            names.add(name)
+    return names
+
+
+class TestMetricsExposition:
+    async def test_scrape_strict_parses_and_advertises_families(self):
+        """A cold server's /metrics passes the strict format parser and
+        advertises every histogram family (discovery must not require
+        traffic)."""
+        async with api_server() as api:
+            resp = await api.client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+        families = parse_exposition(text)
+        for name in _HISTOGRAM_HELP:
+            assert name in families, f"advertised family {name} missing"
+            assert families[name]["type"] == "histogram"
+        # Scraped family names are themselves lintable metric names.
+        for name in families:
+            assert re.fullmatch(r"dstack_tpu_[a-z0-9_]+", name), name
+
+    async def test_every_emitted_name_is_documented(self):
+        """Every dstack_tpu_* series name in the package (tracing.observe
+        calls, gauge renders, advertised families) appears in the docs metric
+        reference — catches silent renames and undocumented additions."""
+        emitted = _codebase_metric_names()
+        # Sanity: the scan actually sees the known families from both the
+        # control plane and the serving engine.
+        assert "dstack_tpu_service_request_latency_seconds" in emitted
+        assert "dstack_tpu_serve_ttft_seconds" in emitted
+        doc_text = DOCS.read_text(encoding="utf-8")
+        missing = sorted(n for n in emitted if n not in doc_text)
+        assert not missing, (
+            "metric names emitted in code but absent from"
+            f" docs/guides/observability.md: {missing}"
+        )
+
+    async def test_scraped_families_are_documented(self):
+        """The rendered exposition itself (including families composed at
+        render time) stays covered by the docs reference."""
+        async with api_server() as api:
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+        doc_text = DOCS.read_text(encoding="utf-8")
+        missing = sorted(
+            name for name in parse_exposition(text)
+            if name not in doc_text
+        )
+        assert not missing, f"scraped families missing from docs: {missing}"

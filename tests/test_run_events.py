@@ -442,3 +442,77 @@ class TestTracer:
         assert s["count"] == 4
         assert s["p50"] == 0.025  # bucket upper bound containing the median
         assert s["mean"] == pytest.approx(0.061)
+
+
+class TestHistogramEdgeCases:
+    """Edge cases of the fixed-bucket cumulative histogram the whole metrics
+    surface rides on (ISSUE 18 satellite)."""
+
+    def test_observation_exactly_on_bucket_boundary(self):
+        """Prometheus semantics: le is INCLUSIVE — a value exactly equal to a
+        bucket bound lands in that bucket, not the next one."""
+        hist = tracing.Histogram("edge", buckets=(0.1, 0.5, 1.0))
+        hist.observe(0.5)
+        ((_, cumulative, total, count),) = hist.snapshot()
+        # cumulative = [<=0.1, <=0.5, <=1.0, +Inf]
+        assert cumulative == [0.0, 1.0, 1.0, 1.0]
+        assert count == 1 and total == 0.5
+
+    def test_observation_above_every_bucket(self):
+        hist = tracing.Histogram("edge", buckets=(0.1, 0.5))
+        hist.observe(7.0)
+        ((_, cumulative, _, count),) = hist.snapshot()
+        assert cumulative == [0.0, 0.0, 1.0]  # only +Inf
+        assert count == 1
+
+    def test_concurrent_observes_lose_nothing(self):
+        """module-level observe() is the thread-shared entry point (engine
+        thread + event loop + DB worker all call it): under the lock, N
+        threads x M observes must land exactly N*M counts."""
+        import threading
+
+        name = "edge_concurrent_hist"
+        n_threads, per_thread = 8, 200
+
+        def worker(i: int) -> None:
+            for j in range(per_thread):
+                tracing.observe(name, 0.01 * ((i + j) % 5), {"replica": str(i % 2)})
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _, series = tracing.histogram_snapshot(name)
+        assert sum(count for _, _, _, count in series) == n_threads * per_thread
+        # Cumulative monotonicity survived the interleaving in every series.
+        for _, cumulative, _, count in series:
+            assert cumulative == sorted(cumulative)
+            assert cumulative[-1] == count
+
+    def test_drop_series_of_live_label_set(self):
+        """drop_series removes exactly the named label set; the family and its
+        other series stay, and the dropped set can be re-observed fresh."""
+        name = "edge_drop_hist"
+        tracing.observe(name, 0.1, {"run": "a"})
+        tracing.observe(name, 0.2, {"run": "a"})
+        tracing.observe(name, 0.3, {"run": "b"})
+        tracing.drop_series(name, {"run": "a"})
+        _, series = tracing.histogram_snapshot(name)
+        assert [labels for labels, _, _, _ in series] == [{"run": "b"}]
+        # Re-observing the dropped set starts a fresh counter vector, not a
+        # resurrected one.
+        tracing.observe(name, 0.4, {"run": "a"})
+        _, series = tracing.histogram_snapshot(name)
+        by_labels = {tuple(sorted(l.items())): c for l, _, _, c in series}
+        assert by_labels[(("run", "a"),)] == 1
+        assert by_labels[(("run", "b"),)] == 1
+
+    def test_drop_series_unknown_family_and_labels_noop(self):
+        tracing.drop_series("edge_never_registered", {"run": "x"})
+        tracing.observe("edge_known", 0.1, {"run": "y"})
+        tracing.drop_series("edge_known", {"run": "z"})  # no such series
+        _, series = tracing.histogram_snapshot("edge_known")
+        assert len(series) == 1

@@ -1,0 +1,459 @@
+"""Request-level serving observability (ISSUE 18): proxy -> engine trace
+propagation, per-request lifecycle stage attribution, and the flight recorder.
+
+The invariant everything rides on: instrumentation is host-side bookkeeping —
+timestamps, a ring buffer, histogram observes — and must never change a single
+emitted token. The first test pins that against the greedy reference decode;
+the rest drive the trace path end to end (client -> proxy -> replica ->
+flight recorder -> get_traces API) with the real in-server proxy."""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from dstack_tpu.core import tracing
+from dstack_tpu.workloads import model as model_lib
+from dstack_tpu.workloads import serve as serve_lib
+from dstack_tpu.workloads.config import get_config
+from tests.test_run_events import parse_exposition
+
+TINY = get_config(
+    "test", n_layers=2, d_model=64, n_heads=4, n_kv_heads=2, d_ff=128,
+    vocab_size=251, max_seq_len=128, dtype="float32", param_dtype="float32",
+    remat=False,
+)
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return model_lib.init_params(TINY, jax.random.PRNGKey(0))
+
+
+def make_engine(params, **overrides) -> serve_lib.ServeEngine:
+    kwargs = dict(page_size=8, num_pages=32, max_batch=4, max_seq=128)
+    kwargs.update(overrides)
+    return serve_lib.ServeEngine(
+        TINY, serve_lib.EngineConfig(**kwargs), params=params
+    )
+
+
+def run_to_completion(engine, limit=500):
+    steps = 0
+    while engine.has_work():
+        engine.step()
+        steps += 1
+        assert steps < limit, "engine never drained"
+    return steps
+
+
+class TestTokenIdentity:
+    def test_instrumented_engine_token_identical(self, params):
+        """The whole instrumented path (stage stamps, histogram observes,
+        flight recording) emits exactly the tokens the full-context greedy
+        reference emits — instrumentation is provably scheduling-invisible."""
+        engine = make_engine(params)
+        reqs = [engine.submit(p, max_new_tokens=6) for p in PROMPTS]
+        run_to_completion(engine)
+        for prompt, req in zip(PROMPTS, reqs):
+            ref = serve_lib.greedy_reference_decode(params, TINY, prompt, 6)
+            assert req.tokens == ref, f"instrumented decode diverged for {prompt}"
+
+    def test_stage_timestamps_monotonic(self, params):
+        """enqueued <= admitted <= prefill start <= first token <= finished,
+        and the flight record's derived durations agree with the stamps."""
+        engine = make_engine(params)
+        reqs = [engine.submit(p, max_new_tokens=5) for p in PROMPTS]
+        run_to_completion(engine)
+        for req in reqs:
+            assert req.submitted_t <= req.admitted_t <= req.prefill_start_t
+            assert req.prefill_start_t <= req.first_token_t <= req.finished_t
+            assert len(req.token_times) == len(req.tokens)
+        traces = engine.flight.snapshot()
+        assert len(traces) == len(PROMPTS)
+        for t in traces:
+            assert t["queue_wait_s"] >= 0
+            assert t["prefill_s"] >= 0
+            assert t["ttft_s"] >= t["prefill_s"]
+            assert t["total_s"] >= t["ttft_s"]
+            assert t["total_s"] == pytest.approx(
+                t["ttft_s"] + t["decode_s"], abs=1e-4
+            )
+            assert len(t["itl_ms"]) == t["tokens"] - 1
+
+    def test_lifecycle_histograms_observed(self, params):
+        """Every request-lifecycle family registers with the replica label and
+        counts match the workload (one TTFT per request, one ITL per
+        consecutive token pair)."""
+        tracing.reset()
+        try:
+            engine = make_engine(params)
+            reqs = [engine.submit(p, max_new_tokens=5) for p in PROMPTS]
+            run_to_completion(engine)
+            labels = {"replica": engine.replica}
+            assert tracing.summary(
+                "dstack_tpu_serve_ttft_seconds", labels
+            )["count"] == len(PROMPTS)
+            assert tracing.summary(
+                "dstack_tpu_serve_queue_wait_seconds", labels
+            )["count"] == len(PROMPTS)
+            itl = tracing.summary("dstack_tpu_serve_itl_seconds", labels)
+            assert itl["count"] == sum(len(r.tokens) - 1 for r in reqs)
+            # Step-stage split: admit/prefill/decode all saw work this run.
+            stages = {
+                s[0].get("stage")
+                for s in tracing.histogram_snapshot(
+                    "dstack_tpu_serve_step_stage_seconds"
+                )[1]
+            }
+            assert stages == {"admit", "prefill", "decode"}
+        finally:
+            tracing.reset()
+
+
+class TestFlightRecorder:
+    def _trace(self, i: int, total: float = 0.01) -> dict:
+        return {
+            "req_id": f"req-{i}", "trace_id": f"tid-{i}", "replica": "0",
+            "finished_at": float(i), "queue_wait_s": 0.0, "prefill_s": 0.0,
+            "ttft_s": 0.005, "decode_s": total - 0.005, "total_s": total,
+            "prompt_tokens": 3, "cached_tokens": 0, "tokens": 4,
+            "preemptions": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "itl_ms": [1.0, 1.0, 1.0],
+        }
+
+    def test_ring_bounded_newest_first(self):
+        fr = serve_lib.FlightRecorder(capacity=8, slow_threshold=100.0)
+        for i in range(20):
+            fr.record(self._trace(i))
+        got = fr.snapshot()
+        assert len(got) == 8
+        assert [t["req_id"] for t in got] == [f"req-{i}" for i in range(19, 11, -1)]
+
+    def test_slow_requests_survive_fast_burst(self):
+        """A slow trace must stay queryable after capacity-many fast
+        completions — the whole point of the second ring."""
+        fr = serve_lib.FlightRecorder(capacity=4, slow_threshold=1.0)
+        fr.record(self._trace(0, total=5.0))
+        for i in range(1, 10):
+            fr.record(self._trace(i, total=0.01))
+        got = fr.snapshot()
+        slow = [t for t in got if t["slow"]]
+        assert [t["req_id"] for t in slow] == ["req-0"]
+        assert fr.snapshot(request_id="req-0")[0]["total_s"] == 5.0
+
+    def test_filters_and_limit(self):
+        fr = serve_lib.FlightRecorder(capacity=16, slow_threshold=100.0)
+        for i in range(6):
+            fr.record(self._trace(i))
+        assert [t["req_id"] for t in fr.snapshot(limit=2)] == ["req-5", "req-4"]
+        assert fr.snapshot(trace_id="tid-3")[0]["req_id"] == "req-3"
+        assert fr.snapshot(request_id="req-1", trace_id="tid-2") == []
+
+    def test_latency_summary_quantiles(self):
+        fr = serve_lib.FlightRecorder(capacity=16, slow_threshold=100.0)
+        for i in range(4):
+            fr.record(self._trace(i))
+        out = fr.latency_summary()
+        assert out["ttft_p50_ms"] == 5.0
+        assert out["itl_p50_ms"] == 1.0
+        assert serve_lib.FlightRecorder(capacity=4).latency_summary() == {}
+
+
+class TestTraceContextAcrossThreads:
+    def test_wrap_with_context_carries_trace_id(self):
+        """Regression for the contextvars-don't-cross-threads trap: a bare
+        thread target sees no trace id; the wrapped one sees the spawner's."""
+        tid = tracing.new_trace()
+        seen = {}
+
+        def target(key):
+            seen[key] = tracing.current_trace_id()
+
+        bare = threading.Thread(target=target, args=("bare",))
+        wrapped = threading.Thread(
+            target=tracing.wrap_with_context(target), args=("wrapped",)
+        )
+        bare.start(); bare.join()
+        wrapped.start(); wrapped.join()
+        assert seen["bare"] is None
+        assert seen["wrapped"] == tid
+
+    def test_wrap_snapshots_at_construction(self):
+        """The snapshot is taken when the wrapper is BUILT (EngineRunner
+        construction), not when the thread later calls it."""
+        first = tracing.new_trace()
+        wrapped = tracing.wrap_with_context(tracing.current_trace_id)
+        tracing.new_trace()  # rebind after capture
+        assert wrapped() == first
+
+    def test_engine_runner_thread_joins_constructing_trace(self, params):
+        """The runner's step loop runs under the trace that was current when
+        the runner was constructed (satellite 1 wired into EngineRunner)."""
+        tid = tracing.new_trace()
+        runner = serve_lib.EngineRunner(make_engine(params), idle_wait=0.01)
+        seen = {}
+        orig = runner.step_once
+
+        def spying_step_once():
+            seen["trace"] = tracing.current_trace_id()
+            return orig()
+
+        runner.step_once = spying_step_once
+        runner.start()
+        try:
+            req = runner.submit([1, 2, 3], 2, lambda ev: None)
+            deadline = time.monotonic() + 30
+            while not req.done and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert req.done
+            assert seen["trace"] == tid
+        finally:
+            runner.shutdown()
+
+
+class TestServeAppTracePath:
+    async def _with_app(self, params, fn, **engine_overrides):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        runner = serve_lib.EngineRunner(make_engine(params, **engine_overrides))
+        runner.start()
+        try:
+            client = TestClient(TestServer(serve_lib.create_serve_app(runner)))
+            await client.start_server()
+            try:
+                return await fn(client, runner)
+            finally:
+                await client.close()
+        finally:
+            runner.shutdown()
+
+    async def test_trace_header_adopted_and_echoed(self, params):
+        """A caller-supplied X-Dstack-Trace-Id is adopted (stamped on the
+        engine request, echoed on the response) and the flight-recorder entry
+        is retrievable by it via GET /debug/traces."""
+        async def fn(client, runner):
+            resp = await client.post(
+                "/generate",
+                json={"prompt_tokens": [1, 2, 3], "max_tokens": 3,
+                      "stream": False},
+                headers={tracing.TRACE_HEADER: "trace-e2e-1"},
+            )
+            assert resp.status == 200
+            assert resp.headers[tracing.TRACE_HEADER] == "trace-e2e-1"
+            body = await resp.json()
+            assert body["trace_id"] == "trace-e2e-1"
+            assert len(body["tokens"]) == 3
+
+            dbg = await client.get("/debug/traces", params={"trace": "trace-e2e-1"})
+            assert dbg.status == 200
+            payload = await dbg.json()
+            assert payload["replica"] == runner.engine.replica
+            (trace,) = payload["traces"]
+            assert trace["req_id"] == body["request_id"]
+            assert trace["tokens"] == 3
+        await self._with_app(params, fn)
+
+    async def test_trace_id_minted_when_absent(self, params):
+        async def fn(client, runner):
+            resp = await client.post(
+                "/generate",
+                json={"prompt_tokens": [5, 6], "max_tokens": 2, "stream": False},
+            )
+            assert resp.status == 200
+            minted = resp.headers[tracing.TRACE_HEADER]
+            assert minted
+            body = await resp.json()
+            assert body["trace_id"] == minted
+            assert runner.engine.flight.snapshot(trace_id=minted)
+        await self._with_app(params, fn)
+
+    async def test_sse_stream_carries_trace_header(self, params):
+        async def fn(client, runner):
+            resp = await client.post(
+                "/generate",
+                json={"prompt_tokens": [9, 10, 11], "max_tokens": 2,
+                      "stream": True},
+                headers={tracing.TRACE_HEADER: "trace-sse"},
+            )
+            assert resp.status == 200
+            assert resp.headers[tracing.TRACE_HEADER] == "trace-sse"
+            text = await resp.text()
+            assert "[DONE]" in text
+        await self._with_app(params, fn)
+
+    async def test_replica_metrics_endpoint_strict_parses(self, params):
+        """GET /metrics on the replica renders every serve family in valid
+        exposition format (validated by the same strict parser that guards
+        the control plane's renderer), advertised even before traffic."""
+        tracing.reset()
+        try:
+            async def fn(client, runner):
+                cold = await client.get("/metrics")
+                assert cold.status == 200
+                families = parse_exposition(await cold.text())
+                for name in serve_lib.SERVE_HISTOGRAM_HELP:
+                    assert name in families
+
+                resp = await client.post(
+                    "/generate",
+                    json={"prompt_tokens": [2, 3, 4], "max_tokens": 3,
+                          "stream": False},
+                )
+                assert resp.status == 200
+                warm = await client.get("/metrics")
+                families = parse_exposition(await warm.text())
+                samples = families["dstack_tpu_serve_ttft_seconds"]["samples"]
+                count = [
+                    v for n, labels, v in samples
+                    if n.endswith("_count")
+                    and labels.get("replica") == runner.engine.replica
+                ]
+                assert count == [1.0]
+            await self._with_app(params, fn)
+        finally:
+            tracing.reset()
+
+
+class TestProxyToEngineTracePath:
+    async def test_proxy_issued_trace_id_reaches_flight_recorder(self, params):
+        """The acceptance path: a request through the REAL in-server proxy gets
+        a proxy-minted X-Dstack-Trace-Id, the replica's flight recorder keys
+        its record by it, and the runs/get_traces API (the `dstack-tpu trace`
+        backend) finds that record fleet-wide by the same id."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from tests.common import api_server
+        from tests.test_serving_fast_path import _Fixture, seed_service
+
+        runner = serve_lib.EngineRunner(make_engine(params))
+        runner.start()
+        try:
+            replica = TestClient(TestServer(serve_lib.create_serve_app(runner)))
+            await replica.start_server()
+            try:
+                with _Fixture():
+                    async with api_server() as api:
+                        await seed_service(
+                            api.db, "svc-obs", replica.server.port
+                        )
+                        resp = await api.client.post(
+                            "/proxy/services/main/svc-obs/generate",
+                            json={"prompt_tokens": [1, 2, 3, 4],
+                                  "max_tokens": 3, "stream": False},
+                        )
+                        assert resp.status == 200
+                        tid = resp.headers[tracing.TRACE_HEADER]
+                        assert tid
+                        body = await resp.json()
+                        assert body["trace_id"] == tid
+
+                        data = await api.post(
+                            "/api/project/main/runs/get_traces",
+                            {"run_name": "svc-obs", "trace_id": tid},
+                        )
+                        assert data["replicas_queried"] == 1
+                        assert data["errors"] == []
+                        (trace,) = data["traces"]
+                        assert trace["trace_id"] == tid
+                        assert trace["req_id"] == body["request_id"]
+                        assert trace["tokens"] == 3
+            finally:
+                await replica.close()
+        finally:
+            runner.shutdown()
+
+    async def test_client_supplied_trace_id_wins(self, params):
+        """A client correlating across services keeps its own id: the proxy
+        reuses rather than re-mints, end to end into the engine record."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from tests.common import api_server
+        from tests.test_serving_fast_path import _Fixture, seed_service
+
+        runner = serve_lib.EngineRunner(make_engine(params))
+        runner.start()
+        try:
+            replica = TestClient(TestServer(serve_lib.create_serve_app(runner)))
+            await replica.start_server()
+            try:
+                with _Fixture():
+                    async with api_server() as api:
+                        await seed_service(
+                            api.db, "svc-own-id", replica.server.port
+                        )
+                        resp = await api.client.post(
+                            "/proxy/services/main/svc-own-id/generate",
+                            json={"prompt_tokens": [8, 9], "max_tokens": 2,
+                                  "stream": False},
+                            headers={tracing.TRACE_HEADER: "caller-id-7"},
+                        )
+                        assert resp.status == 200
+                        assert resp.headers[tracing.TRACE_HEADER] == "caller-id-7"
+                        assert runner.engine.flight.snapshot(
+                            trace_id="caller-id-7"
+                        )
+            finally:
+                await replica.close()
+        finally:
+            runner.shutdown()
+
+
+class TestTraceCli:
+    def test_timeline_renders_all_stages(self, capsys):
+        from dstack_tpu.cli.main import _render_trace_timeline
+
+        _render_trace_timeline({
+            "req_id": "http-3", "trace_id": "abcd1234", "replica": "1",
+            "queue_wait_s": 0.05, "prefill_s": 0.2, "ttft_s": 0.25,
+            "decode_s": 0.75, "total_s": 1.0, "prompt_tokens": 64,
+            "cached_tokens": 32, "tokens": 12, "preemptions": 1,
+            "spec_proposed": 10, "spec_accepted": 7, "slow": True,
+        })
+        out = capsys.readouterr().out
+        assert "http-3" in out and "abcd1234" in out and "[SLOW]" in out
+        for stage in ("queue", "prefill", "decode", "total"):
+            assert stage in out
+        assert "spec accepted 7/10" in out
+        assert "ttft 250.0ms" in out
+
+    def test_cmd_trace_lists_and_narrows(self, capsys, monkeypatch):
+        import dstack_tpu.cli.main as cli_main
+
+        records = [{
+            "req_id": "http-1", "trace_id": "tid-x", "replica": "0",
+            "queue_wait_s": 0.001, "prefill_s": 0.01, "ttft_s": 0.011,
+            "decode_s": 0.02, "total_s": 0.031, "tokens": 5, "slow": False,
+        }]
+
+        class FakeRuns:
+            def get_traces(self, run_name, request_id=None, trace_id=None,
+                           limit=20):
+                out = records
+                if request_id:
+                    out = [t for t in out if t["req_id"] == request_id]
+                return {"run_name": run_name, "replicas_queried": 1,
+                        "errors": [], "traces": out}
+
+        class FakeClient:
+            runs = FakeRuns()
+
+        monkeypatch.setattr(cli_main, "_client", lambda: FakeClient())
+        parser = cli_main.build_parser()
+
+        args = parser.parse_args(["trace", "svc"])
+        args.func(args)
+        out = capsys.readouterr().out
+        assert "http-1" in out and "REQUEST" in out
+
+        args = parser.parse_args(["trace", "svc", "--request", "http-1"])
+        args.func(args)
+        out = capsys.readouterr().out
+        assert "queue" in out and "decode" in out  # timeline mode
+
+        args = parser.parse_args(["trace", "svc", "--request", "nope"])
+        args.func(args)
+        assert "no recorded request traces" in capsys.readouterr().out
