@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-routing bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos smoke-gang release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-routing bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos smoke-gang smoke-usage release publish clean
 
 all: runner wheel
 
@@ -132,6 +132,15 @@ smoke-gang:
 # Prints one JSON line; a missing surface is a non-zero exit.
 smoke-observability:
 	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_observability()"
+
+# Fleet accounting smoke: a real server drives one run end-to-end with a
+# slow scripted agent, one metering tick lands ledger chip-seconds within
+# 10% of wall x chips, and `dstack-tpu usage` renders the row; then an
+# unplaceable run must log a placement_attempt event (reason no_offers),
+# carry `waiting: no_offers` for ps -v, and raise the pending-reason gauge.
+# Prints one JSON line; a missing surface is a non-zero exit.
+smoke-usage:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_usage()"
 
 # Serving smoke: boots the server + a real tier-2 engine replica (prefix
 # cache + chunked prefill + speculative decode), streams SSE tokens through

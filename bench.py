@@ -768,9 +768,65 @@ def bench_scheduler() -> dict:
                 await sched.stop()
         return lats
 
+    async def project_queue_waits(n: int = 30) -> dict:
+        """Per-project queue-wait distribution (ISSUE 19): a 3-project mixed
+        submit storm through the real loops, p50/p99 of submission -> first
+        provisioning event per project — the fairness readout the usage API's
+        queue_wait column aggregates."""
+        from dstack_tpu.utils.common import from_iso
+
+        FakeRunnerClient.reset()
+        tasks.get_runner_client = FakeRunnerClient.for_jpd
+        projects = ["acct-a", "acct-b", "acct-c"]
+        async with api_server() as api:
+            for p in projects:
+                await api.post("/api/projects/create", {"project_name": p})
+                await setup_mock_backend(api, p)
+            for i in range(n):
+                await api.post(
+                    f"/api/project/{projects[i % 3]}/runs/submit",
+                    tpu_task_spec(f"qw-{i}", "v5e-8" if i % 2 else "v5e-16"),
+                )
+            for _ in range(400):
+                await tasks.process_submitted_jobs(api.db, batch=25)
+                await tasks.process_running_jobs(api.db, batch=50)
+                await tasks.process_terminating_jobs(api.db, batch=50)
+                await tasks.process_runs(api.db, batch=50)
+                done = await api.db.fetchone(
+                    "SELECT COUNT(*) AS n FROM runs WHERE status = 'done'"
+                )
+                if done["n"] >= n:
+                    break
+            rows = await api.db.fetchall(
+                "SELECT p.name AS project, r.submitted_at,"
+                " MIN(e.timestamp) AS placed"
+                " FROM runs r JOIN projects p ON p.id = r.project_id"
+                " JOIN run_events e ON e.run_id = r.id AND e.job_id IS NOT NULL"
+                "  AND e.new_status = 'provisioning'"
+                " GROUP BY r.id"
+            )
+            waits: dict = {}
+            for r in rows:
+                w = (
+                    from_iso(r["placed"]) - from_iso(r["submitted_at"])
+                ).total_seconds()
+                waits.setdefault(r["project"], []).append(max(0.0, w))
+            out = {}
+            for p, vals in sorted(waits.items()):
+                vals.sort()
+                out[p] = {
+                    "runs": len(vals),
+                    "p50_ms": round(vals[len(vals) // 2] * 1000, 1),
+                    "p99_ms": round(
+                        vals[min(len(vals) - 1, int(len(vals) * 0.99))] * 1000, 1
+                    ),
+                }
+            return out
+
     dt = asyncio.run(run())
     lat_nudge = asyncio.run(submit_assign_latency(nudge=True))
     lat_poll = asyncio.run(submit_assign_latency(nudge=False))
+    qw_by_project = asyncio.run(project_queue_waits())
     import statistics
 
     rate = N * 60.0 / dt
@@ -801,6 +857,8 @@ def bench_scheduler() -> dict:
                 "nudge": round(statistics.median(lat_nudge) * 1000.0, 1),
                 "interval_poll": round(statistics.median(lat_poll) * 1000.0, 1),
             },
+            # Queue-wait fairness across a 3-project mixed storm (ISSUE 19).
+            "queue_wait_by_project": qw_by_project,
         },
     }
 
@@ -1176,6 +1234,171 @@ async def _render_cli_metrics(api, run_name: str) -> str:
             cli_main._client = old_client
 
     return await asyncio.get_event_loop().run_in_executor(None, _run)
+
+
+async def _render_cli_usage(api, json_out: bool = False) -> str:
+    """Run `dstack-tpu usage` against the in-process test server and return
+    its stdout (executor thread: the requests client is synchronous)."""
+    import argparse
+    import asyncio
+    import contextlib
+    import io
+
+    from dstack_tpu.api.client import Client
+    from dstack_tpu.cli import main as cli_main
+
+    url = str(api.client.make_url("")).rstrip("/")
+    client = Client(url, api.token, project="main")
+    args = argparse.Namespace(project=None, since=None, json=json_out)
+
+    def _run() -> str:
+        old_client = cli_main._client
+        cli_main._client = lambda: client
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                cli_main.cmd_usage(args)
+            return buf.getvalue()
+        finally:
+            cli_main._client = old_client
+
+    return await asyncio.get_event_loop().run_in_executor(None, _run)
+
+
+def smoke_usage() -> dict:
+    """`make smoke-usage`: fleet accounting end to end. A real server drives
+    one v5e-8 run whose scripted agent keeps it running across several passes
+    (so the run has real wall time); one metering tick must land ledger
+    chip-seconds within 10% of wall x chips, and `dstack-tpu usage` must
+    render the row. Then an unplaceable run (max_price below every offer)
+    must leave a placement_attempt event with reason no_offers, surface
+    `waiting: no_offers` in ps -v, and raise the pending-reason gauge.
+    Raises (non-zero exit) on any missing piece."""
+    import asyncio
+
+    from dstack_tpu.core import tracing
+    from dstack_tpu.server.background import tasks
+    from dstack_tpu.server.services import usage as usage_service
+    from dstack_tpu.utils.common import from_iso
+    from tests.common import (
+        FakeRunnerClient,
+        api_server,
+        setup_mock_backend,
+        tpu_task_spec,
+    )
+
+    tracing.reset()
+    usage_service.reset()
+
+    class SlowAgent(FakeRunnerClient):
+        # Stay running for several pulls so the run accrues real wall time.
+        def default_script(self):
+            return [{"job_states": [{"state": "running"}], "logs": [], "offset": 1}] * 8 + [
+                {
+                    "job_states": [{"state": "done", "exit_status": 0}],
+                    "logs": [],
+                    "offset": 2,
+                }
+            ]
+
+    async def run() -> dict:
+        SlowAgent.reset()
+        tasks.get_runner_client = SlowAgent.for_jpd
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("smoke-acct", "v5e-8")
+            )
+            status = None
+            for _ in range(40):
+                await tasks.process_submitted_jobs(api.db)
+                await tasks.process_running_jobs(api.db)
+                await tasks.process_terminating_jobs(api.db)
+                await tasks.process_runs(api.db)
+                await tasks.process_instances(api.db)
+                row = await api.post(
+                    "/api/project/main/runs/get", {"run_name": "smoke-acct"}
+                )
+                status = row["status"]
+                if status in ("done", "failed", "terminated"):
+                    break
+                await asyncio.sleep(0.1)
+            assert status == "done", f"run ended {status}"
+
+            # One metering tick AFTER completion still captures the whole
+            # lifecycle window (accrual is lifecycle-anchored, not tick-based).
+            touched = await usage_service.meter(api.db)
+            assert touched == 1, f"meter touched {touched} runs"
+
+            anchor = await api.db.fetchone(
+                "SELECT MIN(timestamp) AS ts FROM run_events"
+                " WHERE job_id IS NOT NULL AND new_status = 'provisioning'"
+            )
+            job = await api.db.fetchone(
+                "SELECT finished_at FROM jobs WHERE finished_at IS NOT NULL"
+            )
+            wall = (
+                from_iso(job["finished_at"]) - from_iso(anchor["ts"])
+            ).total_seconds()
+            assert wall > 0.5, f"run too fast to meter meaningfully ({wall:.3f}s)"
+            ledger = await api.db.fetchone(
+                "SELECT SUM(chip_seconds) AS cs, SUM(dollars) AS d FROM usage_samples"
+            )
+            expected = 8 * wall  # v5e-8: 8 chips, 1 host
+            drift = abs(ledger["cs"] - expected) / expected
+            assert drift < 0.10, (
+                f"ledger {ledger['cs']:.2f} chip-s vs wall*chips {expected:.2f}"
+                f" ({drift * 100:.1f}% off)"
+            )
+            assert ledger["d"] > 0
+
+            # The CLI renders the row (fleet header + per-run table).
+            cli_out = await _render_cli_usage(api)
+            for needle in ("fleet:", "smoke-acct", "CHIP-S", "QUEUE-WAIT"):
+                assert needle in cli_out, f"usage CLI missing {needle!r}:\n{cli_out}"
+
+            # Placement decision log: an unplaceable run says WHY it waits.
+            await api.post(
+                "/api/project/main/runs/submit",
+                tpu_task_spec(
+                    "smoke-stuck",
+                    "v5e-8",
+                    max_price=0.0001,
+                    retry={"on_events": ["no-capacity"], "duration": 3600},
+                ),
+            )
+            await tasks.process_submitted_jobs(api.db)
+            events = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "smoke-stuck"}
+            )
+            attempts = [
+                e for e in events["events"] if e["new_status"] == "placement_attempt"
+            ]
+            assert attempts and attempts[0]["reason"] == "no_offers", events["events"]
+            stuck = await api.post(
+                "/api/project/main/runs/get", {"run_name": "smoke-stuck"}
+            )
+            assert stuck["status_message"] == "waiting: no_offers", stuck
+            resp = await api.client.get("/metrics")
+            text = await resp.text()
+            needle = (
+                'dstack_tpu_run_pending_reason{reason="no_offers",run="smoke-stuck"} 1'
+            )
+            assert needle in text, "pending-reason gauge missing from /metrics"
+
+            return {
+                "metric": "smoke_usage",
+                "value": round(ledger["cs"], 2),
+                "unit": "chip_seconds",
+                "wall_chip_seconds": round(expected, 2),
+                "drift_pct": round(drift * 100, 2),
+                "dollars": round(ledger["d"], 6),
+                "pending_reason": attempts[0]["reason"],
+            }
+
+    result = asyncio.run(run())
+    print(json.dumps(result))
+    return result
 
 
 def smoke_gang() -> dict:

@@ -65,6 +65,7 @@ class Client:
         self.gateways = GatewaysApi(self)
         self.projects = ProjectsApi(self)
         self.instances = InstancesApi(self)
+        self.usage = UsageApi(self)
 
     def post(self, path: str, body: Optional[dict] = None, data: Optional[bytes] = None) -> Any:
         url = self.url + path
@@ -312,6 +313,23 @@ class ProjectsApi:
 
     def delete(self, names: List[str]) -> None:
         self._c.post("/api/projects/delete", {"projects_names": names})
+
+
+class UsageApi:
+    def __init__(self, client: Client):
+        self._c = client
+
+    def get(self, project: Optional[str] = None, since: Optional[str] = None) -> dict:
+        """Fleet accounting readout: per-run chip-seconds/dollars/goodput rows,
+        per-project totals, and the fleet summary (chips by state, $/hr burn).
+        Scoped to the caller's projects; `project` narrows to one, `since` is
+        an ISO timestamp filtering the ledger's UTC-hour buckets."""
+        body: Dict[str, Any] = {}
+        if project:
+            body["project"] = project
+        if since:
+            body["since"] = since
+        return self._c.post("/api/usage/get", body)
 
 
 class GatewaysApi:

@@ -328,6 +328,7 @@ def cmd_ps(args) -> None:
             runs = [r for r in runs if not r.status.is_finished()] or runs[:5]
         headers = ["NAME", "TYPE", "RESOURCES", "STATUS", "OWNER", "COST", "AGE"]
         if args.verbose:
+            headers.append("WAITING")
             headers.append("PHASES")
         rows = []
         for r in runs:
@@ -341,6 +342,15 @@ def cmd_ps(args) -> None:
                 f"${r.cost:.2f}", _age(r.submitted_at),
             ]
             if args.verbose:
+                # WAITING: why the scheduler's last placement pass failed,
+                # from the placement decision log (runs.status_message carries
+                # `waiting: <reason>` while the run sits queued).
+                msg = r.status_message or ""
+                row.append(
+                    msg[len("waiting:"):].strip()
+                    if msg.startswith("waiting:") and r.status.value in ("pending", "submitted")
+                    else "-"
+                )
                 # One events call per listed run: -v is an operator surface,
                 # and ps caps the listing anyway.
                 try:
@@ -673,9 +683,44 @@ def cmd_top(args) -> None:
     """Live fleet health view (`dstack-tpu top`): runs × hosts over the
     existing REST API — last step, step time, collective wait, MFU, goodput,
     skew, straggler flag per host — so an operator watches a pod's health
-    without a Prometheus stack. Refreshes top(1)-style by default; --once
-    renders a single frame (scripts pipe `metrics --json` instead)."""
+    without a Prometheus stack. A one-line fleet accounting header (chips by
+    state, queued runs, $/hr burn) tops the frame. Refreshes top(1)-style by
+    default; --once renders a single frame; --json emits one frame of
+    machine-readable fleet summary + live runs."""
     client = _client()
+
+    def _fleet_header() -> tuple:
+        try:
+            fleet = client.usage.get()["fleet"]
+        except DstackTpuError:
+            return None, ""
+        line = (
+            f"fleet: {fleet['total_chips']} chips"
+            f" ({fleet['allocated_chips']} allocated, {fleet['idle_chips']} idle,"
+            f" {fleet['provisioning_chips']} provisioning)"
+            f" · {fleet['queued_runs']} queued"
+            f" · ${fleet['dollars_per_hour']:.2f}/hr"
+        )
+        return fleet, line
+
+    if args.json:
+        import json as json_lib
+
+        fleet, _ = _fleet_header()
+        runs = [r for r in client.runs.list() if not r.status.is_finished()]
+        print(
+            json_lib.dumps(
+                {
+                    "fleet": fleet,
+                    "runs": [
+                        {"run_name": r.run_name, "status": r.status.value}
+                        for r in runs
+                    ],
+                }
+            ),
+            flush=True,
+        )
+        return
 
     def render() -> None:
         runs = [r for r in client.runs.list() if not r.status.is_finished()]
@@ -748,6 +793,9 @@ def cmd_top(args) -> None:
                 )
         if not args.once:
             _clear_screen()
+        _, header = _fleet_header()
+        if header:
+            print(header, flush=True)
         if rows:
             print(_table(headers, rows), flush=True)
         else:
@@ -886,9 +934,79 @@ def cmd_offer(args) -> None:
     print(f"{result['total']} offers total")
 
 
+def _parse_since(value):
+    """`--since` accepts a relative window (\"2h\", \"30m\", \"1d\") or an ISO
+    timestamp; relatives resolve client-side so the server stays stateless."""
+    if not value:
+        return None
+    import datetime
+    import re as re_lib
+
+    from dstack_tpu.utils.common import now_utc, to_iso
+
+    m = re_lib.fullmatch(r"(\d+)([smhd])", value.strip())
+    if m:
+        seconds = int(m.group(1)) * {"s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
+        return to_iso(now_utc() - datetime.timedelta(seconds=seconds))
+    return value
+
+
+def cmd_usage(args) -> None:
+    """Fleet accounting readout (`dstack-tpu usage`): chip-seconds, estimated
+    dollars, goodput-weighted chip-seconds, and queue wait attributed to each
+    run, with per-project totals and the fleet burn line."""
+    client = _client()
+    data = client.usage.get(project=args.project, since=_parse_since(args.since))
+    if args.json:
+        import json as json_lib
+
+        print(json_lib.dumps(data), flush=True)
+        return
+    fleet = data["fleet"]
+    print(
+        f"fleet: {fleet['total_chips']} chips"
+        f" ({fleet['allocated_chips']} allocated, {fleet['idle_chips']} idle,"
+        f" {fleet['provisioning_chips']} provisioning)"
+        f" · {fleet['queued_runs']} queued"
+        f" · ${fleet['dollars_per_hour']:.2f}/hr"
+    )
+    if not data["runs"]:
+        print("no usage recorded" + (f" since {data['since']}" if data["since"] else ""))
+        return
+    rows = [
+        [
+            r["project"],
+            r["run_name"],
+            r["user"] or "-",
+            f"{r['chip_seconds']:,.0f}",
+            f"{r['goodput_chip_seconds']:,.0f}",
+            f"${r['dollars']:.2f}",
+            _fmt_secs(r["queue_wait_s"]) if r["queue_wait_s"] is not None else "-",
+            r["status"],
+        ]
+        for r in data["runs"]
+    ]
+    print(
+        _table(
+            ["PROJECT", "RUN", "USER", "CHIP-S", "GOODPUT-CHIP-S", "$EST",
+             "QUEUE-WAIT", "STATUS"],
+            rows,
+        )
+    )
+    print()
+    totals = [
+        [
+            t["project"], str(t["runs"]), f"{t['chip_seconds']:,.0f}",
+            f"{t['goodput_chip_seconds']:,.0f}", f"${t['dollars']:.2f}",
+        ]
+        for t in data["projects"]
+    ]
+    print(_table(["PROJECT", "RUNS", "CHIP-S", "GOODPUT-CHIP-S", "$EST"], totals))
+
+
 _SUBCOMMANDS = (
-    "server config init apply attach metrics events ps top trace stop delete logs offer"
-    " fleet gateway volume secret backend instance project profile stats completion"
+    "server config init apply attach metrics events ps top trace usage stop delete logs"
+    " offer fleet gateway volume secret backend instance project profile stats completion"
 )
 
 
@@ -1107,7 +1225,22 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--interval", type=float, default=2.0)
     s.add_argument("--once", action="store_true",
                    help="render one frame and exit (no refresh loop)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable single frame (fleet summary + live runs)")
     s.set_defaults(func=cmd_top)
+
+    s = sub.add_parser(
+        "usage",
+        help="fleet accounting: chip-seconds, $ estimate, goodput-weighted"
+             " chip-seconds, and queue wait per run and project",
+    )
+    s.add_argument("--project", help="narrow to one project")
+    s.add_argument("--since",
+                   help="only count ledger buckets at or after this time"
+                        " (ISO timestamp, or a relative window like 2h / 30m / 1d)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output (runs, project totals, fleet)")
+    s.set_defaults(func=cmd_usage)
 
     s = sub.add_parser(
         "trace",

@@ -27,6 +27,7 @@ from dstack_tpu.server.routers import projects as projects_router
 from dstack_tpu.server.routers import repos as repos_router
 from dstack_tpu.server.routers import runs as runs_router
 from dstack_tpu.server.routers import secrets as secrets_router
+from dstack_tpu.server.routers import usage as usage_router
 from dstack_tpu.server.routers import users as users_router
 from dstack_tpu.server.routers import volumes as volumes_router
 from dstack_tpu.server.routers._common import error_middleware
@@ -186,6 +187,7 @@ def create_app(
     app.add_routes(logs_router.routes)
     app.add_routes(instances_router.routes)
     app.add_routes(metrics_router.routes)
+    app.add_routes(usage_router.routes)
     app.add_routes(proxy_router.routes)
     app.add_routes(gateways_router.routes)
     app.on_startup.append(_on_startup)

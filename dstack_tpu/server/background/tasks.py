@@ -61,6 +61,7 @@ from dstack_tpu.server.services import logs as logs_service
 from dstack_tpu.server.services import offers as offers_service
 from dstack_tpu.server.services import jobs as jobs_service
 from dstack_tpu.server.services import resilience
+from dstack_tpu.server.services import usage as usage_service
 from dstack_tpu.server.services.jobs import (
     build_cluster_info,
     job_jpd,
@@ -269,6 +270,14 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
     offers: Optional[List[InstanceOffer]] = None
     placed_all = True
     breaker_open = False
+    # Placement decision log (ISSUE 19): per-slice rejection reasons for this
+    # pass. quota_reserved is the fair-share stub (ROADMAP item 3) — counted
+    # nowhere yet, documented in the taxonomy.
+    offer_count = 0
+    reject_reasons = {
+        "no_offers": 0, "no_capacity": 0, "breaker_open": 0,
+        "slice_busy": 0, "quota_reserved": 0,
+    }
     for s in range(num_slices):
         slice_jobs = job_rows[s * hosts_per_slice : (s + 1) * hosts_per_slice]
         if not slice_jobs or slice_jobs[0]["status"] != "submitted":
@@ -296,6 +305,7 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
                 # A concurrent placement (another run's task holds a different
                 # lock) won this slice; the transaction rolled back whole — try
                 # the next candidate.
+                reject_reasons["slice_busy"] += 1
                 continue
             assigned = True
             break
@@ -304,21 +314,31 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
         # Phase 2: provision a new slice (reference :415 _run_job_on_new_instance).
         if profile.creation_policy == CreationPolicy.REUSE:
             placed_all = False
+            reject_reasons["no_capacity"] += 1
             continue
         if offers is None:
             offers = await offers_service.get_offers_by_requirements(
                 db, project_row, requirements, profile
             )
             offers = [o for o in offers if o.availability.is_available()]
+            offer_count = len(offers)
+        if not offers:
+            placed_all = False
+            reject_reasons["no_offers"] += 1
+            continue
         outcome = await _provision_slice(
             db, project_row, run_row, run_spec, offers, slice_jobs, volumes=run_volumes
         )
         if outcome != "created":
             placed_all = False
+            reject_reasons[outcome] += 1
             if outcome == "breaker_open":
                 breaker_open = True
 
     if not placed_all:
+        await _record_placement_attempt(
+            db, run_row, project_row, offer_count, reject_reasons
+        )
         if breaker_open:
             # Graceful degradation: at least one matching offer sits behind a
             # backend whose circuit is open. That is not "no capacity" — the
@@ -327,6 +347,15 @@ async def _place_replica(db: Database, run_id: str, replica_num: int, submission
             await _requeue_breaker_open(db, run_row, job_rows)
         else:
             await _handle_no_capacity(db, run_row, job_rows, profile)
+    else:
+        # Placed: the run is no longer waiting — its pending-reason series and
+        # WAITING message must not outlive the decision that resolved them.
+        usage_service.clear_pending(run_row["run_name"])
+        await db.execute(
+            "UPDATE runs SET status_message = NULL"
+            " WHERE id = ? AND status_message LIKE 'waiting:%'",
+            (run_row["id"],),
+        )
 
 
 def _assign_job_tx(conn, job_row, instance_id: str, jpd_dict: dict) -> None:
@@ -498,17 +527,65 @@ async def _provision_slice(
     return "breaker_open" if breaker_skipped else "no_capacity"
 
 
+async def _record_placement_attempt(
+    db: Database, run_row, project_row, offer_count: int, reasons: Dict[str, int]
+) -> None:
+    """The placement decision log (ISSUE 19): one structured
+    ``placement_attempt`` run_event per failed pass — candidate-offer count +
+    rejection-reason breakdown as JSON in the message — deduped per pass like
+    backend_circuit_open (identical consecutive attempts stay silent). Also
+    updates the pending-reason registry (the /metrics gauges) and the run's
+    status_message (the ``ps -v`` WAITING column)."""
+    primary = usage_service.set_pending(
+        run_row["run_name"], run_row["id"], project_row["name"], offer_count, reasons
+    )
+    breakdown = {k: v for k, v in reasons.items() if v}
+    message = json.dumps(
+        {"offers": offer_count, "reasons": breakdown}, sort_keys=True
+    )
+    # Dedup window of 3: a stalled gang may interleave placement_attempt with
+    # backend_circuit_open, and either event looking only at the very last row
+    # would re-trigger the other every pass.
+    recent = await db.fetchall(
+        "SELECT new_status, message FROM run_events WHERE run_id = ?"
+        " ORDER BY seq DESC LIMIT 3",
+        (run_row["id"],),
+    )
+    if not any(
+        r["new_status"] == "placement_attempt" and r["message"] == message
+        for r in recent
+    ):
+        def _tx(conn) -> None:
+            events_service.record_event_tx(
+                conn,
+                run_row["id"],
+                "placement_attempt",
+                old_status=run_row["status"],
+                actor="scheduler",
+                reason=primary,
+                message=message,
+            )
+
+        await db.run(_tx)
+    await db.execute(
+        "UPDATE runs SET status_message = ? WHERE id = ?",
+        (f"waiting: {primary}", run_row["id"]),
+    )
+
+
 async def _requeue_breaker_open(db: Database, run_row, job_rows: List) -> None:
     """Skip-and-requeue: the gang stays queued while its backend's circuit is
     open, with ONE reason'd run_event (not one per 1s pass) so the timeline
     answers "why isn't my run placing"."""
     submitted = [r for r in job_rows if r["status"] == "submitted"]
     await touch_jobs(db, submitted)
-    last = await db.fetchone(
-        "SELECT reason FROM run_events WHERE run_id = ? ORDER BY seq DESC LIMIT 1",
+    # Same 3-deep dedup window as placement_attempt (the two interleave while
+    # a gang is stalled behind an open breaker).
+    recent = await db.fetchall(
+        "SELECT reason FROM run_events WHERE run_id = ? ORDER BY seq DESC LIMIT 3",
         (run_row["id"],),
     )
-    if last is not None and last["reason"] == "backend_circuit_open":
+    if any(r["reason"] == "backend_circuit_open" for r in recent):
         return
 
     def _tx(conn) -> None:
@@ -1219,6 +1296,13 @@ async def _process_terminating_run(db: Database, run_row) -> None:
             conn.execute(
                 "UPDATE runs SET status = ? WHERE id = ?", (final, run_row["id"])
             )
+            # A run that dies waiting must not keep its WAITING banner or its
+            # pending-reason gauge (the terminal reason is on the timeline).
+            conn.execute(
+                "UPDATE runs SET status_message = NULL"
+                " WHERE id = ? AND status_message LIKE 'waiting:%'",
+                (run_row["id"],),
+            )
             events_service.record_event_tx(
                 conn, run_row["id"], final,
                 old_status=run_row["status"], actor="scheduler", reason=reason.value,
@@ -1227,6 +1311,7 @@ async def _process_terminating_run(db: Database, run_row) -> None:
             leases_service.release_tx(conn, run_row["id"])
 
         await db.run(_finalize)
+        usage_service.clear_pending(run_row["run_name"])
 
 
 async def _process_active_run(db: Database, run_row) -> None:
@@ -1844,6 +1929,9 @@ async def process_metrics(db: Database) -> None:
     await gang_health_service.check_gang_health(db)
     await metrics_service.enforce_utilization_policies(db)
     await metrics_service.sweep_metrics(db)
+    # Fleet accounting tick (ISSUE 19): fold live jobs' accrual windows into
+    # the usage_samples ledger — O(live runs) like the passes above.
+    await usage_service.meter(db)
 
 
 # =====================================================================================

@@ -286,6 +286,30 @@ MIGRATIONS: List[Tuple[int, str]] = [
         CREATE INDEX ix_run_leases_expires ON run_leases(expires_at);
         """,
     ),
+    (
+        6,
+        # Fleet accounting ledger (services/usage.py): chip-seconds and
+        # dollars attributed to (project, user, run), one row per run per
+        # UTC-hour bucket, accrued incrementally by the metering pass.
+        # `last_sampled_at` is the per-run accrual cursor (MAX across the
+        # run's buckets) so metering is idempotent across restarts and
+        # replicas; rows are deleted when their run or project is deleted
+        # (the per-project /metrics counter resets, which rate() tolerates).
+        """
+        CREATE TABLE usage_samples (
+            run_id TEXT NOT NULL,
+            project_id TEXT NOT NULL,
+            user_id TEXT,
+            bucket TEXT NOT NULL,
+            chip_seconds REAL NOT NULL DEFAULT 0,
+            dollars REAL NOT NULL DEFAULT 0,
+            goodput_chip_seconds REAL NOT NULL DEFAULT 0,
+            last_sampled_at TEXT,
+            PRIMARY KEY (run_id, bucket)
+        );
+        CREATE INDEX ix_usage_samples_project ON usage_samples(project_id, bucket);
+        """,
+    ),
 ]
 
 

@@ -1,0 +1,39 @@
+"""/api/usage/get — the fleet accounting readout (ISSUE 19).
+
+Global route like /api/runs/list: admins see every live project, members see
+the projects they belong to. The body optionally narrows to one project by
+name and/or a `since` ISO timestamp (compared against the ledger's UTC-hour
+buckets).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dstack_tpu.core.errors import ResourceNotExistsError
+from dstack_tpu.server.routers._common import auth_user, body_dict
+from dstack_tpu.server.services import usage as usage_service
+
+routes = web.RouteTableDef()
+
+
+@routes.post("/api/usage/get")
+async def get_usage(request: web.Request) -> web.Response:
+    user_row = await auth_user(request)
+    body = await body_dict(request)
+    db = request.app["db"]
+    if user_row["global_role"] == "admin":
+        rows = await db.fetchall("SELECT id, name FROM projects WHERE deleted = 0")
+    else:
+        rows = await db.fetchall(
+            "SELECT p.id, p.name FROM projects p JOIN members m ON m.project_id = p.id"
+            " WHERE m.user_id = ? AND p.deleted = 0",
+            (user_row["id"],),
+        )
+    project = body.get("project")
+    if project:
+        rows = [r for r in rows if r["name"] == project]
+        if not rows:
+            raise ResourceNotExistsError(f"project {project} not found")
+    result = await usage_service.get_usage(db, rows, since=body.get("since") or None)
+    return web.json_response(result)

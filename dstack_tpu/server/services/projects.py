@@ -105,3 +105,9 @@ async def delete_projects(db: Database, names: List[str]) -> None:
     for name in names:
         row = await get_project_row(db, name)
         await db.execute("UPDATE projects SET deleted = 1 WHERE id = ?", (row["id"],))
+        # Fleet accounting: the project's ledger rows and any pending-reason
+        # entries die with it, so per-project /metrics series disappear on
+        # the next scrape instead of freezing at their last value.
+        from dstack_tpu.server.services import usage as usage_service
+
+        await usage_service.sweep_project(db, row["id"], name)

@@ -148,6 +148,90 @@ async def render_metrics(db: Database) -> str:
         )
     )
 
+    # Fleet accounting (ISSUE 19, services/usage.py): chips by state, per-
+    # project allocation/queue/usage, and the scheduler's pending reasons.
+    # All families render cold; the per-project series die with their project
+    # (delete_projects sweeps the ledger) and pending reasons live in the
+    # usage registry, swept on placement/terminal/delete.
+    from dstack_tpu.server.services import usage as usage_service
+
+    rows = await db.fetchall(
+        "SELECT status, instance_type FROM instances"
+        " WHERE status IN ('pending', 'provisioning', 'idle', 'busy')"
+    )
+    fleet_chips = {"allocated": 0, "idle": 0, "provisioning": 0}
+    for r in rows:
+        state = {"busy": "allocated", "idle": "idle"}.get(r["status"], "provisioning")
+        fleet_chips[state] += usage_service.job_chips(r["instance_type"])
+    sections.append(
+        _fmt(
+            "dstack_tpu_fleet_chips",
+            "TPU chips in the fleet by state (allocated = busy workers,"
+            " provisioning includes pending)",
+            "gauge",
+            [({"state": k}, float(v)) for k, v in sorted(fleet_chips.items())],
+        )
+    )
+    rows = await db.fetchall(
+        "SELECT p.name AS project, i.instance_type FROM instances i"
+        " JOIN projects p ON p.id = i.project_id"
+        " WHERE i.status = 'busy' AND p.deleted = 0"
+    )
+    alloc_by_project: Dict[str, int] = {}
+    for r in rows:
+        alloc_by_project[r["project"]] = alloc_by_project.get(
+            r["project"], 0
+        ) + usage_service.job_chips(r["instance_type"])
+    sections.append(
+        _fmt(
+            "dstack_tpu_project_allocated_chips",
+            "TPU chips currently allocated (busy workers) by project",
+            "gauge",
+            [({"project": k}, float(v)) for k, v in sorted(alloc_by_project.items())],
+        )
+    )
+    rows = await db.fetchall(
+        "SELECT p.name AS project, COUNT(*) AS n FROM runs r"
+        " JOIN projects p ON p.id = r.project_id"
+        " WHERE r.deleted = 0 AND r.status IN ('pending', 'submitted')"
+        " GROUP BY p.name"
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_project_queued_runs",
+            "Runs waiting for placement by project",
+            "gauge",
+            [({"project": r["project"]}, float(r["n"])) for r in rows],
+        )
+    )
+    rows = await db.fetchall(
+        "SELECT p.name AS project, SUM(u.chip_seconds) AS cs FROM usage_samples u"
+        " JOIN projects p ON p.id = u.project_id"
+        " WHERE p.deleted = 0 GROUP BY p.name"
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_project_chip_seconds_total",
+            "Chip-seconds attributed to the project's runs (ledger sum;"
+            " resets when runs or the project are deleted)",
+            "counter",
+            [({"project": r["project"]}, float(r["cs"] or 0.0)) for r in rows],
+        )
+    )
+    sections.append(
+        _fmt(
+            "dstack_tpu_run_pending_reason",
+            "1 while the submitted run's latest placement pass failed for"
+            " this reason (no_offers / no_capacity / breaker_open /"
+            " slice_busy / quota_reserved)",
+            "gauge",
+            [
+                ({"run": e["run"], "reason": e["reason"]}, 1.0)
+                for e in usage_service.pending_snapshot()
+            ],
+        )
+    )
+
     # Per-running-job latest sample (cpu micro is a counter; TPU gauges as-is).
     # One grouped join resolves every job's newest point: the correlated
     # MAX(timestamp) subquery this replaces re-scanned job_metrics_points once

@@ -512,6 +512,12 @@ async def delete_runs(db: Database, project_row, run_names: List[str]) -> None:
         from dstack_tpu.server.services import gang_health as gang_health_service
 
         gang_health_service.forget_run(row["id"])
+        # Fleet accounting: the run's ledger rows and pending-reason series
+        # go with it (the per-project chip-seconds counter resets, which
+        # rate() tolerates).
+        from dstack_tpu.server.services import usage as usage_service
+
+        await usage_service.sweep_run(db, row["id"], row["run_name"])
 
 
 def _validate_run_name(name: str) -> None:

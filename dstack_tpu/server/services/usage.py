@@ -1,0 +1,420 @@
+"""Fleet accounting and scheduling explainability (ISSUE 19).
+
+Two halves:
+
+1. **The chip-seconds ledger** (``usage_samples``): ``meter()`` attributes
+   chip-seconds, dollars, and goodput-weighted chip-seconds to
+   (project, user, run) from job/instance lifecycle rows — one row per run
+   per UTC-hour bucket, accrued incrementally. The pass is O(live jobs):
+   one join over live (or recently finished) jobs, one grouped cursor
+   fetch, one grouped provisioning-anchor fetch, one workload-points fetch
+   for the goodput weight. Accrual windows come from the lifecycle rows
+   themselves (provisioning start → finished_at/now), not from tick
+   wall-clock deltas, so a job that starts and finishes between two ticks
+   still bills its full window and a restart resumes from the persisted
+   ``last_sampled_at`` cursor without double counting. Single-writer: the
+   pass runs inside the server's process_metrics loop; multi-replica
+   deployments shard runs by lease before this matters.
+
+2. **The pending-reason registry**: the submitted-jobs pass records why a
+   run failed to place this pass (offer count + rejection-reason
+   breakdown). The registry renders as ``dstack_tpu_run_pending_reason``
+   gauges and backs the ``ps -v`` WAITING column (via runs.status_message);
+   entries die on successful placement, terminal transition, run/project
+   delete, and — defensively — when ``meter()`` notices the run is no
+   longer waiting.
+
+The placement-reason taxonomy (docs/guides/observability.md):
+``no_offers`` (no candidate offers matched), ``no_capacity`` (offers
+existed but every tried backend was out of stock), ``breaker_open``
+(matching offers sit behind a backend whose circuit is open),
+``slice_busy`` (every idle pool slice was claimed by a concurrent
+placement), ``quota_reserved`` (reserved for fair-share quotas —
+ROADMAP item 3; never emitted yet).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+from typing import Dict, List, Optional
+
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.common import from_iso, now_utc, to_iso
+
+logger = logging.getLogger(__name__)
+
+# Rejection reasons a placement pass can report, in precedence order for the
+# single "primary" reason (ties in the per-slice counts break this way).
+PENDING_REASONS = (
+    "breaker_open",
+    "no_capacity",
+    "slice_busy",
+    "quota_reserved",
+    "no_offers",
+)
+
+# Job statuses that occupy chips (instance assigned, slice alive or coming up).
+_ACCRUING_STATUSES = ("provisioning", "pulling", "running", "terminating")
+
+# run_name -> {"run_id", "project", "reason", "reasons", "offers", "since"}
+_pending: Dict[str, dict] = {}
+
+
+def reset() -> None:
+    """Test hook: drop all in-memory pending-reason state."""
+    _pending.clear()
+
+
+# =====================================================================================
+# Pending-reason registry (scheduling explainability)
+
+
+def set_pending(
+    run_name: str, run_id: str, project: str, offers: int, reasons: Dict[str, int]
+) -> str:
+    """Record why `run_name` failed to place this pass; returns the primary
+    reason (highest per-slice count, precedence order breaking ties)."""
+    breakdown = {k: v for k, v in reasons.items() if v}
+    primary = "no_offers"
+    best = -1
+    for key in PENDING_REASONS:
+        n = breakdown.get(key, 0)
+        if n > best:
+            primary, best = key, n
+    _pending[run_name] = {
+        "run_id": run_id,
+        "project": project,
+        "reason": primary,
+        "reasons": breakdown,
+        "offers": offers,
+        "since": to_iso(now_utc()),
+    }
+    return primary
+
+
+def clear_pending(run_name: str) -> None:
+    _pending.pop(run_name, None)
+
+
+def forget_run(run_name: str) -> None:
+    """Run deleted: its pending-reason series must not outlive it."""
+    _pending.pop(run_name, None)
+
+
+def forget_project(project_name: str) -> None:
+    """Project deleted: sweep every pending entry it owned."""
+    for name in [n for n, e in _pending.items() if e["project"] == project_name]:
+        del _pending[name]
+
+
+def pending_snapshot() -> List[dict]:
+    """Current waiting runs for /metrics: one entry per (run, reason)."""
+    return [
+        {"run": name, "reason": entry["reason"], "project": entry["project"]}
+        for name, entry in sorted(_pending.items())
+    ]
+
+
+# =====================================================================================
+# Chip-seconds metering
+
+
+def job_chips(instance_type_json) -> int:
+    """Per-worker chip count from an instance_type (JSON string or parsed
+    dict). The stored resources.tpu is slice-wide (chips across all hosts),
+    and one job occupies one host — same derivation as chips_per_host."""
+    if not instance_type_json:
+        return 0
+    if isinstance(instance_type_json, dict):
+        itype = instance_type_json
+    else:
+        try:
+            itype = json.loads(instance_type_json)
+        except ValueError:
+            return 0
+    tpu = (itype.get("resources") or {}).get("tpu") or {}
+    chips = int(tpu.get("chips") or 0)
+    hosts = int(tpu.get("hosts") or 1)
+    return chips // max(1, hosts) if chips else 0
+
+
+def _bucket(ts: datetime.datetime) -> str:
+    return to_iso(ts.replace(minute=0, second=0, microsecond=0))
+
+
+async def _goodput_ratios(db: Database, run_ids: List[str]) -> Dict[str, float]:
+    """Current goodput ratio per run (lead lineage, step/mark kinds — the
+    /metrics gauge query), defaulting absent/unknown ledgers to 1.0 so runs
+    without telemetry weigh goodput chip-seconds at face value."""
+    from dstack_tpu.server.services.metrics import compute_goodput
+
+    rows = await db.fetch_in(
+        "SELECT j.run_id, w.data FROM workload_metrics_points w"
+        " JOIN jobs j ON j.id = w.job_id"
+        " WHERE j.job_num = 0 AND j.replica_num = 0"
+        "   AND w.kind IN ('step', 'mark') AND j.run_id IN ({in})"
+        " ORDER BY w.timestamp ASC",
+        run_ids,
+    )
+    points: Dict[str, List[dict]] = {}
+    for r in rows:
+        try:
+            points.setdefault(r["run_id"], []).append(json.loads(r["data"]))
+        except ValueError:
+            continue
+    ratios: Dict[str, float] = {}
+    for run_id, pts in points.items():
+        ledger = compute_goodput(pts)
+        if ledger["ratio"] is not None:
+            ratios[run_id] = float(ledger["ratio"])
+    return ratios
+
+
+async def meter(db: Database, now: Optional[datetime.datetime] = None) -> int:
+    """One metering tick: fold every live job's accrual window since the
+    run's cursor into the ledger. Returns the number of runs touched."""
+    now = now or now_utc()
+    cutoff = to_iso(now - datetime.timedelta(seconds=settings.USAGE_FINISHED_GRACE))
+    # Chips and price come from the job's own provisioning data, not the
+    # instances join: a finished job's instance_id is already NULL (the slice
+    # returned to the pool), but its JPD keeps the instance_type it occupied.
+    rows = await db.fetchall(
+        "SELECT j.id AS job_id, j.run_id, j.status, j.finished_at,"
+        "       j.job_provisioning_data, r.project_id, r.user_id, r.run_name"
+        " FROM jobs j"
+        " JOIN runs r ON r.id = j.run_id"
+        " WHERE r.deleted = 0 AND j.job_provisioning_data IS NOT NULL"
+        "   AND (j.status IN ('provisioning', 'pulling', 'running', 'terminating')"
+        "        OR (j.finished_at IS NOT NULL AND j.finished_at >= ?))",
+        (cutoff,),
+    )
+    if _pending:
+        await _prune_pending(db)
+    if not rows:
+        return 0
+
+    by_run: Dict[str, List] = {}
+    for r in rows:
+        by_run.setdefault(r["run_id"], []).append(r)
+    run_ids = list(by_run)
+
+    cursor_rows = await db.fetch_in(
+        "SELECT run_id, MAX(last_sampled_at) AS cursor FROM usage_samples"
+        " WHERE run_id IN ({in}) GROUP BY run_id",
+        run_ids,
+    )
+    cursors = {r["run_id"]: from_iso(r["cursor"]) for r in cursor_rows if r["cursor"]}
+
+    # When each job started occupying its slice: the first provisioning event.
+    anchor_rows = await db.fetch_in(
+        "SELECT job_id, MIN(timestamp) AS ts FROM run_events"
+        " WHERE job_id IS NOT NULL AND new_status = 'provisioning'"
+        "   AND run_id IN ({in}) GROUP BY job_id",
+        run_ids,
+    )
+    anchors = {r["job_id"]: from_iso(r["ts"]) for r in anchor_rows if r["ts"]}
+
+    ratios = await _goodput_ratios(db, run_ids)
+
+    bucket = _bucket(now)
+    now_iso = to_iso(now)
+    touched = 0
+    for run_id, job_rows in by_run.items():
+        cursor = cursors.get(run_id)
+        chip_s = 0.0
+        dollars = 0.0
+        live = False
+        for j in job_rows:
+            start = anchors.get(j["job_id"])
+            if start is None:
+                continue
+            try:
+                jpd = json.loads(j["job_provisioning_data"])
+            except (TypeError, ValueError):
+                continue
+            if j["status"] in _ACCRUING_STATUSES:
+                live = True
+                end = now
+            else:
+                end = from_iso(j["finished_at"]) if j["finished_at"] else now
+            lo = max(start, cursor) if cursor is not None else start
+            dt = (min(end, now) - lo).total_seconds()
+            if dt <= 0:
+                continue
+            chip_s += job_chips(jpd.get("instance_type")) * dt
+            # Every worker's JPD carries the whole slice's price; bill it on
+            # worker 0 only so a multi-host gang counts its slice $/hr once.
+            if int(jpd.get("worker_num") or 0) == 0:
+                dollars += float(jpd.get("price") or 0.0) * dt / 3600.0
+        if chip_s <= 0 and dollars <= 0 and not live:
+            continue
+        ratio = ratios.get(run_id, 1.0)
+        await db.execute(
+            "INSERT INTO usage_samples (run_id, project_id, user_id, bucket,"
+            " chip_seconds, dollars, goodput_chip_seconds, last_sampled_at)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?)"
+            " ON CONFLICT (run_id, bucket) DO UPDATE SET"
+            " chip_seconds = usage_samples.chip_seconds + excluded.chip_seconds,"
+            " dollars = usage_samples.dollars + excluded.dollars,"
+            " goodput_chip_seconds = usage_samples.goodput_chip_seconds"
+            "   + excluded.goodput_chip_seconds,"
+            " last_sampled_at = excluded.last_sampled_at",
+            (
+                run_id,
+                job_rows[0]["project_id"],
+                job_rows[0]["user_id"],
+                bucket,
+                chip_s,
+                dollars,
+                chip_s * ratio,
+                now_iso,
+            ),
+        )
+        touched += 1
+    return touched
+
+
+async def _prune_pending(db: Database) -> None:
+    """Drop registry entries whose run is no longer waiting to place (stopped,
+    finished, or deleted outside the placement pass)."""
+    rows = await db.fetchall(
+        "SELECT run_name FROM runs WHERE deleted = 0"
+        " AND status IN ('pending', 'submitted')"
+    )
+    waiting = {r["run_name"] for r in rows}
+    for name in [n for n in _pending if n not in waiting]:
+        del _pending[name]
+
+
+async def sweep_run(db: Database, run_id: str, run_name: str) -> None:
+    """Run deleted: ledger rows and pending-reason series die with it."""
+    await db.execute("DELETE FROM usage_samples WHERE run_id = ?", (run_id,))
+    forget_run(run_name)
+
+
+async def sweep_project(db: Database, project_id: str, project_name: str) -> None:
+    """Project deleted: per-project ledger rows and pending entries go too
+    (the per-project /metrics series disappear on the next scrape)."""
+    await db.execute("DELETE FROM usage_samples WHERE project_id = ?", (project_id,))
+    forget_project(project_name)
+
+
+# =====================================================================================
+# Aggregation (the /usage/get API and the fleet header)
+
+
+async def fleet_summary(db: Database) -> dict:
+    """One-line fleet accounting: chips by state, queued runs, $/hr burn.
+    `allocated` = busy workers, `provisioning` = pending+provisioning,
+    matching the dstack_tpu_fleet_chips states."""
+    rows = await db.fetchall(
+        "SELECT status, instance_type, price FROM instances"
+        " WHERE status IN ('pending', 'provisioning', 'idle', 'busy')"
+    )
+    chips = {"allocated": 0, "idle": 0, "provisioning": 0}
+    burn = 0.0
+    for r in rows:
+        state = {"busy": "allocated", "idle": "idle"}.get(r["status"], "provisioning")
+        chips[state] += job_chips(r["instance_type"])
+        burn += float(r["price"] or 0.0)
+    queued = await db.fetchone(
+        "SELECT COUNT(*) AS n FROM runs WHERE deleted = 0"
+        " AND status IN ('pending', 'submitted')"
+    )
+    return {
+        "total_chips": sum(chips.values()),
+        "allocated_chips": chips["allocated"],
+        "idle_chips": chips["idle"],
+        "provisioning_chips": chips["provisioning"],
+        "queued_runs": int(queued["n"]),
+        "dollars_per_hour": burn,
+    }
+
+
+async def get_usage(
+    db: Database, project_rows: List, since: Optional[str] = None
+) -> dict:
+    """Ledger readout for the given projects: per-run rows (chip-seconds,
+    dollars, goodput-weighted chip-seconds, queue wait), per-project totals,
+    and the fleet summary. `since` is an ISO timestamp compared against the
+    hour buckets (lexical compare works: both are UTC ISO strings)."""
+    projects = {p["id"]: p["name"] for p in project_rows}
+    result = {
+        "runs": [],
+        "projects": [],
+        "fleet": await fleet_summary(db),
+        "since": since,
+    }
+    if not projects:
+        return result
+    params: List = list(projects)
+    q = (
+        "SELECT run_id, project_id, SUM(chip_seconds) AS chip_seconds,"
+        " SUM(dollars) AS dollars,"
+        " SUM(goodput_chip_seconds) AS goodput_chip_seconds"
+        f" FROM usage_samples WHERE project_id IN ({','.join('?' for _ in projects)})"
+    )
+    if since:
+        q += " AND bucket >= ?"
+        params.append(since)
+    q += " GROUP BY run_id, project_id"
+    sample_rows = await db.fetchall(q, params)
+    if not sample_rows:
+        return result
+
+    run_ids = [r["run_id"] for r in sample_rows]
+    run_rows = await db.fetch_in(
+        "SELECT r.id, r.run_name, r.status, r.submitted_at, u.username"
+        " FROM runs r LEFT JOIN users u ON u.id = r.user_id"
+        " WHERE r.id IN ({in})",
+        run_ids,
+    )
+    runs = {r["id"]: r for r in run_rows}
+    # Queue wait per run: submission -> the first job entering provisioning.
+    placed_rows = await db.fetch_in(
+        "SELECT run_id, MIN(timestamp) AS ts FROM run_events"
+        " WHERE job_id IS NOT NULL AND new_status = 'provisioning'"
+        "   AND run_id IN ({in}) GROUP BY run_id",
+        run_ids,
+    )
+    placed = {r["run_id"]: r["ts"] for r in placed_rows}
+
+    totals: Dict[str, dict] = {}
+    for s in sample_rows:
+        run = runs.get(s["run_id"])
+        project = projects.get(s["project_id"], "")
+        queue_wait = None
+        if run is not None and placed.get(s["run_id"]) and run["submitted_at"]:
+            queue_wait = max(
+                0.0,
+                (
+                    from_iso(placed[s["run_id"]]) - from_iso(run["submitted_at"])
+                ).total_seconds(),
+            )
+        result["runs"].append(
+            {
+                "project": project,
+                "run_name": run["run_name"] if run is not None else s["run_id"],
+                "user": run["username"] if run is not None else None,
+                "status": run["status"] if run is not None else "deleted",
+                "chip_seconds": float(s["chip_seconds"] or 0.0),
+                "dollars": float(s["dollars"] or 0.0),
+                "goodput_chip_seconds": float(s["goodput_chip_seconds"] or 0.0),
+                "queue_wait_s": queue_wait,
+            }
+        )
+        t = totals.setdefault(
+            project,
+            {"project": project, "chip_seconds": 0.0, "dollars": 0.0,
+             "goodput_chip_seconds": 0.0, "runs": 0},
+        )
+        t["chip_seconds"] += float(s["chip_seconds"] or 0.0)
+        t["dollars"] += float(s["dollars"] or 0.0)
+        t["goodput_chip_seconds"] += float(s["goodput_chip_seconds"] or 0.0)
+        t["runs"] += 1
+    result["runs"].sort(key=lambda r: (r["project"], -r["chip_seconds"]))
+    result["projects"] = sorted(totals.values(), key=lambda t: -t["chip_seconds"])
+    return result

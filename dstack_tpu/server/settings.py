@@ -51,6 +51,10 @@ PROCESS_SERVICES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_SERVICES_INTERVA
 PROCESS_AUTOSCALER_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_AUTOSCALER_INTERVAL", "2.0"))
 PROCESS_BATCH_SIZE = int(os.getenv("DSTACK_TPU_PROCESS_BATCH_SIZE", "10"))
 METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL", "3600"))
+# Fleet accounting (services/usage.py): jobs that finished within this many
+# seconds stay in the metering scan so their final accrual window (finish
+# between two ticks, or a short restart gap) is still folded into the ledger.
+USAGE_FINISHED_GRACE = float(os.getenv("DSTACK_TPU_USAGE_FINISHED_GRACE", "300"))
 
 # Concurrent scheduler fan-out: each background pass processes up to this many
 # independent runs/gangs at once (bounded asyncio.gather); per-run keyed locks

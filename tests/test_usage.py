@@ -1,0 +1,324 @@
+"""Fleet accounting and scheduling explainability (ISSUE 19): the chip-seconds
+ledger (services/usage.py meter), the /api/usage/get readout, the placement
+decision log (placement_attempt run_events + WAITING status_message +
+pending-reason gauges), the fleet/project utilization gauges on /metrics, and
+the sweep hygiene that keeps all of it from outliving its run or project."""
+
+import json
+
+import pytest
+
+from dstack_tpu.core import tracing
+from dstack_tpu.server.background import tasks
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import usage as usage_service
+from dstack_tpu.utils.common import from_iso
+from tests.common import (
+    FakeRunnerClient,
+    api_server,
+    drive,
+    setup_mock_backend,
+    tpu_task_spec,
+)
+from tests.test_run_events import parse_exposition
+
+
+@pytest.fixture(autouse=True)
+def _fake_runner(monkeypatch):
+    FakeRunnerClient.reset()
+    backends_service.reset_compute_cache()
+    monkeypatch.setattr(tasks, "get_runner_client", FakeRunnerClient.for_jpd)
+    tracing.reset()
+    usage_service.reset()
+    yield
+    FakeRunnerClient.reset()
+    tracing.reset()
+    usage_service.reset()
+
+
+def _stuck_spec(name: str) -> dict:
+    """A run no offer can satisfy (max_price below every catalog price) that
+    stays queued on the no-capacity retry window instead of failing."""
+    return tpu_task_spec(
+        name,
+        "v5e-8",
+        max_price=0.0001,
+        retry={"on_events": ["no-capacity"], "duration": 3600},
+    )
+
+
+class TestChipSecondsMetering:
+    async def test_meter_attributes_lifecycle_window(self):
+        """One completed v5e-8 run (8 chips, 1 host): the ledger row equals
+        chips x the job's provisioning->finished window exactly — metering
+        accrues from lifecycle rows, not tick deltas, so a run shorter than
+        one metering interval still bills its full window."""
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("acct", "v5e-8")
+            )
+            await drive(api.db)
+            run = await api.post("/api/project/main/runs/get", {"run_name": "acct"})
+            assert run["status"] == "done"
+
+            touched = await usage_service.meter(api.db)
+            assert touched == 1
+
+            sample = await api.db.fetchone(
+                "SELECT SUM(chip_seconds) AS cs, SUM(dollars) AS d,"
+                " SUM(goodput_chip_seconds) AS gcs FROM usage_samples"
+            )
+            anchor = await api.db.fetchone(
+                "SELECT MIN(timestamp) AS ts FROM run_events"
+                " WHERE job_id IS NOT NULL AND new_status = 'provisioning'"
+            )
+            job = await api.db.fetchone(
+                "SELECT finished_at FROM jobs WHERE finished_at IS NOT NULL"
+            )
+            window = (
+                from_iso(job["finished_at"]) - from_iso(anchor["ts"])
+            ).total_seconds()
+            assert window > 0
+            assert sample["cs"] == pytest.approx(8 * window, rel=1e-6)
+            assert sample["d"] > 0
+            # No workload telemetry -> goodput weight defaults to 1.0.
+            assert sample["gcs"] == pytest.approx(sample["cs"], rel=1e-6)
+
+            # Idempotent: the cursor advanced past the job's window, so a
+            # second tick adds nothing.
+            assert await usage_service.meter(api.db) == 0
+            again = await api.db.fetchone(
+                "SELECT SUM(chip_seconds) AS cs FROM usage_samples"
+            )
+            assert again["cs"] == pytest.approx(sample["cs"], rel=1e-9)
+
+    async def test_usage_api_rows_totals_and_since(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("acct-api", "v5e-8")
+            )
+            await drive(api.db)
+            await usage_service.meter(api.db)
+
+            data = await api.post("/api/usage/get", {})
+            assert len(data["runs"]) == 1
+            row = data["runs"][0]
+            assert row["project"] == "main"
+            assert row["run_name"] == "acct-api"
+            assert row["user"] == "admin"
+            assert row["chip_seconds"] > 0
+            assert row["dollars"] > 0
+            assert row["queue_wait_s"] is not None and row["queue_wait_s"] >= 0
+            totals = data["projects"]
+            assert totals[0]["project"] == "main" and totals[0]["runs"] == 1
+            assert totals[0]["chip_seconds"] == pytest.approx(row["chip_seconds"])
+            assert data["fleet"]["total_chips"] >= 0
+
+            # A since filter past every bucket excludes the ledger rows but
+            # still reports the fleet summary.
+            far = "2999-01-01T00:00:00+00:00"
+            later = await api.post("/api/usage/get", {"since": far})
+            assert later["runs"] == [] and later["since"] == far
+
+            # Unknown project filter is a clean 404, not an empty readout.
+            await api.post("/api/usage/get", {"project": "ghost"}, expect=404)
+
+
+class TestPlacementDecisionLog:
+    async def test_unplaceable_run_records_attempt_and_waits(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post("/api/project/main/runs/submit", _stuck_spec("stuck"))
+            await tasks.process_submitted_jobs(api.db)
+
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "stuck"}
+            )
+            attempts = [
+                e for e in data["events"] if e["new_status"] == "placement_attempt"
+            ]
+            assert len(attempts) == 1
+            ev = attempts[0]
+            assert ev["actor"] == "scheduler"
+            assert ev["reason"] == "no_offers"
+            assert ev["job_id"] is None
+            payload = json.loads(ev["message"])
+            assert payload["offers"] == 0
+            assert payload["reasons"] == {"no_offers": 1}
+
+            # The run surfaces WHY it waits (ps -v WAITING column source).
+            run = await api.post(
+                "/api/project/main/runs/get", {"run_name": "stuck"}
+            )
+            assert run["status"] == "submitted"
+            assert run["status_message"] == "waiting: no_offers"
+
+            # Identical consecutive attempts stay silent (per-pass dedup).
+            await tasks.process_submitted_jobs(api.db)
+            data = await api.post(
+                "/api/project/main/runs/get_events", {"run_name": "stuck"}
+            )
+            assert (
+                len([
+                    e for e in data["events"]
+                    if e["new_status"] == "placement_attempt"
+                ])
+                == 1
+            )
+
+            # And the pending-reason gauge is live on /metrics.
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            pending = families["dstack_tpu_run_pending_reason"]["samples"]
+            assert (
+                "dstack_tpu_run_pending_reason",
+                {"reason": "no_offers", "run": "stuck"},
+                1.0,
+            ) in pending
+            queued = families["dstack_tpu_project_queued_runs"]["samples"]
+            assert (
+                "dstack_tpu_project_queued_runs", {"project": "main"}, 1.0
+            ) in queued
+
+    async def test_placement_clears_waiting_state(self):
+        """A run that eventually places must lose its pending-reason series
+        and its WAITING message the moment placement succeeds."""
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("clears", "v5e-8")
+            )
+            # Fake a stale waiting state from an earlier failed pass.
+            usage_service.set_pending(
+                "clears", "rid", "main", 0, {"no_offers": 1}
+            )
+            await api.db.execute(
+                "UPDATE runs SET status_message = 'waiting: no_offers'"
+                " WHERE run_name = 'clears'"
+            )
+            await tasks.process_submitted_jobs(api.db)
+            assert usage_service.pending_snapshot() == []
+            run = await api.post(
+                "/api/project/main/runs/get", {"run_name": "clears"}
+            )
+            assert run["status_message"] is None
+
+    async def test_meter_prunes_stale_pending_entries(self):
+        """Defensive prune: a registry entry whose run is no longer waiting
+        (e.g. stopped outside the placement pass) dies on the next tick."""
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            usage_service.set_pending("ghost", "rid", "main", 0, {"no_offers": 1})
+            await usage_service.meter(api.db)
+            assert usage_service.pending_snapshot() == []
+
+    def test_primary_reason_precedence(self):
+        # Highest count wins; ties break in taxonomy precedence order.
+        assert (
+            usage_service.set_pending(
+                "r", "id", "p", 3, {"no_capacity": 2, "slice_busy": 1}
+            )
+            == "no_capacity"
+        )
+        assert (
+            usage_service.set_pending(
+                "r", "id", "p", 3, {"breaker_open": 1, "no_offers": 1}
+            )
+            == "breaker_open"
+        )
+        usage_service.reset()
+
+
+class TestFleetGauges:
+    async def test_cold_scrape_renders_families(self):
+        """A cold server advertises every fleet-accounting family with typed
+        headers; dstack_tpu_fleet_chips emits all three states at 0 so
+        dashboards discover the state label set before any instance exists."""
+        async with api_server() as api:
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+        chips = families["dstack_tpu_fleet_chips"]
+        assert chips["type"] == "gauge"
+        assert {labels["state"] for _, labels, _ in chips["samples"]} == {
+            "allocated", "idle", "provisioning",
+        }
+        assert all(v == 0.0 for _, _, v in chips["samples"])
+        assert families["dstack_tpu_project_allocated_chips"]["type"] == "gauge"
+        assert families["dstack_tpu_project_queued_runs"]["type"] == "gauge"
+        assert families["dstack_tpu_project_chip_seconds_total"]["type"] == "counter"
+        assert families["dstack_tpu_run_pending_reason"]["type"] == "gauge"
+
+    async def test_fleet_and_project_series_after_run(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("gauges", "v5e-8")
+            )
+            await drive(api.db)
+            await usage_service.meter(api.db)
+
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            # The run's slice stays pooled after the run: 8 chips, none busy.
+            by_state = {
+                labels["state"]: v
+                for _, labels, v in families["dstack_tpu_fleet_chips"]["samples"]
+            }
+            assert sum(by_state.values()) == 8.0
+            assert by_state["allocated"] == 0.0
+            # The ledger backs the per-project counter.
+            counter = families["dstack_tpu_project_chip_seconds_total"]["samples"]
+            assert len(counter) == 1
+            name, labels, value = counter[0]
+            assert labels == {"project": "main"} and value > 0
+
+            summary = await usage_service.fleet_summary(api.db)
+            assert summary["total_chips"] == 8
+            assert summary["queued_runs"] == 0
+            assert summary["dollars_per_hour"] > 0
+
+
+class TestSweepHygiene:
+    async def test_run_delete_sweeps_ledger_and_pending(self):
+        async with api_server() as api:
+            await setup_mock_backend(api)
+            await api.post(
+                "/api/project/main/runs/submit", tpu_task_spec("swept", "v5e-8")
+            )
+            await drive(api.db)
+            await usage_service.meter(api.db)
+            rows = await api.db.fetchall("SELECT * FROM usage_samples")
+            assert rows
+            usage_service.set_pending("swept", "rid", "main", 0, {"no_offers": 1})
+
+            await api.post(
+                "/api/project/main/runs/delete", {"runs_names": ["swept"]}
+            )
+            assert await api.db.fetchall("SELECT * FROM usage_samples") == []
+            assert usage_service.pending_snapshot() == []
+
+            # The per-project counter series disappears on the next scrape.
+            resp = await api.client.get("/metrics")
+            families = parse_exposition(await resp.text())
+            assert families["dstack_tpu_project_chip_seconds_total"]["samples"] == []
+
+    async def test_project_delete_sweeps_ledger_and_pending(self):
+        async with api_server() as api:
+            await api.post("/api/projects/create", {"project_name": "acct2"})
+            proj = await api.db.fetchone(
+                "SELECT id FROM projects WHERE name = 'acct2'"
+            )
+            await api.db.execute(
+                "INSERT INTO usage_samples (run_id, project_id, user_id, bucket,"
+                " chip_seconds, dollars, goodput_chip_seconds, last_sampled_at)"
+                " VALUES ('r1', ?, NULL, '2026-01-01T00:00:00+00:00',"
+                " 10, 0.1, 10, '2026-01-01T00:30:00+00:00')",
+                (proj["id"],),
+            )
+            usage_service.set_pending("p2-run", "r1", "acct2", 0, {"no_offers": 1})
+
+            await api.post("/api/projects/delete", {"projects_names": ["acct2"]})
+            assert await api.db.fetchall("SELECT * FROM usage_samples") == []
+            assert usage_service.pending_snapshot() == []
