@@ -9,7 +9,7 @@
 ARTIFACT_BUCKET ?= gs://dstack-tpu-artifacts
 DIST := dist
 
-.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-routing bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-preemption smoke-chaos smoke-gang smoke-usage release publish clean
+.PHONY: all runner wheel image test test-native test-python bench bench-scheduler bench-proxy bench-train bench-serve bench-routing bench-kernels bench-preemption bench-chaos smoke-observability smoke-serve smoke-draft smoke-preemption smoke-chaos smoke-gang smoke-usage release publish clean
 
 all: runner wheel
 
@@ -155,6 +155,14 @@ smoke-usage:
 smoke-serve:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  python -c "import bench; bench.smoke_serve()"
+
+# 30-step CPU convergence smoke for the speculative-decode draft head: rolls
+# the target out on the natural-text bench mix, distills the head against the
+# frozen target (train.py --draft-head's loss), and fails unless the loss
+# actually drops and the trained head honors the [S, k] int32 proposer
+# contract the serve engine builds verify rows from.
+smoke-draft:
+	JAX_PLATFORMS=cpu python -c "import bench; bench.smoke_draft()"
 
 release: runner wheel
 	@mkdir -p $(DIST)

@@ -725,13 +725,19 @@ def bench_scheduler() -> dict:
             return time.perf_counter() - t0
 
     async def submit_assign_latency(nudge: bool, n: int = 10,
-                                    interval: float = 0.4) -> list:
+                                    interval: float = 0.4,
+                                    cross_replica: bool = False) -> list:
         """Submit->assign latency with the REAL periodic loop running: each
         submit waits until its job leaves 'submitted'. With the wake nudge
         (submit_run sets the loop's event) the pass starts immediately; with
         the nudge disabled the job waits out the remainder of the poll
-        interval — the latency the nudge removes."""
+        interval — the latency the nudge removes. cross_replica simulates a
+        submit landing on ANOTHER replica: the in-process event is hidden (as
+        in no-nudge mode) and the loop is registered with the run_leases
+        notify poll, so ONLY the DB stamp submit_run writes can cut the sleep
+        short — the path that wakes replica B next short-tick."""
         from dstack_tpu.server import background as bg
+        from dstack_tpu.server.services import leases as leases_service
 
         FakeRunnerClient.reset()
         tasks.get_runner_client = FakeRunnerClient.for_jpd
@@ -739,18 +745,27 @@ def bench_scheduler() -> dict:
         async with api_server() as api:
             await setup_mock_backend(api)
             sched = bg.BackgroundScheduler()
+            notify_poll = None
+            if cross_replica:
+                notify_poll = lambda: leases_service.last_notify(
+                    api.db, "process_submitted_jobs"
+                )
             sched.add_periodic(
                 lambda: tasks.process_submitted_jobs(api.db, batch=25),
                 interval,
                 "process_submitted_jobs",
+                notify_poll=notify_poll,
             )
             if not nudge:
-                # Pre-nudge behavior: the loop still polls on its interval but
-                # submit_run's wake() finds no event to set.
+                # Pre-nudge behavior (and the cross-replica simulation): the
+                # loop still polls on its interval but submit_run's wake()
+                # finds no event to set — on a real fleet the event lives in
+                # the other replica's process.
                 bg._WAKE_EVENTS.pop("process_submitted_jobs", None)
             try:
                 for i in range(n):
-                    name = f"lat-{'n' if nudge else 'p'}-{i}"
+                    tag = "x" if cross_replica else ("n" if nudge else "p")
+                    name = f"lat-{tag}-{i}"
                     t0 = time.perf_counter()
                     await api.post(
                         "/api/project/main/runs/submit",
@@ -826,6 +841,7 @@ def bench_scheduler() -> dict:
     dt = asyncio.run(run())
     lat_nudge = asyncio.run(submit_assign_latency(nudge=True))
     lat_poll = asyncio.run(submit_assign_latency(nudge=False))
+    lat_cross = asyncio.run(submit_assign_latency(nudge=False, cross_replica=True))
     qw_by_project = asyncio.run(project_queue_waits())
     import statistics
 
@@ -852,10 +868,13 @@ def bench_scheduler() -> dict:
             },
             # Submit->assign latency through the live periodic loop: "nudge"
             # = submit_run wakes process_submitted_jobs (current behavior),
-            # "interval_poll" = the pre-nudge fixed-interval sleep.
+            # "interval_poll" = the pre-nudge fixed-interval sleep,
+            # "cross_replica" = the in-process event is invisible (submit on
+            # replica A) and only the run_leases notify stamp wakes the loop.
             "submit_to_assign_p50_ms": {
                 "nudge": round(statistics.median(lat_nudge) * 1000.0, 1),
                 "interval_poll": round(statistics.median(lat_poll) * 1000.0, 1),
+                "cross_replica": round(statistics.median(lat_cross) * 1000.0, 1),
             },
             # Queue-wait fairness across a 3-project mixed storm (ISSUE 19).
             "queue_wait_by_project": qw_by_project,
@@ -1898,14 +1917,22 @@ def _long_prompt_itl_compare(cfg, params) -> dict:
     return out
 
 
-def _spec_decode_check(cfg, params) -> dict:
+def _spec_decode_check(cfg, params, draft_params=None, prompts=None,
+                       max_new=24) -> dict:
     """Speculative decode vs the plain engine on the same prompts: records
     the acceptance rate and RAISES if any emitted token differs — a spec
     implementation that drifts from greedy is a correctness bug, not a perf
     data point. Strict identity only holds in fp32 (the verify forward
     reorders attention reductions vs the C==1 decode, and bf16 rounding can
     flip argmax near-ties — see the serve.py numerics caveat), so this hard
-    check is pinned to fp32 regardless of what the bench config says."""
+    check is pinned to fp32 regardless of what the bench config says.
+
+    ``draft_params`` swaps the proposer from host n-gram to the model-based
+    draft head (accept-rate fallback disabled — this measures the head, not
+    the safety net); the token-identity assertion is the same either way,
+    because drafts are only ever a throughput bet the verify forward scores.
+    ``prompts`` overrides the default repetitive mix (which exists to feed
+    the n-gram proposer so acceptance is exercised, not just trivially 0)."""
     from dstack_tpu.workloads import serve as serve_lib
 
     import random
@@ -1917,18 +1944,24 @@ def _spec_decode_check(cfg, params) -> dict:
             "is specified to fail only on real scheduling bugs"
         )
 
-    rng = random.Random(17)
-    # Repetitive prompts on purpose: the n-gram proposer feeds on recurrence
-    # (the greedy tail of a tiny synthetic model loops quickly, too).
-    base = [rng.randrange(1, 512) for _ in range(6)]
-    prompts = [base * 3 + [rng.randrange(1, 512)] for _ in range(4)]
+    if prompts is None:
+        rng = random.Random(17)
+        # Repetitive prompts on purpose: the n-gram proposer feeds on
+        # recurrence (the greedy tail of a tiny synthetic model loops
+        # quickly, too).
+        base = [rng.randrange(1, 512) for _ in range(6)]
+        prompts = [base * 3 + [rng.randrange(1, 512)] for _ in range(4)]
     pool = dict(page_size=16, num_pages=96, max_batch=4, max_seq=192)
     outputs = {}
     for label, k in (("plain", 0), ("spec4", 4)):
         engine = serve_lib.ServeEngine(
-            cfg, serve_lib.EngineConfig(spec_tokens=k, **pool), params=params
+            cfg,
+            serve_lib.EngineConfig(spec_tokens=k,
+                                   spec_fallback_threshold=0.0, **pool),
+            params=params,
+            draft_params=draft_params if k else None,
         )
-        reqs = [engine.submit(p, max_new_tokens=24) for p in prompts]
+        reqs = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
         steps = 0
         t0 = time.perf_counter()
         while engine.has_work():
@@ -1949,12 +1982,112 @@ def _spec_decode_check(cfg, params) -> dict:
         )
     return {
         "token_identical": True,
+        "proposer": "draft" if draft_params is not None else "ngram",
         "spec_accept_rate": round(outputs["spec4"]["accept_rate"], 4),
         "steps_plain": outputs["plain"]["steps"],
         "steps_spec": outputs["spec4"]["steps"],
         "step_reduction": round(
             outputs["plain"]["steps"] / max(outputs["spec4"]["steps"], 1), 2
         ),
+    }
+
+
+def _natural_prompts(n, seed, vocab=1024, lo=12, hi=32) -> list:
+    """Non-repetitive natural-text-like prompts: Zipf-weighted unigram draws
+    over the vocab. Real text has a heavy-tailed unigram distribution but
+    (unlike the repetitive mixes above) almost no verbatim n-gram recurrence
+    inside one prompt — exactly the regime where n-gram lookup hits its
+    acceptance ceiling and a model-based head does not."""
+    import random
+
+    rng = random.Random(seed)
+    ranks = list(range(1, vocab))
+    weights = [1.0 / (r ** 1.1) for r in ranks]
+    return [
+        rng.choices(ranks, weights=weights, k=rng.randint(lo, hi))
+        for _ in range(n)
+    ]
+
+
+def _distill_draft_head(cfg, params, steps=None, seed=29):
+    """On-policy distillation for the draft-vs-ngram bench: roll the target
+    out greedily on natural-mix prompts (a plain engine — the exact serve
+    distribution, prompt + the target's own continuations), then teacher-
+    force the head on those sequences with train.py's distill step. Returns
+    ``(draft_params, info)``; the loss trajectory lands in bench extras so a
+    regression in the distill loop is visible from the bench line alone."""
+    import jax
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads import model as model_lib
+    from dstack_tpu.workloads import serve as serve_lib
+    from dstack_tpu.workloads import train as train_lib
+
+    steps = steps or int(os.environ.get("DSTACK_TPU_BENCH_DRAFT_STEPS", "80"))
+    prompts = _natural_prompts(16, seed)
+    engine = serve_lib.ServeEngine(
+        cfg,
+        serve_lib.EngineConfig(page_size=16, num_pages=96, max_batch=4,
+                               max_seq=192),
+        params=params,
+    )
+    reqs = [engine.submit(p, max_new_tokens=32) for p in prompts]
+    guard = 0
+    while engine.has_work():
+        engine.step()
+        guard += 1
+        assert guard < 20000, "rollout engine never drained"
+    seq = min(len(p) for p in prompts) + 32  # every row full, no padding
+    rows = [(p + r.tokens)[:seq] for p, r in zip(prompts, reqs)]
+    tokens = jnp.asarray(rows, jnp.int32)
+
+    draft = model_lib.init_draft_params(cfg, jax.random.PRNGKey(seed + 1))
+    opt = train_lib.make_optimizer(learning_rate=5e-3)
+    state = train_lib.DraftTrainState(
+        params=params, draft=draft, opt_state=opt.init(draft),
+        step=jnp.zeros((), jnp.int32),
+    )
+    step_fn = train_lib.make_draft_distill_step(cfg, opt)
+    losses = []
+    for _ in range(steps):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    return state.draft, {
+        "steps": steps,
+        "rollout_tokens": int(tokens.size),
+        "loss_first": round(losses[0], 4),
+        "loss_last": round(losses[-1], 4),
+    }
+
+
+def _draft_vs_ngram_compare(cfg, params) -> dict:
+    """The draft-head headline: on a NON-repetitive natural-text-like mix,
+    the distilled draft head vs the n-gram proposer, side by side — accept
+    rate and decode-step reduction vs the non-speculative engine, with the
+    token-identity assertion running for BOTH proposers.
+
+    The head is distilled on greedy rollouts from the same prompt mix the
+    bench serves — the production shape (EAGLE-style heads train on live
+    traffic), and the only meaningful protocol here: a random-init tiny
+    target has no cross-prompt structure to generalize over, so a held-out
+    split would measure noise, not the proposer. The n-gram proposer gets
+    the same serve-time information it always has (each request's own
+    emitted stream); what the comparison isolates is the mechanism — on
+    text without verbatim recurrence, lookup has nothing to hit and a
+    model-based head still does."""
+    draft, distill = _distill_draft_head(cfg, params)
+    prompts = _natural_prompts(6, seed=29)
+    ngram = _spec_decode_check(cfg, params, prompts=prompts)
+    head = _spec_decode_check(cfg, params, draft_params=draft,
+                              prompts=prompts)
+    return {
+        "mix": "zipf_natural",
+        "ngram_accept_rate": ngram["spec_accept_rate"],
+        "draft_accept_rate": head["spec_accept_rate"],
+        "ngram_step_reduction": ngram["step_reduction"],
+        "draft_step_reduction": head["step_reduction"],
+        "token_identical": True,  # both checks raise on any divergence
+        "distill": distill,
     }
 
 
@@ -2374,6 +2507,10 @@ def bench_serve() -> dict:
     # spec engine that stops being token-identical to greedy must fail the
     # bench run loudly.
     spec_decode = _spec_decode_check(cfg, params)
+    # Draft-head vs n-gram on the non-repetitive natural mix: like the
+    # repetitive check above, token-identity failures raise — only the
+    # accept-rate/step-reduction numbers are data points.
+    spec_natural = _draft_vs_ngram_compare(cfg, params)
     try:
         prefix_cache = _prefix_cache_compare(cfg, params)
     except Exception as e:  # noqa: BLE001
@@ -2442,6 +2579,7 @@ def bench_serve() -> dict:
             "prefix_cache": prefix_cache,
             "long_prompt_itl": long_prompt_itl,
             "spec_decode": spec_decode,
+            "spec_natural_mix": spec_natural,
             "variants": variants,
         },
     }
@@ -2643,6 +2781,46 @@ def bench_kernels() -> dict:
     }
 
 
+def smoke_draft() -> dict:
+    """`make smoke-draft`: the draft-head distillation loop end to end on
+    CPU, 30 steps — the loss must actually DROP (the loop fits the frozen
+    target's argmax, not noise) and the trained head must satisfy the
+    proposer contract the serve engine builds rows from ([S, k] int32). The
+    fast pre-submit gate for train.py --draft-head / model.py draft changes."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads import model as model_lib
+    from dstack_tpu.workloads import serve as serve_lib
+
+    cfg = _serve_bench_config()
+    params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    draft, info = _distill_draft_head(cfg, params, steps=30)
+    wall = time.perf_counter() - t0
+    assert info["loss_last"] < info["loss_first"] * 0.5, (
+        f"distill loss never converged: {info}"
+    )
+    fn = serve_lib.make_draft_fn(cfg, 4)
+    probe = fn(params, draft, jnp.zeros((2, cfg.d_model), jnp.float32),
+               jnp.asarray([5, 7], jnp.int32))
+    assert probe.shape == (2, 4), probe.shape
+    assert probe.dtype == jnp.int32, probe.dtype
+    result = {
+        "metric": "smoke_draft",
+        "value": info["loss_last"],
+        "unit": "distill_loss",
+        "wall_s": round(wall, 1),
+        **info,
+    }
+    print(json.dumps(result))
+    return result
+
+
 def smoke_serve() -> dict:
     """`make smoke-serve`: boot the server in-process, stand up a REAL serving
     engine as a replica, stream tokens through the proxy's SSE pass-through,
@@ -2672,12 +2850,19 @@ def smoke_serve() -> dict:
         import jax
 
         cfg = _serve_bench_config()
+        # The smoke engine speculates with the MODEL-BASED draft head (a
+        # random-init one: correctness and the gauge plumbing are what a
+        # smoke proves; accept-rate QUALITY is bench_serve's job) — every
+        # request below therefore drives the draft proposer + hidden-state
+        # plumbing through the proxy end to end.
+        draft_params = model_lib.init_draft_params(cfg, jax.random.PRNGKey(3))
         engine = serve_lib.ServeEngine(
             cfg,
             serve_lib.EngineConfig(page_size=8, num_pages=64, max_batch=4,
                                    max_seq=128, prefix_cache=True,
                                    prefill_chunk=16, spec_tokens=2),
             params=model_lib.init_params(cfg, jax.random.PRNGKey(0)),
+            draft_params=draft_params,
         )
         runner = serve_lib.EngineRunner(engine, idle_wait=0.01)
         runner.start()
@@ -2756,9 +2941,27 @@ def smoke_serve() -> dict:
                     assert f'{family}{{run="smoke-serve"}}' in metrics_text, (
                         f"{family} has no sample for smoke-serve"
                     )
+                # Draft proposer output contract — the shape/dtype the
+                # engine builds verify rows from, checked on the exact jitted
+                # fn the engine dispatches (make_draft_fn is memoized per
+                # (cfg, k, quant, mesh), so this IS the engine's instance).
+                import jax.numpy as jnp
+
+                dfn = serve_lib.make_draft_fn(cfg, engine.ecfg.spec_tokens)
+                probe = dfn(
+                    engine._serve_params, engine.draft_params,
+                    jnp.zeros((3, cfg.d_model), jnp.float32),
+                    jnp.asarray([1, 2, 3], jnp.int32),
+                )
+                assert probe.shape == (3, engine.ecfg.spec_tokens), probe.shape
+                assert probe.dtype == jnp.int32, probe.dtype
+                stats_now = engine.stats()
+                assert stats_now["spec_proposer"] == "draft", stats_now
+                assert "spec_accept_rate_windowed" in stats_now, stats_now
                 tier2 = {
                     "prefix_hit_rate": round(engine.prefix_hit_rate, 4),
                     "spec_accept_rate": round(engine.spec_accept_rate, 4),
+                    "spec_proposer": stats_now["spec_proposer"],
                 }
 
                 # --- fleet: two tp=2-SHARDED replicas + cache-aware routing

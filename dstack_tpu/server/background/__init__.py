@@ -31,14 +31,56 @@ def wake(name: str) -> None:
         ev.set()
 
 
+async def _wait_with_notify(
+    event: asyncio.Event, interval: float, poll: Callable[[], Awaitable]
+) -> None:
+    """Sleep out `interval` in short ticks, returning early on the in-process
+    wake event OR when the cross-replica notify stamp (services/leases.py
+    notify) advances past what it read at sleep start. The baseline read
+    means a stamp written BEFORE this sleep began is treated as consumed —
+    the pass that just finished either saw that submit's rows or the next
+    interval pass will; only stamps landing during the sleep cut it short."""
+    from dstack_tpu.server import settings as _settings
+
+    loop_time = asyncio.get_event_loop().time
+    deadline = loop_time() + interval
+    tick = max(_settings.SCHEDULER_NOTIFY_POLL, 0.005)
+    baseline = await poll()
+    while True:
+        remaining = deadline - loop_time()
+        if remaining <= 0:
+            raise asyncio.TimeoutError
+        try:
+            await asyncio.wait_for(event.wait(), timeout=min(tick, remaining))
+            return
+        except asyncio.TimeoutError:
+            pass
+        try:
+            stamp = await poll()
+        except Exception:
+            logger.debug("notify poll failed; falling back to interval sleep",
+                         exc_info=True)
+            continue
+        if stamp is not None and stamp != baseline:
+            return
+
+
 class BackgroundScheduler:
     def __init__(self) -> None:
         self._tasks: List[asyncio.Task] = []
         self._names: List[str] = []
 
     def add_periodic(
-        self, fn: Callable[[], Awaitable[None]], interval: float, name: str
+        self,
+        fn: Callable[[], Awaitable[None]],
+        interval: float,
+        name: str,
+        notify_poll: Callable[[], Awaitable] = None,
     ) -> None:
+        """``notify_poll`` (an async () -> Optional[str] returning the loop's
+        cross-replica notify stamp) turns the fixed-interval sleep into a
+        short-tick poll: submits on OTHER replicas — invisible to the
+        in-process wake() event — start a pass next tick."""
         from dstack_tpu.core import tracing
 
         event = asyncio.Event()
@@ -73,7 +115,10 @@ class BackgroundScheduler:
                 except Exception:
                     logger.exception("background task %s failed", name)
                 try:
-                    await asyncio.wait_for(event.wait(), timeout=interval)
+                    if notify_poll is not None:
+                        await _wait_with_notify(event, interval, notify_poll)
+                    else:
+                        await asyncio.wait_for(event.wait(), timeout=interval)
                 except asyncio.TimeoutError:
                     pass
 
@@ -99,10 +144,20 @@ def start_background_tasks(app: web.Application) -> BackgroundScheduler:
     sched.add_periodic(
         lambda: tasks.process_runs(db), settings.PROCESS_RUNS_INTERVAL, "process_runs"
     )
+    # The submitted pass additionally polls the cross-replica notify stamp
+    # (leases.notify, written by submit_run): a submit landing on replica A
+    # wakes THIS replica's pass next short-tick instead of next full interval.
+    # Gate on the poll setting so 0 restores the plain fixed-interval sleep.
+    from dstack_tpu.server.services import leases as _leases
+
+    submitted_poll = None
+    if settings.SCHEDULER_NOTIFY_POLL > 0:
+        submitted_poll = lambda: _leases.last_notify(db, "process_submitted_jobs")
     sched.add_periodic(
         lambda: tasks.process_submitted_jobs(db),
         settings.PROCESS_SUBMITTED_JOBS_INTERVAL,
         "process_submitted_jobs",
+        notify_poll=submitted_poll,
     )
     sched.add_periodic(
         lambda: tasks.process_running_jobs(db),

@@ -310,6 +310,18 @@ MIGRATIONS: List[Tuple[int, str]] = [
         CREATE INDEX ix_usage_samples_project ON usage_samples(project_id, bucket);
         """,
     ),
+    (
+        7,
+        # Cross-replica scheduler notify (services/leases.py notify/
+        # last_notify): piggybacked on run_leases as sentinel rows
+        # (run_id = 'notify:<loop name>') so a submit on replica A wakes
+        # replica B's submitted pass on its next short poll tick instead of
+        # its next full interval — the DB-visible analogue of the in-process
+        # background.wake() event. Real lease rows leave the column NULL.
+        """
+        ALTER TABLE run_leases ADD COLUMN notify_at TEXT;
+        """,
+    ),
 ]
 
 

@@ -170,11 +170,55 @@ async def release_runs(db: Database, run_ids: Iterable[str]) -> None:
 async def sweep(db: Database) -> None:
     """Drop leases whose run is finished, deleted, or gone — the table must
     track only live scheduling work (finalize already releases; this catches
-    crashes between the terminal transition and the release)."""
+    crashes between the terminal transition and the release). Notify
+    sentinel rows are not leases and survive the sweep."""
     await db.execute(
         "DELETE FROM run_leases WHERE run_id NOT IN"
         f" (SELECT id FROM runs WHERE deleted = 0 AND {_ACTIVE_RUN_FILTER})"
+        f" AND run_id NOT LIKE '{NOTIFY_PREFIX}%'"
     )
+
+
+# -- cross-replica notify ---------------------------------------------------
+#
+# background.wake() is an in-process asyncio.Event: a submit on replica A
+# never reaches replica B's loops. The DB-visible half rides the run_leases
+# table (the one piece of shared scheduler state every replica already
+# watches): notify() stamps a sentinel row, and a loop registered with a
+# notify poll (background.add_periodic) slices its interval sleep into short
+# ticks that compare the stamp against what it saw when the sleep began —
+# submit on A, assign on B next tick, not next interval.
+
+NOTIFY_PREFIX = "notify:"
+
+
+def notify_tx(conn, name: str) -> None:
+    now_s = to_iso(now_utc())
+    conn.execute(
+        "INSERT INTO run_leases (run_id, owner, acquired_at, heartbeat_at,"
+        " expires_at, notify_at) VALUES (?, ?, ?, ?, ?, ?)"
+        " ON CONFLICT (run_id) DO UPDATE SET"
+        " owner = excluded.owner, notify_at = excluded.notify_at",
+        (NOTIFY_PREFIX + name, replica_id(), now_s, now_s, now_s, now_s),
+    )
+
+
+async def notify(db: Database, name: str) -> None:
+    """Stamp the named loop's cross-replica notify sentinel. Cheap (one
+    upsert), idempotent, and safe to call with no scheduler running — the
+    stamp just waits for the next poller. ISO stamps carry microseconds, so
+    back-to-back submits always advance the value a sleeping poller compares
+    against."""
+    await db.run(lambda conn: notify_tx(conn, name))
+
+
+async def last_notify(db: Database, name: str) -> Optional[str]:
+    """The named loop's latest notify stamp (None before the first one)."""
+    row = await db.fetchone(
+        "SELECT notify_at FROM run_leases WHERE run_id = ?",
+        (NOTIFY_PREFIX + name,),
+    )
+    return row["notify_at"] if row is not None else None
 
 
 async def owners(db: Database, run_ids: Sequence[str]) -> dict:

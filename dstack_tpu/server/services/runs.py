@@ -344,6 +344,12 @@ async def submit_run(db: Database, project_row, user_row, run_spec: RunSpec) -> 
     from dstack_tpu.server import background
 
     background.wake("process_submitted_jobs")
+    # Cross-replica nudge: wake() only reaches loops in THIS process, so stamp
+    # the shared run_leases notify row too — other replicas' submitted passes
+    # poll it and start next short-tick instead of next interval.
+    from dstack_tpu.server.services import leases as leases_service
+
+    await leases_service.notify(db, "process_submitted_jobs")
     from dstack_tpu.server.services import proxy as proxy_service
 
     if existing is not None:

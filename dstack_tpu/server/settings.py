@@ -110,6 +110,12 @@ TERMINATION_RETRY_WINDOW = float(os.getenv("DSTACK_TPU_TERMINATION_RETRY_WINDOW"
 RUN_LEASES_ENABLED = _env_bool("DSTACK_TPU_RUN_LEASES", True)
 LEASE_TTL = float(os.getenv("DSTACK_TPU_LEASE_TTL", "30"))
 REPLICA_ID = os.getenv("DSTACK_TPU_REPLICA_ID")
+# Cross-replica notify poll tick: while a notify-registered loop (the
+# submitted-jobs pass) sleeps out its interval, it checks the shared
+# run_leases notify stamp this often — a submit on another replica is picked
+# up next tick instead of next interval. 0 disables the polling (the
+# in-process wake() nudge still works).
+SCHEDULER_NOTIFY_POLL = float(os.getenv("DSTACK_TPU_SCHEDULER_NOTIFY_POLL", "0.05"))
 
 # Resilience layer (services/resilience.py): per-target circuit breakers over
 # the external call families (runner agents, backend Compute, proxy->replica

@@ -411,6 +411,159 @@ def pick_loss_chunk(cfg: LlamaConfig, seq_len: int) -> int:
     return chunk
 
 
+# ---------------------------------------------------------------------------
+# Speculative-decode draft head (EAGLE-style conditioning, self-contained).
+#
+# The head proposes the target model's NEXT-next token from two inputs it gets
+# for free on the decode path: the target's last hidden state (post final_norm,
+# pre lm_head — the same [D] vector the lm_head just consumed) and the
+# embedding of the token that hidden state emitted. Both are fused through a
+# [2D, D] projection, refined by a short stack of pre-norm residual SwiGLU
+# blocks, and projected through the TARGET's lm_head — the head never owns a
+# vocab-sized matrix, which is what keeps it small enough to replicate on a
+# tp-sharded serve mesh.
+#
+# The blocks are deliberately attention-free: the conditioning hidden state
+# already summarizes the full attended context, so the head carries no KV cache
+# of its own — serve-side preemption and re-prefill need no head-state rebuild,
+# and a k-token proposal is one tiny jitted scan (serve.make_draft_fn). Drafts
+# remain a pure throughput bet: the engine's verify forward scores them, so a
+# bad head costs acceptance, never correctness.
+
+
+def init_draft_params(
+    cfg: LlamaConfig, key: jax.Array, n_layers: int = 2, d_ff: int = 0
+) -> Params:
+    """Draft-head parameter tree (stacked layers, scanned like the target).
+    ``d_ff`` defaults to 2*d_model — the head is ~n_layers * 6*D^2 params,
+    orders of magnitude under the target it drafts for."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    d = cfg.d_model
+    f = d_ff or 2 * d
+    L = n_layers
+    keys = jax.random.split(key, 4)
+
+    def dense_init(k, *shape, fan_in):
+        return (
+            jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(pdt)
+
+    return {
+        "w_fuse": dense_init(keys[0], 2 * d, d, fan_in=2 * d),
+        "mlp_norm": jnp.ones((L, d), pdt),
+        "w_gate": dense_init(keys[1], L, d, f, fan_in=d),
+        "w_up": dense_init(keys[2], L, d, f, fan_in=d),
+        "w_down": dense_init(keys[3], L, f, d, fan_in=f),
+        "final_norm": jnp.ones((d,), pdt),
+    }
+
+
+def _draft_mm(x: jax.Array, w: jax.Array) -> jax.Array:
+    return jax.lax.dot_general(
+        x, w.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def draft_apply(
+    draft: Params, hidden: jax.Array, tok_emb: jax.Array, cfg: LlamaConfig
+) -> jax.Array:
+    """One head application: (target hidden [..., D], condition-token
+    embedding [..., D]) -> predicted next hidden [..., D], in the same basis
+    the target's lm_head reads (post final_norm). Works on any leading shape —
+    [S, D] rows on the serve path, [B, T, D] teacher-forced sequences in
+    distillation."""
+    x = _draft_mm(jnp.concatenate([hidden, tok_emb], axis=-1), draft["w_fuse"])
+
+    def block(x, layer):
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = _draft_mm(h2, layer["w_gate"])
+        up = _draft_mm(h2, layer["w_up"])
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        return x + _draft_mm(act, layer["w_down"]), None
+
+    layer_params = {
+        k: draft[k] for k in ("mlp_norm", "w_gate", "w_up", "w_down")
+    }
+    x, _ = jax.lax.scan(block, x, layer_params)
+    return _rms_norm(x, draft["final_norm"], cfg.norm_eps)
+
+
+def draft_propose(
+    params: Params,
+    draft: Params,
+    hidden: jax.Array,       # [S, D] target hidden at each row's last position
+    last_tokens: jax.Array,  # [S] the token that hidden state emitted
+    k: int,
+    cfg: LlamaConfig,
+) -> jax.Array:
+    """k greedy draft tokens per row in one scan, [S, k] int32: each step
+    embeds the previous token (the target's embed table), applies the head,
+    and reads the argmax through the target's lm_head; the head's own output
+    hidden becomes the next step's conditioning. The fp reference for
+    serve.make_draft_fn (which adds weight-only-quant lm_head handling)."""
+    adt = jnp.dtype(cfg.dtype)
+
+    def step(carry, _):
+        h, t = carry
+        e = params["embed"].astype(adt)[t]
+        h2 = draft_apply(draft, h.astype(adt), e, cfg)
+        logits = _draft_mm(h2, params["lm_head"]).astype(jnp.float32)
+        nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (h2, nt), nt
+
+    _, drafts = jax.lax.scan(
+        step, (hidden.astype(adt), last_tokens.astype(jnp.int32)), None,
+        length=k,
+    )
+    return jnp.swapaxes(drafts, 0, 1)  # [S, k]
+
+
+def draft_distill_loss(
+    draft: Params,
+    params: Params,
+    tokens: jax.Array,  # [B, T]
+    cfg: LlamaConfig,
+    rollout: int = 2,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Distillation loss vs the FROZEN target on one batch: cross-entropy of
+    the head's prediction against the target's own argmax (train.py
+    --draft-head; gradients flow into ``draft`` only — callers differentiate
+    argnums=0).
+
+    Position t conditions on (target hidden_t, embedding of token_{t+1}) and
+    must predict the target's argmax at t+1 — exactly the serve-time contract,
+    where the condition token IS that argmax (greedy decode). ``rollout``
+    extends the loss to the head's own continuations: step j >= 2 feeds the
+    head its previous output hidden and proposed token (what proposal
+    positions 2..k see at serve time), labeled with the target argmax j ahead;
+    without it, later draft positions would be trained on nothing."""
+    adt = jnp.dtype(cfg.dtype)
+    t = tokens.shape[1]
+    hidden = forward(params, tokens, cfg, mesh, return_hidden=True)  # [B,T,D]
+    tgt_logits = _draft_mm(hidden, params["lm_head"]).astype(jnp.float32)
+    labels = jnp.argmax(tgt_logits, axis=-1)  # [B, T]: a_t
+    labels = jax.lax.stop_gradient(labels)
+    hidden = jax.lax.stop_gradient(hidden)
+
+    h = hidden[:, :-1]                     # rows t = 0..T-2
+    cond = tokens[:, 1:].astype(jnp.int32)  # x_{t+1}
+    total = jnp.zeros(())
+    for j in range(1, rollout + 1):
+        e = params["embed"].astype(adt)[cond]
+        h = draft_apply(draft, h, e, cfg)
+        logits_j = _draft_mm(h, params["lm_head"]).astype(jnp.float32)
+        # Row t's label at rollout depth j is a_{t+j}; rows past T-1-j have
+        # no label — mask with -1 (masked_ce's ignore value).
+        lab = jnp.pad(
+            labels[:, j:], ((0, 0), (0, j - 1)), constant_values=-1
+        )
+        total = total + masked_ce(logits_j, lab)
+        cond = jnp.argmax(logits_j, axis=-1).astype(jnp.int32)
+    return total / rollout
+
+
 def masked_ce(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean cross-entropy over targets >= 0 (-1 = ignore); logits fp32."""
     mask = targets >= 0

@@ -215,6 +215,59 @@ def load_serve_params(
     return params, manifest
 
 
+def load_draft_params(
+    checkpoint_dir: str,
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    step: Optional[int] = None,
+) -> Tuple[dict, dict]:
+    """Restore the speculative-decode draft head — the ``.draft`` subtree a
+    ``train.py --draft-head`` run saved (DraftTrainState) — for --spec-model.
+
+    The head's depth/width are whatever was trained, so the template comes
+    from the MANIFEST's ``.draft`` leaves, not from a config-derived
+    ``eval_shape``: each leaf restores at its saved shape. The head stays
+    REPLICATED on a serve mesh (it is a few 100k params; sharding it would
+    trade an all-gather per proposal step for nothing) and never quantizes —
+    weight-only quant pays off on the target's GB-scale projections, not
+    here. Returns ``(draft_params, manifest)``."""
+    from dstack_tpu.workloads import checkpoint as checkpoint_lib
+
+    manager = checkpoint_lib.CheckpointManager(checkpoint_dir)
+    if step is None:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no complete checkpoint in {checkpoint_dir}"
+            )
+    manifest = manager.read_manifest(step)
+    rep = NamedSharding(mesh, P()) if mesh is not None else None
+    template = {}
+    for leaf in manifest["leaves"]:
+        key = leaf["key"]
+        if not key.startswith(".draft["):
+            continue
+        name = key[len(".draft"):].strip("[]'\"")
+        template[name] = jax.ShapeDtypeStruct(
+            tuple(leaf["shape"]), np.dtype(leaf["dtype"]), sharding=rep
+        )
+    if not template:
+        raise ValueError(
+            f"checkpoint step {step} in {checkpoint_dir} has no .draft"
+            f" subtree — distill one with `train.py --draft-head`"
+        )
+    d = cfg.d_model
+    if tuple(template["w_fuse"].shape) != (2 * d, d):
+        raise ValueError(
+            f"draft head was trained for d_model"
+            f" {template['w_fuse'].shape[1]}, engine config has {d}"
+        )
+    draft, manifest = manager.restore_subtree(
+        template, step=step, prefix=".draft"
+    )
+    return draft, manifest
+
+
 def _proj(x: jax.Array, layer: dict, key: str, adt, quant: str) -> jax.Array:
     """x[..., K] @ layer[key] in adt: fp einsum, or weight-only int8/fp8
     (the dequant is dtype-agnostic: values.astype(x.dtype) * scales)."""
@@ -272,6 +325,18 @@ class EngineConfig:
     # Speculative decode: k draft tokens per slot from an n-gram proposer,
     # verified in one batched forward (0 = one token per step, tier-1).
     spec_tokens: int = 0
+    # Model-based drafting (engine built with draft_params, serve CLI
+    # --spec-model): per-request windowed accept tracking falls a slot back
+    # to the n-gram proposer when the head underperforms — a mismatched or
+    # stale head degrades to today's behavior, never below it. The window is
+    # spec STEPS (not tokens); fallback triggers only once it is full, so a
+    # cold start never flaps. threshold <= 0 disables fallback.
+    spec_fallback_window: int = 16
+    spec_fallback_threshold: float = 0.1
+    # Engine-level sliding window (spec steps) behind the windowed accept
+    # rate on /stats and X-Dstack-Spec-Accept-Rate — the lifetime average
+    # masks a proposer that has gone cold on the current traffic.
+    spec_window: int = 64
 
 
 class TokenEvent(NamedTuple):
@@ -325,6 +390,13 @@ class GenRequest:
     # its trailing-n-gram continuation index. _emit keeps both current.
     spec_ctx: Optional[List[int]] = None
     spec_index: Optional[dict] = None
+    # Model-based drafting: whether this request still uses the draft head
+    # (False after a windowed-accept-rate fallback — per-slot, permanent for
+    # the request's life), and the (proposed, accepted) samples of its most
+    # recent spec steps (a deque maxlen = ecfg.spec_fallback_window, created
+    # by the engine on the first spec step).
+    draft_ok: bool = True
+    spec_recent: Optional[Deque[Tuple[int, int]]] = None
 
 
 def _rope_single(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -352,11 +424,16 @@ def _serve_shardings(quant: str, mesh: Mesh):
 
 @functools.lru_cache(maxsize=None)
 def make_prefill_fn(cfg: LlamaConfig, quant: str = "none",
-                    mesh: Optional[Mesh] = None):
+                    mesh: Optional[Mesh] = None, with_hidden: bool = False):
     """jit'd (params, tokens, k_pages, v_pages, write_page, write_off, lens)
     -> (next_tokens, k_pages, v_pages). Memoized on the (frozen) config +
     quant mode (+ mesh) so every engine over the same model shares one jit
     cache — bench variants don't re-compile per engine.
+
+    ``with_hidden`` (the draft-head engines) inserts the last valid
+    position's final hidden state [B, D] after next_tokens in the returns —
+    the conditioning input for the FIRST model-based proposal after this
+    prefill; without it the head would sit blind until the first verify.
 
     With a serve ``mesh``, the same trace runs tp-sharded: projections and
     attention heads split per SERVE_PARAM_SPECS, pages per SERVE_PAGE_SPEC
@@ -411,16 +488,22 @@ def make_prefill_fn(cfg: LlamaConfig, quant: str = "none",
         last_idx = jnp.clip(lens - 1, 0, t - 1)
         last = x[jnp.arange(b), last_idx]  # [B, D]
         logits = _logits(last, params, adt, quant)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if with_hidden:
+            return out, last, k_pages, v_pages
+        return out, k_pages, v_pages
 
     if mesh is None:
         return jax.jit(prefill, donate_argnums=(2, 3))
     param_sh, page_sh, rep = _serve_shardings(quant, mesh)
+    out_sh = (
+        (rep, rep, page_sh, page_sh) if with_hidden else (rep, page_sh, page_sh)
+    )
     return jax.jit(
         prefill,
         donate_argnums=(2, 3),
         in_shardings=(param_sh, rep, page_sh, page_sh, rep, rep, rep),
-        out_shardings=(rep, page_sh, page_sh),
+        out_shardings=out_sh,
     )
 
 
@@ -509,7 +592,7 @@ def _rope_chunk(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 @functools.lru_cache(maxsize=None)
 def make_chunk_fn(cfg: LlamaConfig, quant: str = "none",
                   decode_impl: str = "xla", emit: str = "last",
-                  mesh: Optional[Mesh] = None):
+                  mesh: Optional[Mesh] = None, with_hidden: bool = False):
     """jit'd multi-token step over the paged cache — the shared program behind
     chunked prefill, prefix-cache suffix prefill, AND speculative verify:
     (params, tokens, starts, valid, k_pages, v_pages, page_tables,
@@ -530,6 +613,13 @@ def make_chunk_fn(cfg: LlamaConfig, quant: str = "none",
     verify: position i's argmax is the model's true next token after
     consuming tokens[:, :i+1], which the host's accept/reject rule compares
     against the drafts).
+
+    ``with_hidden`` (the draft-head engines) inserts the final hidden state
+    after the tokens in the returns — [S, D] at the last valid position for
+    emit="last" (the final prefill chunk seeds the head's first proposal),
+    [S, C, D] at every position for emit="all" (the host picks the ACCEPTED
+    position's hidden as the next proposal's conditioning — the hidden the
+    target computed for exactly the tokens it ended up keeping).
     """
 
     def chunk_step(params, tokens, starts, valid, k_pages, v_pages,
@@ -572,20 +662,77 @@ def make_chunk_fn(cfg: LlamaConfig, quant: str = "none",
         x = model_lib._rms_norm(x, params["final_norm"], cfg.norm_eps)
         if emit == "last":
             last_idx = jnp.clip(valid - 1, 0, c - 1)
-            last = x[jnp.arange(s), last_idx]  # [S, D]
-            logits = _logits(last, params, adt, quant)
+            hidden = x[jnp.arange(s), last_idx]  # [S, D]
+            logits = _logits(hidden, params, adt, quant)
         else:
+            hidden = x  # [S, C, D]
             logits = _logits(x, params, adt, quant)  # [S, C, V]
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if with_hidden:
+            return out, hidden, k_pages, v_pages
+        return out, k_pages, v_pages
 
     if mesh is None:
         return jax.jit(chunk_step, donate_argnums=(4, 5))
     param_sh, page_sh, rep = _serve_shardings(quant, mesh)
+    out_sh = (
+        (rep, rep, page_sh, page_sh) if with_hidden else (rep, page_sh, page_sh)
+    )
     return jax.jit(
         chunk_step,
         donate_argnums=(4, 5),
         in_shardings=(param_sh, rep, rep, rep, page_sh, page_sh, rep, rep, rep),
-        out_shardings=(rep, page_sh, page_sh),
+        out_shardings=out_sh,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_draft_fn(cfg: LlamaConfig, k: int, quant: str = "none",
+                  mesh: Optional[Mesh] = None):
+    """jit'd model-based draft proposer (the --spec-model replacement for the
+    n-gram index): (params, draft, hidden, last_tokens) -> drafts [S, k]
+    int32.
+
+    One scan of the EAGLE-style head (model.draft_apply) proposes k tokens
+    for every slot at once: each step embeds the previous token through the
+    TARGET's embed table, applies the head to (hidden, embedding), and takes
+    the argmax through the target's lm_head — quant-aware, so a weight-only
+    int8/fp8 engine drafts through the same quantized lm_head its verify
+    forward scores with. The head's own output hidden feeds the next step,
+    exactly the rollout the distillation loss trained. Fixed [max_batch]
+    shapes = one compile for the engine's life; inactive slots ride along on
+    garbage inputs and their rows are ignored.
+
+    On a serve mesh the head and its activations stay replicated; only the
+    embed gather and the lm_head projection touch tp-sharded weights (GSPMD
+    inserts the same vocab reduction the decode path pays)."""
+
+    def propose(params, draft, hidden, last_tokens):
+        adt = jnp.dtype(cfg.dtype)
+
+        def step(carry, _):
+            h, t = carry
+            e = params["embed"].astype(adt)[t]  # [S, D]
+            h2 = model_lib.draft_apply(draft, h, e, cfg)
+            logits = _logits(h2, params, adt, quant)
+            nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (h2, nt), nt
+
+        _, drafts = jax.lax.scan(
+            step,
+            (hidden.astype(adt), last_tokens.astype(jnp.int32)),
+            None,
+            length=k,
+        )
+        return jnp.swapaxes(drafts, 0, 1)  # [S, k]
+
+    if mesh is None:
+        return jax.jit(propose)
+    param_sh, _, rep = _serve_shardings(quant, mesh)
+    return jax.jit(
+        propose,
+        in_shardings=(param_sh, rep, rep, rep),
+        out_shardings=rep,
     )
 
 
@@ -925,6 +1072,7 @@ class ServeEngine:
         params: Optional[dict] = None,
         seed: int = 0,
         mesh: Optional[Mesh] = None,
+        draft_params: Optional[dict] = None,
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -985,8 +1133,26 @@ class ServeEngine:
                 k: jax.device_put(v, shardings[k])
                 for k, v in self._serve_params.items()
             }
+        # Model-based drafting: the head proposes from the target's last
+        # hidden state, so every forward that can advance a slot's position
+        # (prefill, chunk prefill, verify) must also hand that hidden back.
+        # with_hidden=False keeps the n-gram-only engine byte-identical.
+        if draft_params is not None and self.ecfg.spec_tokens <= 0:
+            raise ValueError(
+                "draft_params given but spec_tokens == 0 — the draft head"
+                " only proposes inside speculative decode (--spec-tokens k)"
+            )
+        self._use_draft = draft_params is not None
+        self.draft_params = draft_params
+        if self._use_draft and mesh is not None:
+            rep = NamedSharding(mesh, P())
+            self.draft_params = {
+                k: jax.device_put(v, rep) for k, v in draft_params.items()
+            }
         self.decode_impl = resolve_decode_impl(self.ecfg.decode_impl)
-        self._prefill_fn = make_prefill_fn(cfg, quant, mesh)
+        self._prefill_fn = make_prefill_fn(
+            cfg, quant, mesh, with_hidden=self._use_draft
+        )
         self._decode_fn = make_decode_fn(cfg, quant, self.decode_impl, mesh)
         # Tier-2 prefill (chunked and/or cache-hit suffix) replaces the
         # whole-prompt prefill path; with both features off the tier-1 path
@@ -996,11 +1162,17 @@ class ServeEngine:
         )
         if self._tier2_prefill:
             self._chunk_fn = make_chunk_fn(
-                cfg, quant, self.decode_impl, "last", mesh
+                cfg, quant, self.decode_impl, "last", mesh,
+                with_hidden=self._use_draft,
             )
         if self.ecfg.spec_tokens > 0:
             self._verify_fn = make_chunk_fn(
-                cfg, quant, self.decode_impl, "all", mesh
+                cfg, quant, self.decode_impl, "all", mesh,
+                with_hidden=self._use_draft,
+            )
+        if self._use_draft:
+            self._draft_fn = make_draft_fn(
+                cfg, self.ecfg.spec_tokens, quant, mesh
             )
         self._cache = (
             PrefixCache(self.ecfg.page_size) if self.ecfg.prefix_cache else None
@@ -1029,6 +1201,12 @@ class ServeEngine:
         self.page_tables = np.zeros((mb, self.table_width), np.int32)
         self.seq_lens = np.zeros(mb, np.int64)       # KV positions stored
         self.last_tokens = np.zeros(mb, np.int32)    # last emitted token
+        # Per-slot target hidden state behind last_tokens — what the draft
+        # head conditions on. Refreshed by prefill and by every verify step
+        # (the hidden at the accept boundary); stale rows are harmless
+        # because a slot's row is rewritten before its next proposal.
+        if self._use_draft:
+            self.last_hidden = np.zeros((mb, cfg.d_model), np.float32)
         self.slots: List[Optional[GenRequest]] = [None] * mb
         self.slot_pages: List[List[int]] = [[] for _ in range(mb)]
 
@@ -1048,6 +1226,13 @@ class ServeEngine:
         self.total_prefix_hit_tokens = 0     # of those, served from the cache
         self.total_spec_proposed = 0         # draft tokens sent to verify
         self.total_spec_accepted = 0         # of those, accepted
+        self.total_spec_fallbacks = 0        # slots switched draft -> n-gram
+        # Sliding window of per-slot-per-step (proposed, accepted) samples
+        # behind spec_accept_rate_windowed (satellite: lifetime averages mask
+        # a proposer that has gone cold on current traffic).
+        self._spec_recent: Deque[Tuple[int, int]] = collections.deque(
+            maxlen=max(self.ecfg.spec_window, 1)
+        )
 
     # -- submission (thread-safe) -----------------------------------------
 
@@ -1131,6 +1316,17 @@ class ServeEngine:
         """Fraction of proposed draft tokens the verify step accepted."""
         return self.total_spec_accepted / max(self.total_spec_proposed, 1)
 
+    @property
+    def spec_accept_rate_windowed(self) -> float:
+        """Accept rate over the last spec_window spec steps — what the
+        proposer is doing NOW, where the lifetime average dilutes a cold
+        streak with history. Before any spec step it mirrors the lifetime
+        rate (0.0), so gauges render from the first scrape."""
+        proposed = sum(p for p, _ in self._spec_recent)
+        if proposed == 0:
+            return 0.0
+        return sum(a for _, a in self._spec_recent) / proposed
+
     def stats(self) -> Dict[str, float]:
         return {
             "queue_depth": self.queue_depth,
@@ -1154,6 +1350,11 @@ class ServeEngine:
             "prefix_evictions": self._cache.evictions if self._cache else 0,
             "prefix_hit_rate": round(self.prefix_hit_rate, 4),
             "spec_accept_rate": round(self.spec_accept_rate, 4),
+            "spec_accept_rate_windowed": round(
+                self.spec_accept_rate_windowed, 4
+            ),
+            "spec_proposer": "draft" if self._use_draft else "ngram",
+            "spec_fallbacks": self.total_spec_fallbacks,
         }
 
     # -- the step loop -----------------------------------------------------
@@ -1311,14 +1512,21 @@ class ServeEngine:
             write_page[i, :n] = pages[pos // page]
             write_off[i, :n] = pos % page
 
-        next_tokens, self.k_pages, self.v_pages = self._prefill_fn(
+        out = self._prefill_fn(
             self._serve_params, jnp.asarray(tokens), self.k_pages, self.v_pages,
             jnp.asarray(write_page), jnp.asarray(write_off), jnp.asarray(lens),
         )
+        if self._use_draft:
+            next_tokens, hidden, self.k_pages, self.v_pages = out
+            hidden = np.asarray(hidden, np.float32)
+        else:
+            next_tokens, self.k_pages, self.v_pages = out
         next_tokens = np.asarray(next_tokens)
         for i, (slot, req) in enumerate(admitted):
             self.seq_lens[slot] = len(req.prompt)
             req.pos = len(req.prompt)
+            if self._use_draft:
+                self.last_hidden[slot] = hidden[i]
             self._emit(slot, req, int(next_tokens[i]), events)
 
     def _run_chunk_prefill(self, events: List[TokenEvent]) -> None:
@@ -1359,12 +1567,17 @@ class ServeEngine:
             write_off[i, :n] = pos % page
             tables[i] = self.page_tables[slot]
 
-        next_tokens, self.k_pages, self.v_pages = self._chunk_fn(
+        out = self._chunk_fn(
             self._serve_params, jnp.asarray(tokens), jnp.asarray(starts),
             jnp.asarray(valid), self.k_pages, self.v_pages,
             jnp.asarray(tables), jnp.asarray(write_page),
             jnp.asarray(write_off),
         )
+        if self._use_draft:
+            next_tokens, hidden, self.k_pages, self.v_pages = out
+            hidden = np.asarray(hidden, np.float32)
+        else:
+            next_tokens, self.k_pages, self.v_pages = out
         next_tokens = np.asarray(next_tokens)
         for i, slot in enumerate(slots):
             req = self.slots[slot]
@@ -1374,6 +1587,10 @@ class ServeEngine:
                 continue  # more chunks to go; nothing emitted yet
             if self._cache is not None:
                 self._cache.register(req.prompt, self.slot_pages[slot])
+            if self._use_draft:
+                # The final chunk's last valid position is the prompt's last
+                # token — exactly the state the head conditions on next.
+                self.last_hidden[slot] = hidden[i]
             self._emit(slot, req, int(next_tokens[i]), events)
 
     def _run_decode(self, decoding: List[int], events: List[TokenEvent]) -> None:
@@ -1435,12 +1652,27 @@ class ServeEngine:
             req = self.slots[slot]
             valid[slot] = min(c, req.max_new_tokens - len(req.tokens))
         self._ensure_decode_pages(decoding, extra=valid)
+        # Model-based drafting: one fixed-shape jitted forward proposes for
+        # every slot at once from the target's last hidden state (rows of
+        # preempted or fallen-back slots are computed but ignored — batching
+        # the head beats per-slot dispatch, and shapes stay compile-stable).
+        draft_rows = None
+        if self._use_draft and any(
+            self.slots[s] is not None and self.slots[s].draft_ok
+            for s in decoding
+        ):
+            draft_rows = np.asarray(self._draft_fn(
+                self._serve_params, self.draft_params,
+                jnp.asarray(self.last_hidden),
+                jnp.asarray(self.last_tokens),
+            ))  # [mb, k] int32
         tokens = np.zeros((mb, c), np.int32)
         starts = np.zeros(mb, np.int32)
         write_page = np.full((mb, c), pool, np.int32)
         write_off = np.zeros((mb, c), np.int32)
         active = []
         drafts: Dict[int, List[int]] = {}
+        used_draft: Dict[int, bool] = {}
         for slot in decoding:
             req = self.slots[slot]
             if req is None:  # preempted by _ensure_decode_pages
@@ -1448,18 +1680,22 @@ class ServeEngine:
             n = int(valid[slot])
             row = [int(self.last_tokens[slot])]
             if n > 1:
-                if req.spec_ctx is None:
-                    # prompt + tokens[absorbed:] is the emitted stream with
-                    # each token exactly once (plain prompt + tokens would
-                    # duplicate the pre-preemption segment a refold already
-                    # folded into the prompt).
-                    req.spec_ctx = (
-                        list(req.prompt) + list(req.tokens[req.absorbed:])
+                if draft_rows is not None and req.draft_ok:
+                    row += [int(t) for t in draft_rows[slot, : n - 1]]
+                    used_draft[slot] = True
+                else:
+                    if req.spec_ctx is None:
+                        # prompt + tokens[absorbed:] is the emitted stream
+                        # with each token exactly once (plain prompt + tokens
+                        # would duplicate the pre-preemption segment a refold
+                        # already folded into the prompt).
+                        req.spec_ctx = (
+                            list(req.prompt) + list(req.tokens[req.absorbed:])
+                        )
+                        req.spec_index = _ngram_index(req.spec_ctx)
+                    row += propose_from_index(
+                        req.spec_ctx, req.spec_index, n - 1
                     )
-                    req.spec_index = _ngram_index(req.spec_ctx)
-                row += propose_from_index(
-                    req.spec_ctx, req.spec_index, n - 1
-                )
             drafts[slot] = row[1:]
             tokens[slot, :n] = row
             starts[slot] = self.seq_lens[slot]
@@ -1471,12 +1707,17 @@ class ServeEngine:
         if not active:
             return
 
-        out_tokens, self.k_pages, self.v_pages = self._verify_fn(
+        out = self._verify_fn(
             self._serve_params, jnp.asarray(tokens),
             jnp.asarray(starts), jnp.asarray(valid, dtype=jnp.int32),
             self.k_pages, self.v_pages, jnp.asarray(self.page_tables),
             jnp.asarray(write_page), jnp.asarray(write_off),
         )
+        if self._use_draft:
+            out_tokens, hidden, self.k_pages, self.v_pages = out
+            hidden = np.asarray(hidden, np.float32)  # [mb, c, d_model]
+        else:
+            out_tokens, self.k_pages, self.v_pages = out
         out_tokens = np.asarray(out_tokens)  # [mb, c]
         for slot in active:
             req = self.slots[slot]
@@ -1493,6 +1734,14 @@ class ServeEngine:
             self.total_spec_accepted += accepted
             req.spec_proposed += n - 1
             req.spec_accepted += accepted
+            self._spec_recent.append((n - 1, accepted))
+            if self._use_draft:
+                # Row position `accepted` is the target's state after
+                # consuming every token it actually kept — the conditioning
+                # for this slot's next proposal.
+                self.last_hidden[slot] = hidden[slot, accepted]
+                if used_draft.get(slot):
+                    self._track_draft_accept(req, n - 1, accepted)
             # The accepted context tokens' K/V (row positions 0..accepted)
             # just landed; the new emitted tail token is not yet written.
             self.seq_lens[slot] += accepted + 1
@@ -1500,6 +1749,30 @@ class ServeEngine:
                 self._emit(slot, req, token, events)
                 if req.done:
                     break
+
+    def _track_draft_accept(
+        self, req: GenRequest, proposed: int, accepted: int
+    ) -> None:
+        """Per-request windowed accept tracking behind the automatic draft ->
+        n-gram fallback. The window must be FULL before the rate is judged —
+        a head that opens with a few unlucky steps on a hard prefix gets the
+        whole window to recover — and the fallback is permanent for the
+        request: flapping between proposers would churn the n-gram index for
+        no benefit. threshold <= 0 disables fallback entirely."""
+        if self.ecfg.spec_fallback_threshold <= 0:
+            return
+        if req.spec_recent is None:
+            req.spec_recent = collections.deque(
+                maxlen=max(self.ecfg.spec_fallback_window, 1)
+            )
+        req.spec_recent.append((proposed, accepted))
+        if len(req.spec_recent) < (req.spec_recent.maxlen or 1):
+            return
+        total_p = sum(p for p, _ in req.spec_recent)
+        total_a = sum(a for _, a in req.spec_recent)
+        if total_p > 0 and total_a / total_p < self.ecfg.spec_fallback_threshold:
+            req.draft_ok = False
+            self.total_spec_fallbacks += 1
 
     def _ensure_decode_pages(
         self, decoding: List[int], extra: Optional[np.ndarray] = None
@@ -1830,8 +2103,10 @@ def create_serve_app(runner: EngineRunner):
                 f"{engine.prefix_hit_rate:.4f}"
             )
         if engine.ecfg.spec_tokens > 0:
+            # Windowed, not lifetime: the proxy gauge is a health signal, and
+            # recent behavior is what fallback/tuning decisions look at.
             headers["X-Dstack-Spec-Accept-Rate"] = (
-                f"{engine.spec_accept_rate:.4f}"
+                f"{engine.spec_accept_rate_windowed:.4f}"
             )
         return headers
 
@@ -2014,6 +2289,24 @@ def main() -> None:
                         help="speculative decode: n-gram draft tokens"
                              " verified per step (0 = off); output stays"
                              " token-identical to greedy")
+    parser.add_argument("--spec-model", default="", dest="spec_model",
+                        help="checkpoint dir holding a distilled draft head"
+                             " (train.py --draft-head, .draft subtree);"
+                             " replaces the n-gram proposer for --spec-tokens"
+                             " — output stays token-identical to greedy")
+    parser.add_argument("--spec-model-step", type=int, default=None,
+                        dest="spec_model_step",
+                        help="draft-head checkpoint step (default: latest"
+                             " complete)")
+    parser.add_argument("--spec-fallback-window", type=int, default=16,
+                        dest="spec_fallback_window",
+                        help="spec steps per request the accept-rate fallback"
+                             " judges over (window must fill first)")
+    parser.add_argument("--spec-fallback-threshold", type=float, default=0.1,
+                        dest="spec_fallback_threshold",
+                        help="windowed accept rate below which a slot falls"
+                             " back from the draft head to the n-gram"
+                             " proposer (<= 0 disables fallback)")
     parser.add_argument("--checkpoint-dir", default="", dest="checkpoint_dir",
                         help="restore real weights from a train checkpoint"
                              " (CheckpointManager layout; the .params subtree"
@@ -2045,6 +2338,18 @@ def main() -> None:
                else ""),
             flush=True,
         )
+    draft_params = None
+    if args.spec_model:
+        if args.spec_tokens <= 0:
+            raise SystemExit("--spec-model needs --spec-tokens > 0")
+        draft_params, draft_manifest = load_draft_params(
+            args.spec_model, cfg, mesh=mesh, step=args.spec_model_step,
+        )
+        print(
+            f"draft head restored from {args.spec_model} step"
+            f" {draft_manifest['step']} (.draft subtree)",
+            flush=True,
+        )
     engine = ServeEngine(
         cfg,
         EngineConfig(
@@ -2058,9 +2363,12 @@ def main() -> None:
             prefill_chunk=args.prefill_chunk,
             prefix_cache=args.prefix_cache,
             spec_tokens=args.spec_tokens,
+            spec_fallback_window=args.spec_fallback_window,
+            spec_fallback_threshold=args.spec_fallback_threshold,
         ),
         params=params,
         mesh=mesh,
+        draft_params=draft_params,
     )
     runner = EngineRunner(engine)
     runner.start()
@@ -2070,6 +2378,7 @@ def main() -> None:
         f"policy={args.policy}, decode={engine.decode_impl}, "
         f"quant={args.quant}, prefill_chunk={args.prefill_chunk}, "
         f"prefix_cache={args.prefix_cache}, spec_tokens={args.spec_tokens}, "
+        f"spec_proposer={'draft' if draft_params is not None else 'ngram'}, "
         f"mesh={engine.mesh_desc or 'none'}, "
         f"weights={'checkpoint' if args.checkpoint_dir else 'synthetic'})",
         flush=True,

@@ -183,6 +183,83 @@ jax.tree_util.register_pytree_with_keys(
 )
 
 
+@dataclasses.dataclass
+class DraftTrainState:
+    """Distillation state for the speculative-decode draft head (--draft-head):
+    the FROZEN target rides along as ``params`` so one checkpoint is fully
+    self-contained for serving — ``serve.load_serve_params`` restores the
+    ``.params`` subtree and ``serve.load_draft_params`` the ``.draft`` subtree
+    from the same step. Only ``draft`` trains; ``opt_state`` covers it alone."""
+
+    params: Dict[str, jax.Array]
+    draft: Dict[str, jax.Array]
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+jax.tree_util.register_pytree_with_keys(
+    DraftTrainState,
+    lambda s: (
+        (
+            (jax.tree_util.GetAttrKey("params"), s.params),
+            (jax.tree_util.GetAttrKey("draft"), s.draft),
+            (jax.tree_util.GetAttrKey("opt_state"), s.opt_state),
+            (jax.tree_util.GetAttrKey("step"), s.step),
+        ),
+        None,
+    ),
+    lambda _, kids: DraftTrainState(*kids),
+)
+
+
+def make_draft_distill_step(
+    cfg: LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    rollout: int = 2,
+):
+    """Returns jitted (state: DraftTrainState, tokens) -> (state, loss).
+
+    One distillation step: the frozen target's forward produces the hidden
+    states and argmax labels, the head trains by cross-entropy against them
+    (model.draft_distill_loss — gradients reach ``state.draft`` only; the
+    target tree is a constant of the backward pass). No targets array: the
+    teacher IS the label source, so the same batch stream train.py feeds the
+    dense step drives distillation unchanged.
+
+    Only the trained leaves (draft, opt_state, step) are donated. The frozen
+    target is neither donated (the caller's params — a serve engine's, a
+    bench's — must survive the step) nor returned through jit (which would
+    copy the full target every step); the host-side wrapper threads the SAME
+    params reference into the new state."""
+
+    def inner(draft, opt_state, step_ct, params, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda d: model_lib.draft_distill_loss(
+                d, params, tokens, cfg, rollout=rollout, mesh=mesh
+            )
+        )(draft)
+        updates, new_opt = optimizer.update(grads, opt_state, draft)
+        return optax.apply_updates(draft, updates), new_opt, step_ct + 1, loss
+
+    if mesh is None:
+        jitted = jax.jit(inner, donate_argnums=(0, 1, 2))
+    else:
+        bspec = batch_sharding(mesh)
+        jitted = jax.jit(
+            inner, donate_argnums=(0, 1, 2),
+            in_shardings=(None, None, None, None, bspec),
+        )
+
+    def step(state: DraftTrainState, tokens: jax.Array):
+        new_draft, new_opt, new_step, loss = jitted(
+            state.draft, state.opt_state, state.step, state.params, tokens
+        )
+        return DraftTrainState(state.params, new_draft, new_opt, new_step), loss
+
+    return step
+
+
 def _step_time_stats(times) -> Dict[str, float]:
     """p50/p90/mean seconds from a list of per-step wall times."""
     if not times:
@@ -523,6 +600,150 @@ def _moe_main(args, moe_lib, data_lib) -> None:
             telemetry.close()
 
 
+def _draft_main(args, data_lib) -> None:
+    """--draft-head: distill the speculative-decode draft head against the
+    FROZEN target (model.draft_distill_loss). The target comes from the latest
+    checkpoint in --checkpoint-dir when one exists (its ``.params`` subtree —
+    a TrainState or an earlier DraftTrainState both restore) and synthetic
+    init otherwise; the saved state is a DraftTrainState whose step numbers
+    continue past the target's, so ``latest_step`` always lands on the
+    draft-bearing checkpoint and serve can point --checkpoint-dir AND
+    --spec-model at the same directory."""
+    from dstack_tpu.workloads.config import get_config, validate_config
+    from dstack_tpu.workloads.sharding import BATCH_SPEC, make_mesh
+
+    cfg = get_config(args.config)
+    cfg = apply_perf_overrides(cfg, args)
+    devices = jax.devices()
+    mesh = make_mesh(tp=args.tp, devices=devices)
+    data_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
+    batch = args.batch or 2 * data_shards
+    seq = args.seq or cfg.max_seq_len
+    validate_config(cfg, mesh, batch=batch, seq=seq)
+    print(f"draft-head distillation: config={args.config} devices={len(devices)} "
+          f"mesh={dict(mesh.shape)} batch={batch} seq={seq} "
+          f"layers={args.draft_layers} rollout={args.draft_rollout} "
+          f"lr={args.draft_lr}", flush=True)
+    telemetry = telemetry_lib.get_emitter()
+    telemetry.set_identity(proc=jax.process_index())
+    telemetry.mark("run_start", workload="train_draft", config=args.config,
+                   devices=len(devices), batch=batch, seq=seq)
+    optimizer = make_optimizer(learning_rate=args.draft_lr,
+                               mu_dtype=args.mu_dtype or None)
+    ckpt = make_checkpoint_manager(args, telemetry)
+
+    def has_draft(step: int) -> bool:
+        return any(
+            leaf["key"].startswith(".draft")
+            for leaf in ckpt.read_manifest(step)["leaves"]
+        )
+
+    with mesh:
+        base_step = 0
+        target = None
+        resume_full = False
+        latest = ckpt.latest_step() if ckpt is not None else None
+        if latest is not None:
+            if args.resume and has_draft(latest):
+                resume_full = True  # continue a draft run in place
+            else:
+                shapes = jax.eval_shape(
+                    lambda k: model_lib.init_params(cfg, k),
+                    jax.random.PRNGKey(0),
+                )
+                shardings = param_sharding(mesh)
+                template = {
+                    k: jax.ShapeDtypeStruct(
+                        v.shape, v.dtype, sharding=shardings.get(k)
+                    )
+                    for k, v in shapes.items()
+                }
+                target, manifest = ckpt.restore_subtree(
+                    template, step=latest, prefix=".params"
+                )
+                base_step = int(manifest["step"])
+                print(f"draft-head: frozen target from checkpoint step"
+                      f" {base_step}", flush=True)
+        if target is None and not resume_full:
+            shardings = param_sharding(mesh)
+            target = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+            target = {
+                k: jax.device_put(v, shardings[k]) for k, v in target.items()
+            }
+        rep = NamedSharding(mesh, P())
+        draft = jax.device_put(
+            model_lib.init_draft_params(
+                cfg, jax.random.PRNGKey(1), n_layers=args.draft_layers,
+                d_ff=args.draft_ff,
+            ),
+            rep,
+        )
+        if resume_full:
+            # Template with the CURRENT target shapes; restore() re-shards.
+            target = jax.device_put(
+                model_lib.init_params(cfg, jax.random.PRNGKey(0)),
+                param_sharding(mesh),
+            )
+            state = DraftTrainState(
+                target, draft, optimizer.init(draft),
+                jnp.zeros((), jnp.int32),
+            )
+            state, manifest = ckpt.restore(state, latest)
+            start_step = int(jax.device_get(state.step))
+            base_step = int(manifest["step"]) - start_step
+            print(f"resumed draft head at draft step {start_step}"
+                  f" (checkpoint step {manifest['step']})", flush=True)
+        else:
+            state = DraftTrainState(
+                target, draft, optimizer.init(draft),
+                jnp.zeros((), jnp.int32),
+            )
+            start_step = 0
+        step_fn = make_draft_distill_step(
+            cfg, optimizer, mesh, rollout=args.draft_rollout
+        )
+        feed = data_lib.input_pipeline(
+            mesh, BATCH_SPEC, batch, seq, cfg.vocab_size,
+            data_path=args.data or None, prefetch=args.prefetch,
+            start_batch=start_step,
+        )
+        box = {"state": state}
+        feed_wait = {"s": 0.0}
+
+        def do_step():
+            t0 = time.perf_counter()
+            tokens, _ = next(feed)  # the teacher labels itself; targets unused
+            feed_wait["s"] = time.perf_counter() - t0
+            box["state"], loss = step_fn(box["state"], tokens)
+            return loss
+
+        def on_step(step: int, loss) -> None:
+            if (ckpt is not None and args.checkpoint_every
+                    and step % args.checkpoint_every == 0
+                    and step < args.steps):
+                ckpt.save(base_step + step, box["state"], data_offset=step,
+                          mesh_shape=dict(mesh.shape))
+
+        try:
+            _timed_loop(args.steps, batch, seq, do_step, telemetry=telemetry,
+                        step_extras=lambda: {
+                            "input_wait_s": round(feed_wait["s"], 6)
+                        },
+                        start_step=start_step, on_step=on_step)
+            if ckpt is not None:
+                ckpt.save(base_step + args.steps, box["state"],
+                          data_offset=args.steps, mesh_shape=dict(mesh.shape),
+                          block=True)
+                print(f"draft head saved at checkpoint step"
+                      f" {base_step + args.steps} (.draft subtree)",
+                      flush=True)
+        finally:
+            feed.close()
+            if ckpt is not None:
+                ckpt.close()
+            telemetry.close()
+
+
 def main() -> None:
     """`python -m dstack_tpu.workloads.train` — the runnable training entrypoint
     the example configurations submit (examples/*.dstack.yml). Synthetic data by
@@ -630,12 +851,38 @@ def main() -> None:
                              " --checkpoint-dir (elastic: the current mesh"
                              " may differ from the one that saved it); a"
                              " fresh dir starts at step 0")
+    parser.add_argument("--draft-head", action="store_true", dest="draft_head",
+                        help="distill a speculative-decode draft head against"
+                             " the FROZEN target instead of training the"
+                             " target: cross-entropy vs the target's argmax on"
+                             " the same batch stream; saved as the .draft"
+                             " subtree next to .params (serve --spec-model)")
+    parser.add_argument("--draft-layers", type=int, default=2,
+                        dest="draft_layers",
+                        help="draft-head depth (pre-norm residual blocks)")
+    parser.add_argument("--draft-ff", type=int, default=0, dest="draft_ff",
+                        help="draft-head MLP width (0 = 2 * d_model)")
+    parser.add_argument("--draft-lr", type=float, default=1e-3,
+                        dest="draft_lr",
+                        help="draft-head AdamW learning rate (the head is"
+                             " small; it takes more than the target's 3e-4)")
+    parser.add_argument("--draft-rollout", type=int, default=2,
+                        dest="draft_rollout",
+                        help="distillation rollout depth: steps >= 2 train the"
+                             " head on its own continuations, which is what"
+                             " later proposal positions see at serve time")
     args = parser.parse_args()
     if args.checkpoint_every and not args.checkpoint_dir:
         raise SystemExit("--checkpoint-every requires --checkpoint-dir")
 
     if args.config in moe_lib.MOE_PRESETS:
+        if args.draft_head:
+            raise SystemExit("--draft-head supports dense configs only")
         _moe_main(args, moe_lib, data_lib)
+        return
+
+    if args.draft_head:
+        _draft_main(args, data_lib)
         return
 
     cfg = get_config(args.config)

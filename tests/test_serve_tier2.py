@@ -398,6 +398,125 @@ class TestSpeculativeDecode:
             assert grown == index, ctx
 
 
+@pytest.fixture(scope="module")
+def draft(params):
+    # A RANDOM head: its proposals are near-worthless, which is exactly the
+    # point — token identity must hold for any head, because drafts are only
+    # a throughput bet the verify forward scores. Accept-rate quality is
+    # bench_serve's concern (distilled heads), not correctness's.
+    return model_lib.init_draft_params(TINY, jax.random.PRNGKey(7))
+
+
+def make_draft_engine(params, draft, **overrides) -> serve_lib.ServeEngine:
+    kwargs = dict(page_size=8, num_pages=32, max_batch=4, max_seq=128,
+                  spec_tokens=3, spec_fallback_threshold=0.0)
+    kwargs.update(overrides)
+    return serve_lib.ServeEngine(
+        TINY, serve_lib.EngineConfig(**kwargs), params=params,
+        draft_params=draft,
+    )
+
+
+class TestDraftHead:
+    def test_token_identical_to_plain_engine(self, params, draft):
+        ref = tier1_decode(params, PROMPTS, 16)
+        engine = make_draft_engine(params, draft)
+        reqs = [engine.submit(p, max_new_tokens=16) for p in PROMPTS]
+        drain(engine)
+        assert [r.tokens for r in reqs] == ref
+        assert engine.total_spec_proposed > 0
+        assert engine.stats()["spec_proposer"] == "draft"
+
+    def test_token_identical_under_preemption(self, params, draft):
+        """Preemption + re-prefill with a draft head: the refolded prompt's
+        prefill must rebuild last_hidden so post-resume proposals condition
+        on the right state — and the stream stays exactly greedy."""
+        ref = tier1_decode(params, PREEMPT_PROMPTS, 20)
+        engine = make_draft_engine(params, draft, **PREEMPT_POOL)
+        reqs = [engine.submit(p, max_new_tokens=20) for p in PREEMPT_PROMPTS]
+        drain(engine)
+        assert max(r.preemptions for r in reqs) >= 1
+        assert [r.tokens for r in reqs] == ref
+
+    def test_chunked_prefill_and_prefix_cache_compose(self, params, draft):
+        """Tier-2 prefill paths must hand back the same conditioning hidden
+        the whole-prompt path does (last chunk's final valid position)."""
+        engine = make_draft_engine(params, draft, prefix_cache=True,
+                                   prefill_chunk=4)
+        warm = engine.submit(SHARED_PREFIX + [50], max_new_tokens=2)
+        drain(engine)
+        assert warm.done
+        prompts = [SHARED_PREFIX + [60], SHARED_PREFIX + [61]]
+        reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+        drain(engine)
+        assert engine.total_prefix_hit_tokens > 0
+        assert [r.tokens for r in reqs] == tier1_decode(params, prompts, 6)
+
+    def test_int8_matches_plain_int8(self, params, draft):
+        """Weight-only quant changes numerics (no fp reference), but the
+        draft head must be a pure scheduling change WITHIN the int8 world —
+        the proposer conditions on the quantized target's own hidden and
+        verifies through the quantized target's own logits."""
+        plain = make_engine(params, quant="int8")
+        p_reqs = [plain.submit(p, max_new_tokens=6) for p in PROMPTS]
+        drain(plain)
+        spec = make_draft_engine(params, draft, quant="int8")
+        s_reqs = [spec.submit(p, max_new_tokens=6) for p in PROMPTS]
+        drain(spec)
+        assert [r.tokens for r in s_reqs] == [r.tokens for r in p_reqs]
+
+    def test_fallback_trigger(self, params, draft):
+        """A random head accepts ~nothing, so a full window at a demanding
+        threshold must flip the slot to the n-gram proposer — permanently,
+        with the stream still exactly greedy."""
+        ref = tier1_decode(params, [PROMPTS[0]], 20)
+        engine = make_draft_engine(params, draft, spec_fallback_window=4,
+                                   spec_fallback_threshold=0.9)
+        req = engine.submit(PROMPTS[0], max_new_tokens=20)
+        drain(engine)
+        assert req.tokens == ref[0]
+        assert not req.draft_ok
+        assert engine.total_spec_fallbacks == 1
+        assert engine.stats()["spec_fallbacks"] == 1
+
+    def test_fallback_needs_full_window(self, params, draft):
+        # 6 spec steps max (one emitted token each at ~0 accept) can never
+        # fill a 50-step window — the head keeps proposing to the end.
+        engine = make_draft_engine(params, draft, spec_fallback_window=50,
+                                   spec_fallback_threshold=0.9)
+        req = engine.submit(PROMPTS[0], max_new_tokens=6)
+        drain(engine)
+        assert req.draft_ok
+        assert engine.total_spec_fallbacks == 0
+
+    def test_draft_requires_spec_tokens(self, params, draft):
+        with pytest.raises(ValueError, match="spec_tokens"):
+            make_draft_engine(params, draft, spec_tokens=0)
+
+    def test_propose_shape_dtype_contract(self, params, draft):
+        """The jitted proposer's contract the engine builds rows from:
+        [S, k] int32 for any slot count, matching the pure-model reference."""
+        fn = serve_lib.make_draft_fn(TINY, 4)
+        hidden = jnp.zeros((3, TINY.d_model), jnp.float32)
+        last = jnp.array([5, 9, 200], jnp.int32)
+        out = fn(params, draft, hidden, last)
+        assert out.shape == (3, 4) and out.dtype == jnp.int32
+        ref = model_lib.draft_propose(params, draft, hidden, last, 4, TINY)
+        assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_windowed_accept_rate(self, params, draft):
+        engine = make_draft_engine(params, draft, spec_window=4)
+        assert engine.spec_accept_rate_windowed == 0.0  # renders pre-traffic
+        for sample in [(3, 3), (3, 3), (3, 0), (3, 0)]:
+            engine._spec_recent.append(sample)
+        assert engine.spec_accept_rate_windowed == pytest.approx(0.5)
+        # The window slides: two perfect steps push out two perfect steps.
+        engine._spec_recent.append((3, 3))
+        engine._spec_recent.append((3, 3))
+        assert engine.spec_accept_rate_windowed == pytest.approx(0.5)
+        assert engine.stats()["spec_accept_rate_windowed"] == 0.5
+
+
 class TestCombined:
     def test_all_three_with_pallas_decode(self, params):
         """Chunked prefill + prefix cache + speculation, decode_impl=pallas:

@@ -22,6 +22,7 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 import optax
 
 from dstack_tpu.workloads import data as data_lib
+from dstack_tpu.workloads import model as model_lib
 from dstack_tpu.workloads import moe as moe_lib
 from dstack_tpu.workloads import train as train_lib
 from dstack_tpu.workloads import xla_flags
@@ -550,6 +551,90 @@ class TestOverlapEnvInjection:
         for job in jobs:
             assert "XLA_FLAGS" not in job.env
             assert "LIBTPU_INIT_ARGS" not in job.env
+
+
+class TestDraftDistill:
+    """Draft-head distillation (serve speculation's model-based proposer):
+    the loop must actually fit the frozen target's argmax, leave the target
+    untouched, and round-trip the head through the ``.draft`` subtree the
+    serve engine restores from."""
+
+    def test_distill_improves_and_freezes_target(self):
+        cfg = fp32_cfg()
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        draft = model_lib.init_draft_params(cfg, jax.random.PRNGKey(1))
+        opt = train_lib.make_optimizer(learning_rate=1e-2)
+        state = train_lib.DraftTrainState(
+            params=params, draft=draft, opt_state=opt.init(draft),
+            step=jnp.zeros((), jnp.int32),
+        )
+        step = train_lib.make_draft_distill_step(cfg, opt)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (4, 32), 0, cfg.vocab_size
+        )
+        target_before = {k: np.asarray(v) for k, v in params.items()}
+        losses = []
+        for _ in range(12):
+            state, loss = step(state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        assert int(state.step) == 12
+        for k, v in state.params.items():
+            np.testing.assert_array_equal(np.asarray(v), target_before[k])
+
+    def test_draft_subtree_roundtrips_into_serve(self, tmp_path):
+        from dstack_tpu.workloads import serve as serve_lib
+        from dstack_tpu.workloads.checkpoint import CheckpointManager
+
+        cfg = fp32_cfg()
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        draft = model_lib.init_draft_params(cfg, jax.random.PRNGKey(1))
+        opt = train_lib.make_optimizer(learning_rate=1e-2)
+        state = train_lib.DraftTrainState(
+            params=params, draft=draft, opt_state=opt.init(draft),
+            step=jnp.asarray(3, jnp.int32),
+        )
+        CheckpointManager(str(tmp_path)).save(3, state, block=True)
+        restored, manifest = serve_lib.load_draft_params(str(tmp_path), cfg)
+        assert manifest["step"] == 3
+        assert set(restored) == set(draft)
+        for k in draft:
+            np.testing.assert_array_equal(
+                np.asarray(restored[k]), np.asarray(draft[k]), err_msg=k
+            )
+        # The same checkpoint also serves the TARGET weights (.params): one
+        # artifact, both restore paths.
+        served, _ = serve_lib.load_serve_params(str(tmp_path), cfg)
+        np.testing.assert_array_equal(
+            np.asarray(served["embed"]), np.asarray(params["embed"])
+        )
+
+    def test_wrong_width_head_rejected(self, tmp_path):
+        from dstack_tpu.workloads import serve as serve_lib
+        from dstack_tpu.workloads.checkpoint import CheckpointManager
+
+        cfg = fp32_cfg()
+        narrow = fp32_cfg(d_model=64, n_heads=4, n_kv_heads=4)
+        opt = train_lib.make_optimizer(learning_rate=1e-2)
+        draft = model_lib.init_draft_params(narrow, jax.random.PRNGKey(1))
+        state = train_lib.DraftTrainState(
+            params=model_lib.init_params(narrow, jax.random.PRNGKey(0)),
+            draft=draft, opt_state=opt.init(draft),
+            step=jnp.zeros((), jnp.int32),
+        )
+        CheckpointManager(str(tmp_path)).save(1, state, block=True)
+        with pytest.raises(ValueError, match="d_model"):
+            serve_lib.load_draft_params(str(tmp_path), cfg)
+
+    def test_params_only_checkpoint_rejected(self, tmp_path):
+        from dstack_tpu.workloads import serve as serve_lib
+        from dstack_tpu.workloads.checkpoint import CheckpointManager
+
+        cfg = fp32_cfg()
+        params = model_lib.init_params(cfg, jax.random.PRNGKey(0))
+        CheckpointManager(str(tmp_path)).save(1, params, block=True)
+        with pytest.raises(ValueError, match="--draft-head"):
+            serve_lib.load_draft_params(str(tmp_path), cfg)
 
 
 class TestEntrypointDefaults:
